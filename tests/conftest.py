@@ -1,0 +1,35 @@
+"""Test harness configuration.
+
+"Cluster without a cluster" (reference TestSparkContext's local[2] Spark,
+utils/.../test/TestSparkContext.scala:36): tests run on a virtual 8-device
+CPU mesh so multi-chip sharding logic is exercised without TPU hardware.
+Must set flags before jax initializes.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.utils.uid import reset as _reset_uids
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_uids():
+    _reset_uids(deterministic=True)
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

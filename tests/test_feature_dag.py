@@ -1,0 +1,132 @@
+"""Feature DAG tests (reference: features/src/test/.../FeatureLikeTest etc)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features import (Dataset, Feature, FeatureBuilder,
+                                        FeatureColumn, FeatureCycleError,
+                                        parent_stages, topo_layers)
+from transmogrifai_tpu.stages.base import UnaryTransformer, BinaryTransformer
+from transmogrifai_tpu.types import Real, RealNN, Text
+
+
+class Plus1(UnaryTransformer):
+    input_types = (Real,)
+    output_type = Real
+
+    def transform_columns(self, cols):
+        return FeatureColumn(Real, cols[0].data + 1.0)
+
+
+class Add(BinaryTransformer):
+    input_types = (Real, Real)
+    output_type = Real
+
+    def transform_columns(self, cols):
+        return FeatureColumn(Real, cols[0].data + cols[1].data)
+
+
+def _raw(name, ftype=Real, response=False):
+    b = FeatureBuilder.of(name, ftype).extract(lambda r: r.get(name))
+    return b.as_response() if response else b.as_predictor()
+
+
+class TestDag:
+    def test_transform_with_and_parents(self):
+        a, b = _raw("a"), _raw("b")
+        c = a.transform_with(Plus1())
+        d = c.transform_with(Add(), b)
+        assert d.parents == (c, b)
+        assert {f.name for f in d.raw_features()} == {"a", "b"}
+
+    def test_topo_layers_distances(self):
+        a, b = _raw("a"), _raw("b")
+        c = a.transform_with(Plus1())        # dist 2 from e
+        d = c.transform_with(Add(), b)       # dist 1
+        e = d.transform_with(Plus1())        # dist 0
+        layers = topo_layers([e])
+        names = [[type(s).__name__ for s in layer] for layer in layers]
+        assert names[-1] == ["Plus1"]
+        # every stage appears in a strictly earlier layer than its consumers
+        pos = {s.uid: i for i, layer in enumerate(layers) for s in layer}
+        for layer in layers:
+            for s in layer:
+                for f in s.input_features:
+                    assert pos[f.origin_stage.uid] < pos[s.uid]
+        dist = parent_stages([e])
+        assert dist[e.origin_stage] == 0
+        assert dist[c.origin_stage] == 2
+        assert dist[a.origin_stage] == 3
+
+    def test_diamond_max_distance(self):
+        a = _raw("a")
+        b = a.transform_with(Plus1())
+        c = b.transform_with(Plus1())
+        d = b.transform_with(Add(), c)
+        dist = parent_stages([d])
+        # b's stage must be at max distance over both paths (2 via c)
+        assert dist[b.origin_stage] == 2
+
+    def test_cycle_detection(self):
+        a = _raw("a")
+        b = a.transform_with(Plus1())
+        # force a cycle
+        b.origin_stage.input_features = (b,)
+        object.__setattr__ if False else None
+        b.parents = (b,)
+        with pytest.raises(FeatureCycleError):
+            parent_stages([b])
+
+    def test_type_checking(self):
+        t = _raw("t", Text)
+        with pytest.raises(TypeError):
+            t.transform_with(Plus1())
+
+    def test_response_propagation(self):
+        y = _raw("y", RealNN, response=True)
+        z = y.transform_with(Plus1())
+        assert z.is_response
+        x = _raw("x")
+        w = x.transform_with(Add(), y)
+        assert not w.is_response
+
+    def test_copy_with_new_stages(self):
+        a = _raw("a")
+        p = Plus1()
+        b = a.transform_with(p)
+        q = Plus1()
+        q.uid = "replacement"
+        b2 = b.copy_with_new_stages({p.uid: q})
+        assert b2.origin_stage is q
+        assert b2.uid == b.uid
+        assert b.origin_stage is p  # original untouched
+
+
+class TestDataset:
+    def test_columns_roundtrip(self):
+        ds = Dataset({
+            "x": FeatureColumn.from_values(Real, [1.0, None, 3.0]),
+            "t": FeatureColumn.from_values(Text, ["a", None, "c"]),
+        })
+        assert ds.n_rows == 3
+        assert np.isnan(ds["x"].data[1])
+        assert ds["x"].boxed(1).is_empty
+        assert ds["t"].boxed(2).value == "c"
+        assert ds["x"].is_missing().tolist() == [False, True, False]
+
+    def test_transform_dataset(self):
+        a = _raw("a")
+        ds = Dataset({"a": FeatureColumn.from_values(Real, [1.0, 2.0])})
+        stage = Plus1().set_input(a)
+        out = stage.get_output()
+        ds2 = stage.transform_dataset(ds)
+        assert ds2[out.name].data.tolist() == [2.0, 3.0]
+
+    def test_row_path_equals_batch_path(self):
+        # contract: batch transform == row-level transform (reference
+        # OpTransformerSpec checks both paths)
+        a = _raw("a")
+        stage = Plus1().set_input(a)
+        col = FeatureColumn.from_values(Real, [1.5, 2.5])
+        batch = stage.transform_columns([col]).data.tolist()
+        rows = [stage.transform_value(v).value for v in [1.5, 2.5]]
+        assert batch == rows

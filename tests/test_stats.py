@@ -1,0 +1,104 @@
+"""Stats kernel tests against hand-computed / scipy values
+(reference: utils/src/test/.../OpStatisticsTest.scala)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.utils.histogram import StreamingHistogram
+from transmogrifai_tpu.utils.stats import (chi_square, col_stats,
+                                           contingency_stats,
+                                           correlation_matrix,
+                                           correlation_with_label, cramers_v)
+
+
+class TestColStats:
+    def test_moments(self, rng):
+        X = rng.normal(size=(500, 4))
+        s = col_stats(X)
+        np.testing.assert_allclose(s.mean, X.mean(axis=0), atol=1e-6)
+        np.testing.assert_allclose(s.variance, X.var(axis=0, ddof=1),
+                                   atol=1e-6)
+        np.testing.assert_allclose(s.min, X.min(axis=0), atol=1e-6)
+        np.testing.assert_allclose(s.max, X.max(axis=0), atol=1e-6)
+
+    def test_weighted_mean(self):
+        X = np.asarray([[1.0], [3.0]])
+        s = col_stats(X, w=np.asarray([3.0, 1.0]))
+        assert s.mean[0] == pytest.approx(1.5)
+
+
+class TestCorrelation:
+    def test_matches_numpy(self, rng):
+        X = rng.normal(size=(200, 5))
+        C = correlation_matrix(X)
+        np.testing.assert_allclose(C, np.corrcoef(X, rowvar=False),
+                                   atol=1e-6)
+
+    def test_label_corr(self, rng):
+        X = rng.normal(size=(300, 3))
+        y = X[:, 0] * 2.0 + rng.normal(size=300) * 0.01
+        c = correlation_with_label(X, y)
+        assert c[0] > 0.99
+        assert abs(c[1]) < 0.2
+
+    def test_constant_column_nan(self):
+        X = np.asarray([[1.0, 5.0], [2.0, 5.0], [3.0, 5.0]])
+        C = correlation_matrix(X)
+        assert np.isnan(C[0, 1])
+
+
+class TestContingency:
+    def test_cramers_v_perfect_association(self):
+        table = np.asarray([[50, 0], [0, 50]])
+        assert cramers_v(table) == pytest.approx(1.0)
+
+    def test_cramers_v_independence(self):
+        table = np.asarray([[25, 25], [25, 25]])
+        assert cramers_v(table) == pytest.approx(0.0)
+
+    def test_chi2_matches_scipy(self):
+        from scipy.stats import chi2_contingency
+        table = np.asarray([[10, 20, 30], [20, 25, 15]])
+        stat, p, dof = chi_square(table)
+        ref = chi2_contingency(table, correction=False)
+        assert stat == pytest.approx(ref.statistic)
+        assert p == pytest.approx(ref.pvalue)
+
+    def test_rule_confidence_and_support(self):
+        table = np.asarray([[30, 10], [5, 55]])
+        cs = contingency_stats(table)
+        assert cs.max_rule_confidences[0] == pytest.approx(0.75)
+        assert cs.max_rule_confidences[1] == pytest.approx(55 / 60)
+        assert cs.supports.sum() == pytest.approx(1.0)
+        assert cs.mutual_info > 0
+
+
+class TestStreamingHistogram:
+    def test_exact_when_under_capacity(self):
+        h = StreamingHistogram(max_bins=10)
+        h.update([1, 2, 3])
+        c, n = h.bins()
+        assert c.tolist() == [1, 2, 3]
+        assert n.tolist() == [1, 1, 1]
+
+    def test_merges_to_capacity(self, rng):
+        h = StreamingHistogram(max_bins=8)
+        h.update(rng.normal(size=1000))
+        c, n = h.bins()
+        assert len(c) == 8
+        assert n.sum() == pytest.approx(1000)
+
+    def test_quantile_roughly_correct(self, rng):
+        x = rng.normal(size=5000)
+        h = StreamingHistogram(max_bins=64).update(x)
+        assert h.quantile(0.5) == pytest.approx(np.median(x), abs=0.1)
+
+    def test_merge_two(self, rng):
+        a = StreamingHistogram(32).update(rng.normal(size=500))
+        b = StreamingHistogram(32).update(rng.normal(loc=3, size=500))
+        a.merge(b)
+        assert a.total == pytest.approx(1000)
+
+    def test_json_roundtrip(self, rng):
+        h = StreamingHistogram(16).update(rng.normal(size=100))
+        h2 = StreamingHistogram.from_json(h.to_json())
+        np.testing.assert_allclose(h.centroids, h2.centroids)

@@ -1,0 +1,128 @@
+"""Feature type system tests (reference: features/src/test/.../types/)."""
+import math
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.types import FeatureTypeError
+
+
+class TestNumerics:
+    def test_real(self):
+        assert T.Real(3.5).value == 3.5
+        assert T.Real(None).is_empty
+        assert T.Real(float("nan")).is_empty
+        assert T.Real(3).value == 3.0
+
+    def test_real_nn_rejects_empty(self):
+        with pytest.raises(FeatureTypeError):
+            T.RealNN(None)
+        assert T.RealNN(1.0).value == 1.0
+
+    def test_binary(self):
+        assert T.Binary(True).value is True
+        assert T.Binary(0.0).value is False
+        assert T.Binary(None).is_empty
+        with pytest.raises(FeatureTypeError):
+            T.Binary(2.0)
+
+    def test_integral(self):
+        assert T.Integral(7).value == 7
+        assert T.Integral(7.0).value == 7
+        with pytest.raises(FeatureTypeError):
+            T.Integral(7.5)
+
+    def test_date_hierarchy(self):
+        assert issubclass(T.DateTime, T.Date)
+        assert issubclass(T.Date, T.Integral)
+        assert issubclass(T.Currency, T.Real)
+        assert issubclass(T.Percent, T.Real)
+
+
+class TestText:
+    def test_text(self):
+        assert T.Text("abc").value == "abc"
+        assert T.Text(None).is_empty
+        assert T.Text("").is_empty
+
+    def test_email_parts(self):
+        e = T.Email("joe@example.com")
+        assert e.prefix == "joe"
+        assert e.domain == "example.com"
+        assert T.Email("not-an-email").prefix is None
+
+    def test_url(self):
+        u = T.URL("https://example.com/x")
+        assert u.is_valid and u.domain == "example.com" \
+            and u.protocol == "https"
+        assert not T.URL("gopher://x").is_valid
+
+    def test_base64(self):
+        b = T.Base64("aGVsbG8=")
+        assert b.as_string() == "hello"
+
+    def test_categorical_markers(self):
+        assert issubclass(T.PickList, T.Categorical)
+        assert issubclass(T.ComboBox, T.Categorical)
+        assert issubclass(T.Country, T.Location)
+
+
+class TestCollections:
+    def test_vector(self):
+        v = T.OPVector([1.0, 2.0])
+        assert v.value.tolist() == [1.0, 2.0]
+        assert T.OPVector(None).is_empty
+        assert v.combine(T.OPVector([3.0])).value.tolist() == [1, 2, 3]
+
+    def test_lists_sets(self):
+        assert T.TextList(["a", "b"]).value == ("a", "b")
+        assert T.MultiPickList({"x", "y"}).value == frozenset({"x", "y"})
+        assert len(T.DateList(None)) == 0
+
+    def test_geolocation(self):
+        g = T.Geolocation((37.77, -122.42, 1.0))
+        assert g.lat == pytest.approx(37.77)
+        sphere = g.to_unit_sphere()
+        back = T.Geolocation.from_unit_sphere(*sphere)
+        assert back.lat == pytest.approx(g.lat)
+        assert back.lon == pytest.approx(g.lon)
+        with pytest.raises(FeatureTypeError):
+            T.Geolocation((200.0, 0.0, 1.0))
+
+
+class TestMaps:
+    def test_text_map(self):
+        m = T.TextMap({"a": "x", "b": None})
+        assert m.value == {"a": "x"}
+
+    def test_real_map(self):
+        m = T.RealMap({"a": 1, "b": 2.5})
+        assert m["a"] == 1.0 and m["b"] == 2.5
+
+    def test_prediction(self):
+        p = T.Prediction.build(1.0, raw_prediction=[0.2, 0.8],
+                               probability=[0.3, 0.7])
+        assert p.prediction == 1.0
+        assert p.raw_prediction.tolist() == [0.2, 0.8]
+        assert p.probability.tolist() == [0.3, 0.7]
+        with pytest.raises(FeatureTypeError):
+            T.Prediction({"probability_0": 0.3})
+
+    def test_registry_counts(self):
+        names = {t.__name__ for t in T.all_feature_types()}
+        expected = {
+            "Real", "RealNN", "Binary", "Integral", "Percent", "Currency",
+            "Date", "DateTime", "Text", "Email", "Base64", "Phone", "ID",
+            "URL", "TextArea", "PickList", "ComboBox", "Country", "State",
+            "PostalCode", "City", "Street", "OPVector", "TextList",
+            "DateList", "DateTimeList", "MultiPickList", "Geolocation",
+            "TextMap", "EmailMap", "Base64Map", "PhoneMap", "IDMap",
+            "URLMap", "TextAreaMap", "PickListMap", "ComboBoxMap",
+            "BinaryMap", "IntegralMap", "RealMap", "PercentMap",
+            "CurrencyMap", "DateMap", "DateTimeMap", "MultiPickListMap",
+            "CountryMap", "StateMap", "CityMap", "PostalCodeMap",
+            "StreetMap", "GeolocationMap", "Prediction",
+        }
+        assert expected <= names
+        assert len(expected) == 52

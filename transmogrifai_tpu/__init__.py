@@ -1,0 +1,14 @@
+"""transmogrifai_tpu: a TPU-native AutoML framework for structured data.
+
+Type-safe feature pipelines, automated feature engineering/validation and
+XLA-compiled model selection — the capability surface of TransmogrifAI
+(reference at /root/reference) re-designed for JAX/XLA on TPU.
+"""
+__version__ = "0.1.0"
+
+from .features import (Dataset, Feature, FeatureBuilder, FeatureColumn,
+                       FeatureGeneratorStage)
+from . import types
+
+__all__ = ["Dataset", "Feature", "FeatureBuilder", "FeatureColumn",
+           "FeatureGeneratorStage", "types", "__version__"]

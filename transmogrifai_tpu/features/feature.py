@@ -1,0 +1,221 @@
+"""The lazy, typed feature DAG.
+
+TPU-native port of the reference Feature DAG
+(features/src/main/scala/com/salesforce/op/features/{FeatureLike.scala:48,
+Feature.scala:52}): a ``Feature`` is a lazy node naming the output of a
+stage applied to parent features; nothing is materialized until a workflow
+runs. Topological sorting (``parent_stages``, reference
+FeatureLike.parentStages:363-430) assigns every origin stage its maximum
+distance from the result features — the workflow fits/transforms layer by
+layer in decreasing distance order.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from ..types import FeatureType
+from ..utils.uid import uid as make_uid
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..stages.base import PipelineStage
+
+__all__ = ["Feature", "FeatureCycleError", "FeatureHistory", "topo_layers"]
+
+
+class FeatureCycleError(ValueError):
+    """Raised when the feature graph contains a cycle
+    (reference FeatureCycleException)."""
+
+
+class FeatureHistory:
+    """Lineage record: origin raw features + stage operations applied
+    (reference utils/.../FeatureHistory.scala)."""
+
+    def __init__(self, origin_features: Sequence[str],
+                 stages: Sequence[str]):
+        self.origin_features = tuple(sorted(set(origin_features)))
+        self.stages = tuple(stages)
+
+    def to_json(self) -> dict:
+        return {"originFeatures": list(self.origin_features),
+                "stages": list(self.stages)}
+
+    def __repr__(self) -> str:
+        return (f"FeatureHistory(origin={list(self.origin_features)}, "
+                f"stages={list(self.stages)})")
+
+
+class Feature:
+    """A node in the feature DAG (reference Feature.scala:52)."""
+
+    __slots__ = ("name", "ftype", "is_response", "origin_stage", "parents",
+                 "uid", "distributions")
+
+    def __init__(self, name: str, ftype: Type[FeatureType],
+                 is_response: bool = False,
+                 origin_stage: Optional["PipelineStage"] = None,
+                 parents: Sequence["Feature"] = (),
+                 uid: Optional[str] = None,
+                 distributions: tuple = ()):
+        self.name = name
+        self.ftype = ftype
+        self.is_response = is_response
+        self.origin_stage = origin_stage
+        self.parents: Tuple[Feature, ...] = tuple(parents)
+        self.uid = uid or make_uid("Feature")
+        #: feature distributions recorded by RawFeatureFilter
+        self.distributions = distributions
+
+    # -- graph API ---------------------------------------------------------
+    @property
+    def is_raw(self) -> bool:
+        return len(self.parents) == 0
+
+    def transform_with(self, stage: "PipelineStage",
+                       *others: "Feature") -> "Feature":
+        """Apply a stage to this feature (+ optional co-inputs) and return
+        its output feature (reference FeatureLike.transformWith)."""
+        return stage.set_input(self, *others).get_output()
+
+    def traverse(self, visit: Callable[["Feature"], None]) -> None:
+        """DFS over the subgraph rooted here, with cycle detection
+        (reference FeatureLike.traverse:309)."""
+        on_path: set[str] = set()
+        seen: set[str] = set()
+
+        def go(f: "Feature"):
+            if f.uid in on_path:
+                raise FeatureCycleError(
+                    f"Feature cycle detected at {f.name!r}")
+            if f.uid in seen:
+                return
+            on_path.add(f.uid)
+            visit(f)
+            for p in f.parents:
+                go(p)
+            on_path.discard(f.uid)
+            seen.add(f.uid)
+
+        go(self)
+
+    def raw_features(self) -> List["Feature"]:
+        """All raw (leaf) ancestors (reference FeatureLike.rawFeatures:338)."""
+        uniq: dict[str, Feature] = {}
+        for f in _collect(self):
+            if f.is_raw:
+                uniq.setdefault(f.uid, f)
+        return sorted(uniq.values(), key=lambda f: f.name)
+
+    def parent_stages(self) -> Dict["PipelineStage", int]:
+        """Map each ancestor origin stage to its max distance from this
+        feature (reference FeatureLike.parentStages:363-430)."""
+        return parent_stages([self])
+
+    def history(self) -> FeatureHistory:
+        """Origin features + stage lineage (reference FeatureLike.history)."""
+        origins = [f.name for f in self.raw_features()]
+        dist = self.parent_stages()
+        ordered = sorted(dist.items(), key=lambda kv: -kv[1])
+        return FeatureHistory(
+            origin_features=origins,
+            stages=[s.stage_name() for s, _ in ordered])
+
+    def copy_with_new_stages(self, stage_map: Dict[str, "PipelineStage"]
+                             ) -> "Feature":
+        """Rebuild the DAG swapping origin stages by uid — used to replace
+        estimators with their fitted models after training
+        (reference Feature.copyWithNewStages:86)."""
+        cache: dict[str, Feature] = {}
+
+        def rebuild(f: "Feature") -> "Feature":
+            if f.uid in cache:
+                return cache[f.uid]
+            new_parents = tuple(rebuild(p) for p in f.parents)
+            stage = stage_map.get(f.origin_stage.uid, f.origin_stage) \
+                if f.origin_stage is not None else None
+            nf = Feature(name=f.name, ftype=f.ftype,
+                         is_response=f.is_response, origin_stage=stage,
+                         parents=new_parents, uid=f.uid,
+                         distributions=f.distributions)
+            cache[f.uid] = nf
+            return nf
+
+        return rebuild(self)
+
+    # -- dunder ------------------------------------------------------------
+    def __repr__(self) -> str:
+        kind = "response" if self.is_response else "predictor"
+        return (f"Feature[{self.ftype.__name__}]({self.name!r}, {kind}, "
+                f"raw={self.is_raw})")
+
+    def __hash__(self) -> int:
+        return hash(self.uid)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Feature) and self.uid == other.uid
+
+
+def _collect(root: Feature) -> List[Feature]:
+    out: list[Feature] = []
+    seen: set[str] = set()
+    stack = [root]
+    while stack:
+        f = stack.pop()
+        if f.uid in seen:
+            continue
+        seen.add(f.uid)
+        out.append(f)
+        stack.extend(f.parents)
+    return out
+
+
+def parent_stages(result_features: Sequence[Feature]
+                  ) -> Dict["PipelineStage", int]:
+    """Stage -> max distance from any result feature, with cycle check
+    (reference FeatureLike.parentStages:363-430). Longest-path DP over the
+    feature DAG in topological order."""
+    color: dict[str, int] = {}   # 0/absent=white, 1=gray, 2=black
+    post: list[Feature] = []     # post-order: parents before children
+
+    def dfs(f: Feature):
+        c = color.get(f.uid, 0)
+        if c == 1:
+            raise FeatureCycleError(f"Feature cycle detected at {f.name!r}")
+        if c == 2:
+            return
+        color[f.uid] = 1
+        for p in f.parents:
+            dfs(p)
+        color[f.uid] = 2
+        post.append(f)
+
+    for rf in result_features:
+        dfs(rf)
+
+    dist: dict[str, int] = {rf.uid: 0 for rf in result_features}
+    for f in reversed(post):  # children before their parents
+        d = dist.get(f.uid, 0)
+        for p in f.parents:
+            dist[p.uid] = max(dist.get(p.uid, -1), d + 1)
+
+    out: dict = {}
+    for f in post:
+        if f.origin_stage is not None:
+            s = f.origin_stage
+            out[s] = max(out.get(s, -1), dist.get(f.uid, 0))
+    return out
+
+
+def topo_layers(result_features: Sequence[Feature]
+                ) -> List[List["PipelineStage"]]:
+    """Stages grouped into layers by decreasing distance from the results —
+    the fit/transform execution order (reference
+    FitStagesUtil.computeDAG:173)."""
+    dist = parent_stages(result_features)
+    if not dist:
+        return []
+    by_d: dict[int, list] = {}
+    for s, d in dist.items():
+        by_d.setdefault(d, []).append(s)
+    return [sorted(by_d[d], key=lambda s: s.uid)
+            for d in sorted(by_d, reverse=True)]

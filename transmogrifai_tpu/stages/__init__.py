@@ -1,0 +1,17 @@
+from .base import (BinaryEstimator, BinaryModel, BinarySequenceEstimator,
+                   BinarySequenceTransformer, BinaryTransformer, Estimator,
+                   LambdaTransformer, Model, PipelineStage,
+                   QuaternaryTransformer, SequenceEstimator, SequenceModel,
+                   SequenceTransformer, TernaryTransformer, Transformer,
+                   UnaryEstimator, UnaryModel, UnaryTransformer,
+                   register_stage_class, stage_class_by_name)
+
+__all__ = [
+    "PipelineStage", "Transformer", "Estimator", "Model",
+    "UnaryTransformer", "UnaryEstimator", "UnaryModel",
+    "BinaryTransformer", "BinaryEstimator", "BinaryModel",
+    "TernaryTransformer", "QuaternaryTransformer",
+    "SequenceTransformer", "SequenceEstimator", "SequenceModel",
+    "BinarySequenceTransformer", "BinarySequenceEstimator",
+    "LambdaTransformer", "register_stage_class", "stage_class_by_name",
+]

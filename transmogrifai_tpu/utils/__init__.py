@@ -1,0 +1,6 @@
+from .uid import uid, reset as reset_uids
+from .vector_meta import (NULL_INDICATOR, OTHER_INDICATOR,
+                          VectorColumnMetadata, VectorMetadata)
+
+__all__ = ["uid", "reset_uids", "VectorColumnMetadata", "VectorMetadata",
+           "NULL_INDICATOR", "OTHER_INDICATOR"]

@@ -1,0 +1,130 @@
+"""Streaming (Ben-Haim/Tom-Tov) histogram.
+
+TPU-native equivalent of the reference's single Java source file
+(utils/src/main/java/com/salesforce/op/utils/stats/StreamingHistogram.java:36),
+used by RawFeatureFilter for numeric feature distributions. This numpy
+implementation batches inserts (sort + merge) instead of the one-point-at-a-
+time Java loop; a C++ kernel backs the hot path when built (see
+native/streaming_histogram.cpp), with this as fallback.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["StreamingHistogram"]
+
+
+class StreamingHistogram:
+    """Fixed-size histogram of (centroid, count) bins supporting merge and
+    interpolated sum/quantile queries."""
+
+    def __init__(self, max_bins: int = 100):
+        if max_bins < 2:
+            raise ValueError("max_bins must be >= 2")
+        self.max_bins = max_bins
+        self.centroids = np.zeros(0, dtype=np.float64)
+        self.counts = np.zeros(0, dtype=np.float64)
+
+    # -- updates -----------------------------------------------------------
+    def update(self, points: Iterable[float],
+               counts: Optional[Iterable[float]] = None
+               ) -> "StreamingHistogram":
+        pts = np.asarray(list(points) if not isinstance(points, np.ndarray)
+                         else points, dtype=np.float64)
+        pts = pts[~np.isnan(pts)]
+        if pts.size == 0:
+            return self
+        cts = np.ones_like(pts) if counts is None else \
+            np.asarray(list(counts), dtype=np.float64)
+        # presort and collapse duplicates, then merge with existing bins
+        order = np.argsort(pts)
+        pts, cts = pts[order], cts[order]
+        uniq, inv = np.unique(pts, return_inverse=True)
+        agg = np.zeros_like(uniq)
+        np.add.at(agg, inv, cts)
+        self._merge_arrays(uniq, agg)
+        return self
+
+    def merge(self, other: "StreamingHistogram") -> "StreamingHistogram":
+        """Merge another histogram into this one (used to combine per-shard
+        histograms — the distributed reduction point)."""
+        self._merge_arrays(other.centroids, other.counts)
+        return self
+
+    def _merge_arrays(self, cents: np.ndarray, cnts: np.ndarray) -> None:
+        c = np.concatenate([self.centroids, cents])
+        n = np.concatenate([self.counts, cnts])
+        order = np.argsort(c)
+        c, n = c[order], n[order]
+        # repeatedly merge the closest pair until within max_bins
+        while c.size > self.max_bins:
+            gaps = np.diff(c)
+            i = int(np.argmin(gaps))
+            tot = n[i] + n[i + 1]
+            c[i] = (c[i] * n[i] + c[i + 1] * n[i + 1]) / tot
+            n[i] = tot
+            c = np.delete(c, i + 1)
+            n = np.delete(n, i + 1)
+        self.centroids, self.counts = c, n
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def total(self) -> float:
+        return float(self.counts.sum())
+
+    def bins(self) -> Tuple[np.ndarray, np.ndarray]:
+        return self.centroids.copy(), self.counts.copy()
+
+    def sum_upto(self, b: float) -> float:
+        """Estimated number of points <= b (StreamingHistogram.java sum())."""
+        c, n = self.centroids, self.counts
+        if c.size == 0:
+            return 0.0
+        if b >= c[-1]:
+            return float(n.sum())
+        if b < c[0]:
+            return 0.0
+        i = int(np.searchsorted(c, b, side="right")) - 1
+        if c.size == 1 or i == c.size - 1:
+            return float(n[:i].sum() + n[i] / 2.0)
+        # trapezoid interpolation between centroid i and i+1
+        ci, ci1, ni, ni1 = c[i], c[i + 1], n[i], n[i + 1]
+        frac = (b - ci) / (ci1 - ci) if ci1 > ci else 0.0
+        mb = ni + (ni1 - ni) * frac
+        s = (ni + mb) * frac / 2.0
+        return float(n[:i].sum() + ni / 2.0 + s)
+
+    def density(self, breakpoints: Sequence[float]) -> np.ndarray:
+        """Estimated counts falling in intervals defined by breakpoints."""
+        sums = np.asarray([self.sum_upto(b) for b in breakpoints])
+        return np.diff(np.concatenate([[0.0], sums, [self.total]]))
+
+    def quantile(self, q: float) -> float:
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("q must be in [0, 1]")
+        c, n = self.centroids, self.counts
+        if c.size == 0:
+            return float("nan")
+        target = q * n.sum()
+        cum = np.cumsum(n) - n / 2.0
+        i = int(np.searchsorted(cum, target))
+        if i == 0:
+            return float(c[0])
+        if i >= c.size:
+            return float(c[-1])
+        frac = (target - cum[i - 1]) / (cum[i] - cum[i - 1])
+        return float(c[i - 1] + (c[i] - c[i - 1]) * frac)
+
+    def to_json(self) -> dict:
+        return {"maxBins": self.max_bins,
+                "centroids": self.centroids.tolist(),
+                "counts": self.counts.tolist()}
+
+    @staticmethod
+    def from_json(d: dict) -> "StreamingHistogram":
+        h = StreamingHistogram(d["maxBins"])
+        h.centroids = np.asarray(d["centroids"], dtype=np.float64)
+        h.counts = np.asarray(d["counts"], dtype=np.float64)
+        return h
