@@ -7,7 +7,11 @@ Must set flags before jax initializes.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the environment pins JAX_PLATFORMS=axon (the real-TPU
+# tunnel, one chip, slow remote compiles) and a sitecustomize imports jax
+# at interpreter start — so we must both set the env var and update the
+# already-imported config to land on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +20,7 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np

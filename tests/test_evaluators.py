@@ -1,0 +1,123 @@
+"""Evaluator tests against hand-computed fixtures (mirrors the reference's
+evaluator suites, e.g. core/src/test/.../OpBinaryClassificationEvaluatorTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import (
+    BinaryClassificationEvaluator, BinScoreEvaluator, Evaluators,
+    MultiClassificationEvaluator, RegressionEvaluator, au_pr, au_roc,
+    binary_metrics, multiclass_metrics, regression_metrics)
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn, \
+    PredictionColumn
+from transmogrifai_tpu.types import RealNN
+
+
+Y = np.array([1, 0, 1, 1, 0], dtype=float)
+SCORE = np.array([0.9, 0.8, 0.7, 0.3, 0.2])
+PRED = (SCORE >= 0.5).astype(float)
+
+
+class TestBinary:
+    def test_confusion_and_point_metrics(self):
+        m = binary_metrics(Y, PRED, SCORE)
+        assert (m.TP, m.TN, m.FP, m.FN) == (2, 1, 1, 1)
+        assert m.Precision == pytest.approx(2 / 3)
+        assert m.Recall == pytest.approx(2 / 3)
+        assert m.F1 == pytest.approx(2 / 3)
+        assert m.Error == pytest.approx(0.4)
+
+    def test_au_roc_hand_computed(self):
+        # 4 of 6 (pos, neg) pairs correctly ranked
+        assert au_roc(Y, SCORE) == pytest.approx(4 / 6)
+
+    def test_au_pr_hand_computed(self):
+        # trapezoid over (0,1),(1/3,1),(1/3,.5),(2/3,2/3),(1,.75),(1,.6)
+        assert au_pr(Y, SCORE) == pytest.approx(55 / 72)
+
+    def test_perfect_ranking(self):
+        y = np.array([0, 0, 1, 1], dtype=float)
+        s = np.array([0.1, 0.2, 0.8, 0.9])
+        assert au_roc(y, s) == pytest.approx(1.0)
+        assert au_pr(y, s) == pytest.approx(1.0)
+
+    def test_tied_scores(self):
+        y = np.array([1, 0, 1, 0], dtype=float)
+        s = np.array([0.5, 0.5, 0.5, 0.5])
+        assert au_roc(y, s) == pytest.approx(0.5)
+
+    def test_evaluator_on_dataset(self):
+        prob = np.stack([1 - SCORE, SCORE], axis=1)
+        ds = Dataset({
+            "y": FeatureColumn.from_values(RealNN, Y.tolist()),
+            "pred": PredictionColumn.from_arrays(PRED, probability=prob),
+        })
+        ev = Evaluators.BinaryClassification.au_pr().set_columns("y", "pred")
+        assert ev.evaluate(ds) == pytest.approx(55 / 72)
+        assert ev.is_larger_better
+
+    def test_bin_score_evaluator(self):
+        prob = np.stack([1 - SCORE, SCORE], axis=1)
+        ds = Dataset({
+            "y": FeatureColumn.from_values(RealNN, Y.tolist()),
+            "pred": PredictionColumn.from_arrays(PRED, probability=prob),
+        })
+        ev = BinScoreEvaluator(num_bins=4).set_columns("y", "pred")
+        m = ev.evaluate_all(ds)
+        assert sum(m.NumberOfDataPoints) == 5
+        brier = np.mean((SCORE - Y) ** 2)
+        assert m.BrierScore == pytest.approx(brier)
+
+
+class TestMulticlass:
+    def test_weighted_prf(self):
+        y = np.array([0, 1, 2, 1], dtype=float)
+        pred = np.array([0, 2, 2, 1], dtype=float)
+        m = multiclass_metrics(y, pred)
+        assert m.Precision == pytest.approx(0.875)
+        assert m.Recall == pytest.approx(0.75)
+        assert m.F1 == pytest.approx(0.75)
+        assert m.Error == pytest.approx(0.25)
+
+    def test_threshold_metrics(self):
+        y = np.array([0, 1, 2], dtype=float)
+        prob = np.array([[0.9, 0.05, 0.05],
+                         [0.2, 0.5, 0.3],
+                         [0.4, 0.35, 0.25]])
+        pred = prob.argmax(axis=1).astype(float)
+        m = multiclass_metrics(y, pred, prob, top_ns=(1, 2), n_bins=2)
+        tm = m.ThresholdMetrics
+        assert tm.topNs == [1, 2]
+        # at threshold 0: top-1 correct for rows 0,1; row 2 incorrect
+        assert tm.correct_counts[1][0] == 2
+        assert tm.incorrect_counts[1][0] == 1
+        # top-2 catches row 2's true label (2nd highest prob is class 1...no:
+        # row2 probs: argsort desc = [0, 1, 2]; top-2 = {0, 1}, label 2 not in
+        assert tm.correct_counts[2][0] == 2
+        # at threshold 0.5: only rows 0 (0.9) and 1 (0.5) have conf >= 0.5
+        assert tm.no_prediction_counts[1][1] == 1
+
+
+class TestRegression:
+    def test_hand_computed(self):
+        m = regression_metrics(np.array([1.0, 2, 3]), np.array([2.0, 2, 2]))
+        assert m.MeanSquaredError == pytest.approx(2 / 3)
+        assert m.RootMeanSquaredError == pytest.approx(np.sqrt(2 / 3))
+        assert m.MeanAbsoluteError == pytest.approx(2 / 3)
+        assert m.R2 == pytest.approx(0.0)
+
+    def test_perfect_fit(self):
+        m = regression_metrics(np.array([1.0, 2, 3]), np.array([1.0, 2, 3]))
+        assert m.RootMeanSquaredError == 0.0
+        assert m.R2 == pytest.approx(1.0)
+
+    def test_evaluator_direction(self):
+        assert not Evaluators.Regression.rmse().is_larger_better
+        assert Evaluators.Regression.r2().is_larger_better
+
+
+def test_metrics_to_json_roundtrippable():
+    import json
+    m = binary_metrics(Y, PRED, SCORE, record_curves=True)
+    d = m.to_json()
+    json.dumps(d)  # must be JSON-serializable
+    assert d["AuPR"] == pytest.approx(55 / 72)

@@ -85,9 +85,30 @@ class TestDag:
         y = _raw("y", RealNN, response=True)
         z = y.transform_with(Plus1())
         assert z.is_response
+        # a feature derived from label + predictor is still a response:
+        # it must never leak back into the predictor matrix
         x = _raw("x")
         w = x.transform_with(Add(), y)
-        assert not w.is_response
+        assert w.is_response
+
+    def test_allow_label_as_input(self):
+        from transmogrifai_tpu.stages.base import AllowLabelAsInput
+
+        class LabelAwareAdd(AllowLabelAsInput, Add):
+            pass
+
+        y = _raw("y", RealNN, response=True)
+        x = _raw("x")
+        w = x.transform_with(LabelAwareAdd(), y)
+        assert not w.is_response  # label-aware stages emit predictors
+        z = y.transform_with(LabelAwareAdd(), _raw("y2", RealNN, response=True))
+        assert z.is_response  # ... unless every input is a response
+
+    def test_get_output_idempotent(self):
+        a = _raw("a")
+        p = Plus1().set_input(a)
+        f1, f2 = p.get_output(), p.get_output()
+        assert f1 is f2 and f1.uid == f2.uid
 
     def test_copy_with_new_stages(self):
         a = _raw("a")

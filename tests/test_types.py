@@ -44,7 +44,8 @@ class TestText:
     def test_text(self):
         assert T.Text("abc").value == "abc"
         assert T.Text(None).is_empty
-        assert T.Text("").is_empty
+        # reference semantics: Text(Some("")) is non-empty (Text.scala:48)
+        assert not T.Text("").is_empty
 
     def test_email_parts(self):
         e = T.Email("joe@example.com")

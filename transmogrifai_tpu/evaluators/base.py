@@ -1,0 +1,126 @@
+"""Evaluator bases and metric containers.
+
+TPU-native port of the reference evaluator kernel
+(core/src/main/scala/com/salesforce/op/evaluators/OpEvaluatorBase.scala:113,
+EvaluationMetrics.scala). Evaluators consume dense label / prediction
+arrays (the columnar ``PredictionColumn``) instead of Spark DataFrames;
+every metric container serializes to a flat JSON dict for
+``ModelSelectorSummary`` and saved metrics files.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..features.columns import Dataset, PredictionColumn
+
+__all__ = ["EvaluationMetrics", "Evaluator", "SingleMetric", "MultiMetrics"]
+
+
+@dataclass
+class EvaluationMetrics:
+    """Base metric record (reference EvaluationMetrics.scala)."""
+
+    def to_json(self) -> Dict[str, Any]:
+        def conv(v):
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            if isinstance(v, (np.floating, np.integer)):
+                return v.item()
+            if isinstance(v, EvaluationMetrics):
+                return v.to_json()
+            if isinstance(v, dict):
+                return {k: conv(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [conv(x) for x in v]
+            return v
+        return {f.name: conv(getattr(self, f.name))
+                for f in dataclasses.fields(self)}
+
+    def to_map(self) -> Dict[str, Any]:
+        return self.to_json()
+
+
+@dataclass
+class SingleMetric(EvaluationMetrics):
+    """One named metric value (reference SingleMetric)."""
+    name: str
+    value: float
+
+
+@dataclass
+class MultiMetrics(EvaluationMetrics):
+    """Named collection of metric records (reference MultiMetrics)."""
+    metrics: Dict[str, EvaluationMetrics]
+
+
+class Evaluator:
+    """Base evaluator (reference OpEvaluatorBase.scala:113).
+
+    ``evaluate_arrays`` is the kernel: label vector + prediction column in,
+    metrics record out. ``evaluate`` / ``evaluate_all`` adapt a Dataset by
+    column name.
+    """
+
+    #: name of the default metric returned by ``evaluate``
+    default_metric: str = ""
+    is_larger_better: bool = True
+
+    def __init__(self, label_col: Optional[str] = None,
+                 prediction_col: Optional[str] = None):
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+
+    # -- kernel ------------------------------------------------------------
+    def evaluate_arrays(self, y: np.ndarray, pred: PredictionColumn
+                        ) -> EvaluationMetrics:
+        raise NotImplementedError
+
+    # -- dataset adapters --------------------------------------------------
+    def _extract(self, ds: Dataset):
+        y = np.asarray(ds[self.label_col].data, dtype=np.float64)
+        col = ds[self.prediction_col]
+        if not isinstance(col, PredictionColumn):
+            # object column of Prediction dicts (slow edge path)
+            pred = np.asarray([d["prediction"] for d in col.data])
+            n_prob = n_raw = 0
+            for d in col.data:
+                for k in d:
+                    if k.startswith("probability_"):
+                        n_prob = max(n_prob, int(k.rsplit("_", 1)[1]) + 1)
+                    elif k.startswith("rawPrediction_"):
+                        n_raw = max(n_raw, int(k.rsplit("_", 1)[1]) + 1)
+            prob = np.zeros((len(pred), n_prob))
+            raw = np.zeros((len(pred), n_raw))
+            for i, d in enumerate(col.data):
+                for k, v in d.items():
+                    if k.startswith("probability_"):
+                        prob[i, int(k.rsplit("_", 1)[1])] = v
+                    elif k.startswith("rawPrediction_"):
+                        raw[i, int(k.rsplit("_", 1)[1])] = v
+            col = PredictionColumn.from_arrays(pred, probability=prob,
+                                               raw_prediction=raw)
+        return y, col
+
+    def evaluate_all(self, ds: Dataset) -> EvaluationMetrics:
+        y, pred = self._extract(ds)
+        return self.evaluate_arrays(y, pred)
+
+    def evaluate(self, ds: Dataset) -> float:
+        metrics = self.evaluate_all(ds)
+        return float(getattr(metrics, self.default_metric))
+
+    def metric_from(self, metrics: EvaluationMetrics) -> float:
+        return float(getattr(metrics, self.default_metric))
+
+    def set_columns(self, label_col: str, prediction_col: str) -> "Evaluator":
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        return self
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__

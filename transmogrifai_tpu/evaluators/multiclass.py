@@ -1,0 +1,129 @@
+"""Multiclass classification metrics.
+
+TPU-native port of the reference OpMultiClassificationEvaluator
+(core/src/main/scala/com/salesforce/op/evaluators/
+OpMultiClassificationEvaluator.scala:58,268,294): weighted
+precision/recall/F1/error plus ``ThresholdMetrics`` — per top-N,
+per-confidence-bin correct/incorrect counts used to study how model
+confidence relates to top-N accuracy.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..features.columns import PredictionColumn
+from .base import EvaluationMetrics, Evaluator
+
+__all__ = ["MultiClassificationMetrics", "ThresholdMetrics",
+           "MultiClassificationEvaluator", "multiclass_metrics"]
+
+
+@dataclass
+class ThresholdMetrics(EvaluationMetrics):
+    """Per-topN, per-confidence-bin counts
+    (reference OpMultiClassificationEvaluator.scala:294)."""
+    topNs: List[int] = field(default_factory=list)
+    thresholds: List[float] = field(default_factory=list)
+    correct_counts: Dict[int, List[int]] = field(default_factory=dict)
+    incorrect_counts: Dict[int, List[int]] = field(default_factory=dict)
+    no_prediction_counts: Dict[int, List[int]] = field(default_factory=dict)
+
+
+@dataclass
+class MultiClassificationMetrics(EvaluationMetrics):
+    """Reference OpMultiClassificationEvaluator metrics (``:58``).
+    Precision/Recall/F1 are label-frequency weighted, matching Spark's
+    MulticlassMetrics weighted variants."""
+    Precision: float = 0.0
+    Recall: float = 0.0
+    F1: float = 0.0
+    Error: float = 0.0
+    ThresholdMetrics: Optional[ThresholdMetrics] = None
+
+
+def _weighted_prf(y: np.ndarray, pred: np.ndarray
+                  ) -> Tuple[float, float, float]:
+    labels = np.unique(y)
+    n = len(y)
+    w_p = w_r = w_f = 0.0
+    for lbl in labels:
+        weight = float(np.sum(y == lbl)) / n
+        tp = float(np.sum((pred == lbl) & (y == lbl)))
+        fp = float(np.sum((pred == lbl) & (y != lbl)))
+        fn = float(np.sum((pred != lbl) & (y == lbl)))
+        p = tp / (tp + fp) if tp + fp > 0 else 0.0
+        r = tp / (tp + fn) if tp + fn > 0 else 0.0
+        f = 2 * p * r / (p + r) if p + r > 0 else 0.0
+        w_p += weight * p
+        w_r += weight * r
+        w_f += weight * f
+    return w_p, w_r, w_f
+
+
+def threshold_metrics(y: np.ndarray, prob: np.ndarray,
+                      top_ns: Sequence[int] = (1, 3),
+                      n_bins: int = 10) -> ThresholdMetrics:
+    """For each topN and max-confidence threshold bin: counts of rows whose
+    true label is within the top-N most-probable classes (correct), isn't
+    (incorrect), or whose max confidence falls below the threshold
+    (no prediction). Reference ``:268``."""
+    thresholds = np.linspace(0.0, 1.0, n_bins, endpoint=False)
+    max_conf = prob.max(axis=1) if prob.size else np.zeros(len(y))
+    order = np.argsort(-prob, axis=1) if prob.size else \
+        np.zeros((len(y), 1), dtype=int)
+    correct: Dict[int, List[int]] = {}
+    incorrect: Dict[int, List[int]] = {}
+    nopred: Dict[int, List[int]] = {}
+    for top_n in top_ns:
+        in_top = np.any(order[:, :top_n] == y[:, None].astype(int), axis=1)
+        c, i, np_ = [], [], []
+        for t in thresholds:
+            above = max_conf >= t
+            c.append(int(np.sum(above & in_top)))
+            i.append(int(np.sum(above & ~in_top)))
+            np_.append(int(np.sum(~above)))
+        correct[top_n], incorrect[top_n], nopred[top_n] = c, i, np_
+    return ThresholdMetrics(
+        topNs=list(top_ns), thresholds=thresholds.tolist(),
+        correct_counts=correct, incorrect_counts=incorrect,
+        no_prediction_counts=nopred)
+
+
+def multiclass_metrics(y: np.ndarray, pred: np.ndarray,
+                       prob: Optional[np.ndarray] = None,
+                       top_ns: Sequence[int] = (1, 3),
+                       n_bins: int = 10) -> MultiClassificationMetrics:
+    y = np.asarray(y, dtype=np.float64)
+    pred = np.asarray(pred, dtype=np.float64)
+    p, r, f1 = _weighted_prf(y, pred)
+    err = float(np.mean(pred != y)) if len(y) else 0.0
+    tm = (threshold_metrics(y, prob, top_ns, n_bins)
+          if prob is not None and prob.size else None)
+    return MultiClassificationMetrics(Precision=p, Recall=r, F1=f1,
+                                      Error=err, ThresholdMetrics=tm)
+
+
+class MultiClassificationEvaluator(Evaluator):
+    """Reference OpMultiClassificationEvaluator.scala:58."""
+
+    default_metric = "F1"
+    is_larger_better = True
+
+    def __init__(self, label_col: Optional[str] = None,
+                 prediction_col: Optional[str] = None,
+                 default_metric: str = "F1",
+                 top_ns: Sequence[int] = (1, 3), n_bins: int = 10):
+        super().__init__(label_col, prediction_col)
+        self.default_metric = default_metric
+        self.is_larger_better = default_metric != "Error"
+        self.top_ns = tuple(top_ns)
+        self.n_bins = n_bins
+
+    def evaluate_arrays(self, y: np.ndarray, pred: PredictionColumn
+                        ) -> MultiClassificationMetrics:
+        prob = pred.probability if pred.probability.shape[1] else None
+        return multiclass_metrics(y, pred.data, prob,
+                                  top_ns=self.top_ns, n_bins=self.n_bins)

@@ -82,15 +82,32 @@ class _FeatureBuilderMeta(type):
 
     _lookup: Optional[Dict[str, Type[FeatureType]]] = None
 
+    _lookup_size: int = -1  # registry size when _lookup was built
+
+    @staticmethod
+    def _rebuild_lookup() -> Dict[str, Type[FeatureType]]:
+        from ..types import all_feature_types
+        types = all_feature_types()
+        lk: Dict[str, Type[FeatureType]] = {}
+        for ft in types:
+            lk[_snake(ft.__name__)] = ft
+            lk[ft.__name__.lower()] = ft
+        _FeatureBuilderMeta._lookup = lk
+        _FeatureBuilderMeta._lookup_size = len(types)
+        return lk
+
     def __getattr__(cls, item: str):
-        if _FeatureBuilderMeta._lookup is None:
+        lk = _FeatureBuilderMeta._lookup
+        if lk is None:
+            lk = _FeatureBuilderMeta._rebuild_lookup()
+        ftype = lk.get(item.lower())
+        if ftype is None:
+            # user-registered feature types may have landed since the cache
+            # was built; rebuild only if the registry actually grew (misses
+            # on an unchanged registry — hasattr probes, typos — stay cheap)
             from ..types import all_feature_types
-            lk: Dict[str, Type[FeatureType]] = {}
-            for ft in all_feature_types():
-                lk[_snake(ft.__name__)] = ft
-                lk[ft.__name__.lower()] = ft
-            _FeatureBuilderMeta._lookup = lk
-        ftype = _FeatureBuilderMeta._lookup.get(item.lower())
+            if len(all_feature_types()) != _FeatureBuilderMeta._lookup_size:
+                ftype = _FeatureBuilderMeta._rebuild_lookup().get(item.lower())
         if ftype is None:
             raise AttributeError(f"FeatureBuilder has no builder {item!r}")
         return lambda name: _FeatureBuilderFor(name, ftype)

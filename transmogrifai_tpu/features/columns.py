@@ -30,7 +30,8 @@ from ..types.maps import BinaryMap, IntegralMap, MultiPickListMap, NumericMap, \
     GeolocationMap, TextMap
 from ..utils.vector_meta import VectorMetadata
 
-__all__ = ["FeatureColumn", "Dataset", "column_kind", "ColumnKind"]
+__all__ = ["FeatureColumn", "PredictionColumn", "Dataset", "column_kind",
+           "ColumnKind"]
 
 
 class ColumnKind:
@@ -128,7 +129,8 @@ class FeatureColumn:
         if k == ColumnKind.NUMERIC:
             return np.isnan(self.data)
         if k == ColumnKind.TEXT:
-            return np.asarray([v is None or v == "" for v in self.data])
+            # empty string is *present* (reference: Text(Some("")) non-empty)
+            return np.asarray([v is None for v in self.data])
         if k == ColumnKind.VECTOR:
             return np.zeros(self.n_rows, dtype=bool)
         return np.asarray([v is None or len(v) == 0 for v in self.data])
@@ -138,6 +140,52 @@ class FeatureColumn:
 
     def __len__(self) -> int:
         return self.n_rows
+
+
+@dataclass
+class PredictionColumn(FeatureColumn):
+    """Columnar batch of ``Prediction`` values.
+
+    The reference materializes one ``Prediction`` map per row
+    (Maps.scala:302); on TPU the model outputs stay dense: ``data`` holds
+    the (n,) predicted values and ``probability`` / ``raw_prediction`` the
+    (n, k) per-class arrays (k = 0 when absent). Boxed ``Prediction`` dicts
+    are synthesized only at the row-level scoring edge."""
+    probability: np.ndarray = field(
+        default_factory=lambda: np.zeros((0, 0), dtype=np.float64))
+    raw_prediction: np.ndarray = field(
+        default_factory=lambda: np.zeros((0, 0), dtype=np.float64))
+
+    @staticmethod
+    def from_arrays(prediction: np.ndarray,
+                    probability: Optional[np.ndarray] = None,
+                    raw_prediction: Optional[np.ndarray] = None
+                    ) -> "PredictionColumn":
+        pred = np.asarray(prediction, dtype=np.float64).reshape(-1)
+        n = pred.shape[0]
+        prob = (np.zeros((n, 0)) if probability is None
+                else np.asarray(probability, dtype=np.float64).reshape(n, -1))
+        raw = (np.zeros((n, 0)) if raw_prediction is None
+               else np.asarray(raw_prediction, dtype=np.float64).reshape(n, -1))
+        return PredictionColumn(ftype=Prediction, data=pred,
+                                probability=prob, raw_prediction=raw)
+
+    def boxed(self, i: int) -> Prediction:
+        return Prediction.build(
+            float(self.data[i]),
+            raw_prediction=self.raw_prediction[i]
+            if self.raw_prediction.shape[1] else None,
+            probability=self.probability[i]
+            if self.probability.shape[1] else None)
+
+    def is_missing(self) -> np.ndarray:
+        return np.zeros(self.n_rows, dtype=bool)
+
+    def take(self, idx: np.ndarray) -> "PredictionColumn":
+        return PredictionColumn(
+            ftype=self.ftype, data=self.data[idx], metadata=self.metadata,
+            probability=self.probability[idx],
+            raw_prediction=self.raw_prediction[idx])
 
 
 class Dataset:

@@ -37,9 +37,11 @@ class FeatureGeneratorStage(PipelineStage, _ZeroInput):
         self.aggregate_window_ms = aggregate_window_ms
 
     def get_output(self) -> Feature:
-        return Feature(name=self.name, ftype=self.ftype,
-                       is_response=self.is_response, origin_stage=self,
-                       parents=())
+        if self._output_feature is None:
+            self._output_feature = Feature(
+                name=self.name, ftype=self.ftype,
+                is_response=self.is_response, origin_stage=self, parents=())
+        return self._output_feature
 
     def extract_column(self, records) -> FeatureColumn:
         """Apply the extract function over records into a column."""

@@ -40,6 +40,7 @@ __all__ = [
     "SequenceTransformer", "SequenceEstimator", "SequenceModel",
     "BinarySequenceTransformer", "BinarySequenceEstimator",
     "LambdaTransformer", "stage_class_by_name", "register_stage_class",
+    "AllowLabelAsInput",
 ]
 
 _STAGE_REGISTRY: Dict[str, type] = {}
@@ -83,6 +84,7 @@ class PipelineStage:
         self.operation_name = operation_name or type(self).__name__
         self.uid = uid or make_uid(type(self))
         self.input_features: Tuple[Feature, ...] = ()
+        self._output_feature: Optional["Feature"] = None
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -126,6 +128,7 @@ class PipelineStage:
         self._check_input_types(features)
         self.check_input_constraints(features)
         self.input_features = tuple(features)
+        self._output_feature = None  # re-wiring invalidates the output
         return self
 
     def _check_input_types(self, features: Sequence[Feature]) -> None:
@@ -158,8 +161,13 @@ class PipelineStage:
 
     # -- output ------------------------------------------------------------
     def output_is_response(self) -> bool:
+        """A feature derived from any response is itself a response, so it
+        can never silently re-enter the predictor matrix (label-leakage
+        guard; reference OpPipelineStages.scala:56 `exists(_.isResponse)`).
+        Stages that legitimately consume the label to produce predictors
+        (e.g. SanityChecker) mix in ``AllowLabelAsInput``."""
         return (len(self.input_features) > 0
-                and all(f.is_response for f in self.input_features))
+                and any(f.is_response for f in self.input_features))
 
     def output_feature_name(self) -> str:
         names = [f.name for f in self.input_features]
@@ -170,18 +178,23 @@ class PipelineStage:
             else f"{self.operation_name}_{suffix}"
 
     def get_output(self) -> "Feature":
-        """Create the (lazy) output feature (reference getOutput)."""
+        """The (lazy) output feature (reference getOutput). Idempotent:
+        repeated calls return the same Feature (same uid) until the stage
+        is re-wired with ``set_input``."""
         from ..features.feature import Feature
         if self.input_features == () and not isinstance(self, _ZeroInput):
             raise ValueError(
                 f"{type(self).__name__}.get_output() before set_input()")
-        return Feature(
+        if self._output_feature is not None:
+            return self._output_feature
+        self._output_feature = Feature(
             name=self.output_feature_name(),
             ftype=self.output_type,
             is_response=self.output_is_response(),
             origin_stage=self,
             parents=self.input_features,
         )
+        return self._output_feature
 
     # -- persistence -------------------------------------------------------
     def stage_name(self) -> str:
@@ -200,6 +213,17 @@ class PipelineStage:
 
 class _ZeroInput:
     """Marker for stages with no inputs (feature generators)."""
+
+
+class AllowLabelAsInput:
+    """Mixin for stages allowed to consume the label while producing
+    predictor outputs (reference AllowLabelAsInput; used by SanityChecker,
+    DecisionTreeNumericBucketizer, ModelSelector etc.). Output is a
+    response only if *every* input is."""
+
+    def output_is_response(self) -> bool:
+        return (len(self.input_features) > 0
+                and all(f.is_response for f in self.input_features))
 
 
 class Transformer(PipelineStage):

@@ -286,6 +286,16 @@ class Prediction(NonNullable, RealMap):
             raise FeatureTypeError(
                 "Prediction must contain a 'prediction' key; got keys "
                 f"{sorted(out)}")
+        for k in out:
+            if k == cls.KEY_PREDICTION:
+                continue
+            prefix, _, suffix = k.rpartition("_")
+            if prefix not in (cls.KEY_RAW, cls.KEY_PROB) \
+                    or not suffix.isdigit():
+                raise FeatureTypeError(
+                    f"Prediction contains invalid key {k!r}; allowed: "
+                    "'prediction', 'rawPrediction_<i>', 'probability_<i>' "
+                    "(reference Maps.scala:302-357)")
         return out
 
     @classmethod

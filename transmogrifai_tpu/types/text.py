@@ -17,7 +17,12 @@ __all__ = ["Text", "Email", "Base64", "Phone", "ID", "URL", "TextArea",
 
 @register_feature_type
 class Text(FeatureType):
-    """Optional string (reference Text.scala:48)."""
+    """Optional string (reference Text.scala:48).
+
+    Matching the reference, ``Text(Some(""))`` is *non-empty*: only ``None``
+    encodes a missing value, so fill rates and null indicators treat the
+    empty string as present.
+    """
     __slots__ = ()
 
     @classmethod
@@ -27,6 +32,10 @@ class Text(FeatureType):
         if isinstance(value, str):
             return value
         raise FeatureTypeError(f"Cannot convert {value!r} to {cls.__name__}")
+
+    @property
+    def is_empty(self) -> bool:
+        return self._value is None
 
 
 @register_feature_type

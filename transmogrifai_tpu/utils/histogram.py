@@ -4,8 +4,7 @@ TPU-native equivalent of the reference's single Java source file
 (utils/src/main/java/com/salesforce/op/utils/stats/StreamingHistogram.java:36),
 used by RawFeatureFilter for numeric feature distributions. This numpy
 implementation batches inserts (sort + merge) instead of the one-point-at-a-
-time Java loop; a C++ kernel backs the hot path when built (see
-native/streaming_histogram.cpp), with this as fallback.
+time Java loop.
 """
 from __future__ import annotations
 
@@ -33,11 +32,15 @@ class StreamingHistogram:
                ) -> "StreamingHistogram":
         pts = np.asarray(list(points) if not isinstance(points, np.ndarray)
                          else points, dtype=np.float64)
-        pts = pts[~np.isnan(pts)]
-        if pts.size == 0:
-            return self
         cts = np.ones_like(pts) if counts is None else \
             np.asarray(list(counts), dtype=np.float64)
+        if cts.shape != pts.shape:
+            raise ValueError(
+                f"counts shape {cts.shape} != points shape {pts.shape}")
+        keep = ~np.isnan(pts)  # drop NaN points and their counts together
+        pts, cts = pts[keep], cts[keep]
+        if pts.size == 0:
+            return self
         # presort and collapse duplicates, then merge with existing bins
         order = np.argsort(pts)
         pts, cts = pts[order], cts[order]

@@ -62,6 +62,8 @@ def correlation_matrix(X, w: Optional[np.ndarray] = None) -> np.ndarray:
     wsum = jnp.sum(w)
     mean = (w @ X) / wsum
     Xc = (X - mean) * jnp.sqrt(w)[:, None]
+    # population normalization; the 1/wsum factor cancels in corr = cov/sd²,
+    # so this matches col_stats' sample variance convention for correlations
     cov = (Xc.T @ Xc) / wsum
     sd = jnp.sqrt(jnp.diag(cov))
     denom = jnp.outer(sd, sd)
