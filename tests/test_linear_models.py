@@ -1,0 +1,140 @@
+"""Linear model family tests (reference analogues:
+core/src/test/.../OpLogisticRegressionTest.scala, OpLinearRegressionTest.scala,
+OpLinearSVCTest.scala)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import (Dataset, FeatureColumn,
+                                                PredictionColumn)
+from transmogrifai_tpu.models import (LinearRegression, LinearSVC,
+                                      LogisticRegression)
+from transmogrifai_tpu.types import OPVector, RealNN
+from transmogrifai_tpu.utils.vector_meta import (VectorColumnMetadata,
+                                                 VectorMetadata)
+
+
+def _binary_data(rng, n=400, d=5):
+    X = rng.normal(size=(n, d))
+    w = np.arange(1, d + 1, dtype=float)
+    logits = X @ w - 0.5
+    y = (logits + rng.logistic(size=n) > 0).astype(float)
+    return X, y
+
+
+def _features():
+    y = FeatureBuilder.real_nn("label").extract(
+        lambda r: r["label"]).as_response()
+    x = FeatureBuilder.op_vector("feats").extract(
+        lambda r: r["feats"]).as_predictor()
+    return y, x
+
+
+class TestLogisticRegression:
+    def test_separable_accuracy(self, rng):
+        X, y = _binary_data(rng)
+        model = LogisticRegression(max_iter=80).fit_arrays(X, y)
+        pred = model.predict_arrays(X)
+        acc = np.mean(pred.data == y)
+        assert acc > 0.85
+        # probabilities are calibrated-ish and complementary
+        assert np.allclose(pred.probability.sum(axis=1), 1.0, atol=1e-6)
+        assert pred.raw_prediction.shape == (len(y), 2)
+
+    def test_regularization_shrinks(self, rng):
+        X, y = _binary_data(rng)
+        m0 = LogisticRegression(reg_param=0.0).fit_arrays(X, y)
+        m1 = LogisticRegression(reg_param=10.0).fit_arrays(X, y)
+        assert np.linalg.norm(m1.coefficients) < np.linalg.norm(m0.coefficients)
+
+    def test_l1_sparsifies(self, rng):
+        n = 300
+        X = rng.normal(size=(n, 6))
+        y = (X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=n) > 0).astype(float)
+        m = LogisticRegression(reg_param=0.3, elastic_net_param=1.0,
+                               max_iter=200).fit_arrays(X, y)
+        assert np.sum(np.abs(m.coefficients) < 1e-5) >= 2
+
+    def test_multinomial(self, rng):
+        n = 600
+        X = rng.normal(size=(n, 4))
+        centers = np.array([[2, 0, 0, 0], [-2, 2, 0, 0], [0, -2, 2, 0]])
+        y = rng.integers(0, 3, size=n).astype(float)
+        X = X + centers[y.astype(int)]
+        m = LogisticRegression(max_iter=60).fit_arrays(X, y)
+        pred = m.predict_arrays(X)
+        assert np.mean(pred.data == y) > 0.8
+        assert pred.probability.shape == (n, 3)
+
+    def test_stage_wiring_and_value_path(self, rng):
+        X, y = _binary_data(rng, n=100, d=3)
+        label, feats = _features()
+        est = LogisticRegression().set_input(label, feats)
+        out = est.get_output()
+        assert out.is_response  # prediction derived from label is response
+        meta = VectorMetadata("feats", tuple(
+            VectorColumnMetadata("f", "Real") for _ in range(3)))
+        ds = Dataset({
+            "label": FeatureColumn.from_values(RealNN, list(y)),
+            "feats": FeatureColumn.vector(X, meta)})
+        model = est.fit(ds)
+        assert model.uid == est.uid
+        assert model.vector_metadata is meta
+        scored = model.transform_dataset(ds)
+        pcol = scored[out.name]
+        assert isinstance(pcol, PredictionColumn)
+        # row path == batch path
+        boxed = model.transform_value(RealNN(1.0), OPVector(X[0]))
+        assert boxed["prediction"] == pcol.data[0]
+
+    def test_response_constraint_enforced(self):
+        # label wired as predictor -> CheckIsResponseValues must reject
+        not_response = FeatureBuilder.real_nn("y").extract(
+            lambda r: r["y"]).as_predictor()
+        feats = FeatureBuilder.op_vector("feats").extract(
+            lambda r: r["feats"]).as_predictor()
+        with pytest.raises(ValueError):
+            LogisticRegression().set_input(not_response, feats)
+
+
+class TestLinearRegression:
+    def test_exact_recovery(self, rng):
+        X = rng.normal(size=(200, 4))
+        w = np.array([1.0, -2.0, 3.0, 0.5])
+        y = X @ w + 1.5
+        m = LinearRegression().fit_arrays(X, y)
+        assert np.allclose(m.coefficients, w, atol=1e-4)
+        assert abs(m.intercept - 1.5) < 1e-4
+
+    def test_ridge_matches_closed_form(self, rng):
+        X = rng.normal(size=(150, 3))
+        y = X @ np.array([2.0, 0.0, -1.0]) + rng.normal(size=150) * 0.1
+        reg = 0.5
+        m = LinearRegression(reg_param=reg, standardization=False,
+                             fit_intercept=False).fit_arrays(X, y)
+        n = len(y)
+        w_exact = np.linalg.solve(X.T @ X / n + reg * np.eye(3), X.T @ y / n)
+        assert np.allclose(m.coefficients, w_exact, atol=1e-5)
+
+    def test_lasso_sparsifies(self, rng):
+        X = rng.normal(size=(200, 6))
+        y = X[:, 0] * 3.0 + rng.normal(size=200) * 0.05
+        m = LinearRegression(reg_param=0.5, elastic_net_param=1.0,
+                             max_iter=300).fit_arrays(X, y)
+        assert np.sum(np.abs(m.coefficients) < 1e-4) >= 4
+        assert abs(m.coefficients[0]) > 1.0
+
+
+class TestLinearSVC:
+    def test_separates(self, rng):
+        X, y = _binary_data(rng)
+        m = LinearSVC(reg_param=0.01).fit_arrays(X, y)
+        pred = m.predict_arrays(X)
+        assert np.mean(pred.data == y) > 0.85
+        assert pred.probability.shape[1] == 0  # no probability, as in MLlib
+
+    def test_grid_copy(self):
+        est = LinearSVC(reg_param=0.1)
+        est2 = est.with_params(reg_param=0.7)
+        assert est2.reg_param == 0.7 and est.reg_param == 0.1
+        assert est2.uid != est.uid

@@ -84,23 +84,18 @@ class Evaluator:
         y = np.asarray(ds[self.label_col].data, dtype=np.float64)
         col = ds[self.prediction_col]
         if not isinstance(col, PredictionColumn):
-            # object column of Prediction dicts (slow edge path)
-            pred = np.asarray([d["prediction"] for d in col.data])
-            n_prob = n_raw = 0
-            for d in col.data:
-                for k in d:
-                    if k.startswith("probability_"):
-                        n_prob = max(n_prob, int(k.rsplit("_", 1)[1]) + 1)
-                    elif k.startswith("rawPrediction_"):
-                        n_raw = max(n_raw, int(k.rsplit("_", 1)[1]) + 1)
+            # object column of Prediction dicts (slow edge path); key
+            # parsing is owned by Prediction (types/maps.py)
+            from ..types import Prediction
+            boxed = [Prediction(d) for d in col.data]
+            pred = np.asarray([p.prediction for p in boxed])
+            n_prob = max((len(p.probability) for p in boxed), default=0)
+            n_raw = max((len(p.raw_prediction) for p in boxed), default=0)
             prob = np.zeros((len(pred), n_prob))
             raw = np.zeros((len(pred), n_raw))
-            for i, d in enumerate(col.data):
-                for k, v in d.items():
-                    if k.startswith("probability_"):
-                        prob[i, int(k.rsplit("_", 1)[1])] = v
-                    elif k.startswith("rawPrediction_"):
-                        raw[i, int(k.rsplit("_", 1)[1])] = v
+            for i, p in enumerate(boxed):
+                prob[i, :len(p.probability)] = p.probability
+                raw[i, :len(p.raw_prediction)] = p.raw_prediction
             col = PredictionColumn.from_arrays(pred, probability=prob,
                                                raw_prediction=raw)
         return y, col

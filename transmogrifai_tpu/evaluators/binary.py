@@ -38,12 +38,16 @@ def positive_class_score(pred: PredictionColumn) -> Optional[np.ndarray]:
 
 
 def _curve_points(y: np.ndarray, score: np.ndarray
-                  ) -> Tuple[np.ndarray, np.ndarray, float, float]:
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, float, float]:
     """Cumulative TP/FP at each distinct score threshold (descending).
 
-    Returns (tp, fp, n_pos, n_neg) where tp[i]/fp[i] are counts predicted
-    positive at threshold = i-th distinct score.
+    Returns (thresholds, tp, fp, n_pos, n_neg) where tp[i]/fp[i] are counts
+    predicted positive at threshold = thresholds[i] (the i-th distinct
+    score). All curve arrays are empty for empty input.
     """
+    if len(score) == 0:
+        z = np.zeros(0, dtype=np.float64)
+        return z, z, z, 0.0, 0.0
     order = np.argsort(-score, kind="stable")
     y_sorted = y[order]
     s_sorted = score[order]
@@ -51,14 +55,15 @@ def _curve_points(y: np.ndarray, score: np.ndarray
     fp_cum = np.cumsum(y_sorted != 1)
     # last index of each distinct-score run
     last = np.r_[np.nonzero(np.diff(s_sorted))[0], len(s_sorted) - 1]
-    return (tp_cum[last].astype(np.float64), fp_cum[last].astype(np.float64),
+    return (s_sorted[last], tp_cum[last].astype(np.float64),
+            fp_cum[last].astype(np.float64),
             float(np.sum(y == 1)), float(np.sum(y != 1)))
 
 
 def roc_curve(y: np.ndarray, score: np.ndarray
               ) -> Tuple[np.ndarray, np.ndarray]:
     """(fpr, tpr) points including the (0,0) and (1,1) endpoints."""
-    tp, fp, n_pos, n_neg = _curve_points(y, score)
+    _, tp, fp, n_pos, n_neg = _curve_points(y, score)
     tpr = tp / max(n_pos, 1.0)
     fpr = fp / max(n_neg, 1.0)
     return (np.r_[0.0, fpr, 1.0], np.r_[0.0, tpr, 1.0])
@@ -68,7 +73,7 @@ def pr_curve(y: np.ndarray, score: np.ndarray
              ) -> Tuple[np.ndarray, np.ndarray]:
     """(recall, precision) points, prepending (0, first precision) as Spark
     BinaryClassificationMetrics.pr does."""
-    tp, fp, n_pos, _ = _curve_points(y, score)
+    _, tp, fp, n_pos, _ = _curve_points(y, score)
     recall = tp / max(n_pos, 1.0)
     precision = tp / np.maximum(tp + fp, 1.0)
     first_p = precision[0] if precision.size else 1.0
@@ -125,19 +130,19 @@ def binary_metrics(y: np.ndarray, pred_label: np.ndarray,
         Precision=precision, Recall=recall, F1=f1,
         Error=(fp + fn) / n, TP=tp, TN=tn, FP=fp, FN=fn)
     if score is not None and len(np.unique(y)) > 1:
-        m.AuROC = au_roc(y, score)
-        m.AuPR = au_pr(y, score)
+        # one sort serves ROC, PR and the threshold curves
+        thr, tp_c, fp_c, n_pos, n_neg = _curve_points(y, score)
+        tpr = tp_c / max(n_pos, 1.0)
+        fpr = fp_c / max(n_neg, 1.0)
+        prec = tp_c / np.maximum(tp_c + fp_c, 1.0)
+        first_p = prec[0] if prec.size else 1.0
+        m.AuROC = _trapezoid(np.r_[0.0, fpr, 1.0], np.r_[0.0, tpr, 1.0])
+        m.AuPR = _trapezoid(np.r_[0.0, tpr], np.r_[first_p, prec])
         if record_curves:
-            tp_c, fp_c, n_pos, n_neg = _curve_points(y, score)
-            order = np.argsort(-score, kind="stable")
-            s_sorted = score[order]
-            last = np.r_[np.nonzero(np.diff(s_sorted))[0], len(s_sorted) - 1]
-            m.thresholds = s_sorted[last].tolist()
-            m.precision_by_threshold = (
-                tp_c / np.maximum(tp_c + fp_c, 1.0)).tolist()
-            m.recall_by_threshold = (tp_c / max(n_pos, 1.0)).tolist()
-            m.false_positive_rate_by_threshold = (
-                fp_c / max(n_neg, 1.0)).tolist()
+            m.thresholds = thr.tolist()
+            m.precision_by_threshold = prec.tolist()
+            m.recall_by_threshold = tpr.tolist()
+            m.false_positive_rate_by_threshold = fpr.tolist()
     return m
 
 

@@ -1,0 +1,16 @@
+"""Model zoo: JAX-native estimators replacing the reference's Spark MLlib
+wrappers (SURVEY §2.8; core/.../sparkwrappers/specific/OpPredictorWrapper.scala:67).
+"""
+from .base import (ClassifierModel, PredictionModel, Predictor,
+                   RegressionModel, check_is_response_values)
+from .linear import (LinearRegression, LinearRegressionModel, LinearSVC,
+                     LinearSVCModel, LogisticRegression,
+                     LogisticRegressionModel)
+
+__all__ = [
+    "Predictor", "PredictionModel", "ClassifierModel", "RegressionModel",
+    "check_is_response_values",
+    "LogisticRegression", "LogisticRegressionModel",
+    "LinearRegression", "LinearRegressionModel",
+    "LinearSVC", "LinearSVCModel",
+]

@@ -1,0 +1,133 @@
+"""Predictor / PredictionModel stage bases — the model-zoo kernel.
+
+TPU-native re-design of the reference predictor wrapper layer
+(core/src/main/scala/com/salesforce/op/stages/sparkwrappers/specific/
+OpPredictorWrapper.scala:67 and OpPredictorWrapperModel /
+OpProbabilisticClassifierModel in the same directory). Where the reference
+wraps a Spark MLlib ``Predictor`` and converts the fitted Spark model into
+a row-level ``transformFn``, here each model family is implemented
+natively in JAX: ``fit_arrays`` consumes dense device arrays (the
+label vector and the feature matrix) and ``predict_arrays`` is an
+XLA-compiled batch function returning dense predictions — the
+``Prediction`` map objects of the reference (features/.../types/
+Maps.scala:302) are synthesized only at the row-scoring edge by
+``PredictionColumn``.
+
+Input contract matches the reference exactly: input 1 is the RealNN label
+(must be a response), input 2 the OPVector feature matrix (must not be) —
+core/src/main/scala/com/salesforce/op/stages/impl/CheckIsResponseValues.scala.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..features.columns import FeatureColumn, PredictionColumn
+from ..stages.base import BinaryEstimator, BinaryModel
+from ..types import OPVector, Prediction, RealNN
+
+__all__ = ["Predictor", "PredictionModel", "ClassifierModel",
+           "RegressionModel", "check_is_response_values"]
+
+
+def check_is_response_values(label, features) -> None:
+    """Reference CheckIsResponseValues: in1 must be a response, in2 must
+    not be."""
+    if not label.is_response:
+        raise ValueError(
+            f"Label input {label.name!r} must be a response feature")
+    if features.is_response:
+        raise ValueError(
+            f"Feature-vector input {features.name!r} must not be a response")
+
+
+class Predictor(BinaryEstimator):
+    """Estimator over (RealNN label, OPVector features) -> Prediction."""
+
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+
+    def check_input_constraints(self, features) -> None:
+        check_is_response_values(*features)
+
+    def fit_columns(self, cols: List[FeatureColumn]) -> "PredictionModel":
+        y = np.asarray(cols[0].data, dtype=np.float64)
+        X = np.asarray(cols[1].data, dtype=np.float64)
+        model = self.fit_arrays(X, y)
+        model.vector_metadata = cols[1].metadata
+        return model
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> "PredictionModel":
+        raise NotImplementedError
+
+    # -- hyperparameter grid support ---------------------------------------
+    def with_params(self, **params) -> "Predictor":
+        """A copy of this estimator with ctor params overridden — the
+        grid-point expansion primitive (reference ParamMap copies,
+        tuning/OpValidator.scala:293)."""
+        kwargs = self.get_params()
+        kwargs.pop("uid", None)
+        kwargs.update(params)
+        return type(self)(**kwargs)
+
+
+class PredictionModel(BinaryModel):
+    """Fitted model: OPVector batch -> PredictionColumn.
+
+    Scoring uses only the feature-vector input; the label column (wired
+    for uid/DAG symmetry with the estimator) is ignored, so score-time
+    data without real labels works (reference OpPredictionModel
+    transforms only the features column).
+    """
+
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+    #: vector metadata of the training feature matrix (for insights/LOCO)
+    vector_metadata = None
+
+    def check_input_constraints(self, features) -> None:
+        check_is_response_values(*features)
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> PredictionColumn:
+        X = np.asarray(cols[-1].data, dtype=np.float64)
+        return self.predict_arrays(X)
+
+    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
+        raise NotImplementedError
+
+    def transform_value(self, *values: Any) -> Prediction:
+        vec = values[-1]
+        arr = np.asarray(vec.value if hasattr(vec, "value") else vec,
+                         dtype=np.float64).reshape(1, -1)
+        return self.predict_arrays(arr).boxed(0)
+
+
+class ClassifierModel(PredictionModel):
+    """Probabilistic classifier: produces prediction + rawPrediction +
+    probability (reference OpProbabilisticClassifierModel)."""
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """(n, k) raw margins/scores."""
+        raise NotImplementedError
+
+    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
+        raw = np.asarray(self.predict_raw(X), dtype=np.float64)
+        prob = np.asarray(self.raw_to_probability(raw), dtype=np.float64)
+        pred = np.argmax(prob, axis=1).astype(np.float64)
+        return PredictionColumn.from_arrays(pred, probability=prob,
+                                            raw_prediction=raw)
+
+
+class RegressionModel(PredictionModel):
+    """Regressor: prediction only (reference OpPredictionModel)."""
+
+    def predict_values(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
+        pred = np.asarray(self.predict_values(X), dtype=np.float64)
+        return PredictionColumn.from_arrays(pred)
