@@ -1,0 +1,216 @@
+"""Vectorizer tests (reference analogues: core/src/test/.../
+RealVectorizerTest, OpOneHotVectorizerTest, SmartTextVectorizerTest,
+VectorsCombinerTest, DateToUnitCircleTransformerTest, TransmogrifierTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+from transmogrifai_tpu.ops import (BinaryVectorizer, DateToUnitCircleVectorizer,
+                                   IntegralVectorizer, MultiPickListVectorizer,
+                                   OneHotVectorizer, RealVectorizer,
+                                   SmartTextVectorizer, TextHashVectorizer,
+                                   VectorsCombiner, tokenize, transmogrify)
+from transmogrifai_tpu.types import (Binary, Date, Integral, MultiPickList,
+                                     PickList, Real, Text)
+from transmogrifai_tpu.utils.vector_meta import NULL_INDICATOR, OTHER_INDICATOR
+
+
+def _feat(name, ftype):
+    return FeatureBuilder.of(name, ftype).extract(
+        lambda r: r.get(name)).as_predictor()
+
+
+class TestRealVectorizer:
+    def test_mean_impute_and_null_tracking(self):
+        age = _feat("age", Real)
+        fare = _feat("fare", Real)
+        ds = Dataset({
+            "age": FeatureColumn.from_values(Real, [10.0, None, 30.0]),
+            "fare": FeatureColumn.from_values(Real, [1.0, 2.0, 3.0])})
+        est = RealVectorizer().set_input(age, fare)
+        model = est.fit(ds)
+        out = model.transform_columns([ds["age"], ds["fare"]])
+        # age: mean(10,30)=20 imputed at row 1; null col lights up
+        np.testing.assert_allclose(
+            out.data, [[10, 0, 1, 0], [20, 1, 2, 0], [30, 0, 3, 0]])
+        cols = out.metadata.columns
+        assert cols[1].indicator_value == NULL_INDICATOR
+        assert cols[0].parent_feature_name == "age"
+        assert out.metadata.size == 4
+
+    def test_constant_fill(self):
+        age = _feat("age", Real)
+        ds = Dataset({"age": FeatureColumn.from_values(Real, [None, 5.0])})
+        model = RealVectorizer(fill_with_mean=False, fill_value=-1.0,
+                               track_nulls=False).set_input(age).fit(ds)
+        out = model.transform_columns([ds["age"]])
+        np.testing.assert_allclose(out.data, [[-1.0], [5.0]])
+
+
+class TestIntegralVectorizer:
+    def test_mode_impute(self):
+        sib = _feat("sib", Integral)
+        ds = Dataset({"sib": FeatureColumn.from_values(
+            Integral, [1, 1, 2, None])})
+        model = IntegralVectorizer().set_input(sib).fit(ds)
+        out = model.transform_columns([ds["sib"]])
+        np.testing.assert_allclose(
+            out.data, [[1, 0], [1, 0], [2, 0], [1, 1]])
+
+
+class TestBinaryVectorizer:
+    def test_false_fill(self):
+        b = _feat("b", Binary)
+        ds = Dataset({"b": FeatureColumn.from_values(
+            Binary, [True, False, None])})
+        out = BinaryVectorizer().set_input(b).transform_columns([ds["b"]])
+        np.testing.assert_allclose(out.data, [[1, 0], [0, 0], [0, 1]])
+
+
+class TestOneHotVectorizer:
+    def test_topk_other_null(self):
+        sex = _feat("sex", PickList)
+        vals = ["m"] * 5 + ["f"] * 3 + ["x"] + [None]
+        ds = Dataset({"sex": FeatureColumn.from_values(PickList, vals)})
+        model = OneHotVectorizer(top_k=2, min_support=2).set_input(sex).fit(ds)
+        assert model.categories == [["m", "f"]]
+        out = model.transform_columns([ds["sex"]])
+        assert out.width == 4  # m, f, OTHER, NULL
+        np.testing.assert_allclose(out.data[0], [1, 0, 0, 0])
+        np.testing.assert_allclose(out.data[8], [0, 0, 1, 0])  # "x" -> OTHER
+        np.testing.assert_allclose(out.data[9], [0, 0, 0, 1])  # None -> NULL
+        ivals = [c.indicator_value for c in out.metadata.columns]
+        assert ivals == ["m", "f", OTHER_INDICATOR, NULL_INDICATOR]
+        # indicator group covers all 4 columns of the pivot
+        groups = out.metadata.indicator_groups()
+        assert groups[("sex", "sex")] == [0, 1, 2, 3]
+
+    def test_min_support_filters(self):
+        c = _feat("c", PickList)
+        vals = ["a"] * 5 + ["b"]  # b below min_support
+        ds = Dataset({"c": FeatureColumn.from_values(PickList, vals)})
+        model = OneHotVectorizer(top_k=5, min_support=2).set_input(c).fit(ds)
+        assert model.categories == [["a"]]
+
+
+class TestMultiPickListVectorizer:
+    def test_multi_hot(self):
+        tags = _feat("tags", MultiPickList)
+        ds = Dataset({"tags": FeatureColumn.from_values(
+            MultiPickList,
+            [{"a", "b"}, {"a"}, set(), {"a"}, {"b"}, {"a", "b"}])})
+        model = MultiPickListVectorizer(
+            top_k=5, min_support=1).set_input(tags).fit(ds)
+        out = model.transform_columns([ds["tags"]])
+        assert out.width == 4
+        row0 = dict(zip(
+            [c.indicator_value for c in out.metadata.columns], out.data[0]))
+        assert row0["a"] == 1 and row0["b"] == 1
+        assert out.data[2][3] == 1.0  # empty set -> NULL indicator
+
+
+class TestSmartTextVectorizer:
+    def test_pivot_low_cardinality(self):
+        t = _feat("t", Text)
+        vals = (["red"] * 6 + ["blue"] * 5) * 2
+        ds = Dataset({"t": FeatureColumn.from_values(Text, vals)})
+        model = SmartTextVectorizer(max_cardinality=5).set_input(t).fit(ds)
+        assert model.strategies[0][0] == "pivot"
+        out = model.transform_columns([ds["t"]])
+        assert out.width == 4  # red, blue, OTHER, NULL
+
+    def test_hash_high_cardinality(self):
+        t = _feat("t", Text)
+        vals = [f"token{i} common" for i in range(40)]
+        ds = Dataset({"t": FeatureColumn.from_values(Text, vals)})
+        model = SmartTextVectorizer(max_cardinality=10,
+                                    num_hashes=16).set_input(t).fit(ds)
+        assert model.strategies[0][0] == "hash"
+        out = model.transform_columns([ds["t"]])
+        assert out.width == 17  # 16 hash buckets + null indicator
+        # "common" token hashes to the same bucket in every row
+        common_cols = np.sum(np.all(out.data[:, :16] >= 1.0, axis=0))
+        assert common_cols >= 1
+
+    def test_tokenize(self):
+        assert tokenize("Hello, World! x") == ["hello", "world", "x"]
+        assert tokenize(None) == []
+        assert tokenize("a bb ccc", min_token_length=2) == ["bb", "ccc"]
+
+
+class TestDateVectorizer:
+    def test_unit_circle(self):
+        d = _feat("d", Date)
+        noon = 12 * 3600 * 1000
+        ds = Dataset({"d": FeatureColumn.from_values(
+            Date, [0, noon, None])})
+        out = DateToUnitCircleVectorizer(
+            time_period="HourOfDay").set_input(d).transform_columns([ds["d"]])
+        np.testing.assert_allclose(out.data[0], [0.0, 1.0], atol=1e-12)
+        np.testing.assert_allclose(out.data[1], [0.0, -1.0], atol=1e-12)
+        np.testing.assert_allclose(out.data[2], [0.0, 0.0])  # missing
+
+    def test_day_of_week(self):
+        d = _feat("d", Date)
+        # 1970-01-01 was a Thursday; phase = 3/7
+        ds = Dataset({"d": FeatureColumn.from_values(Date, [0])})
+        out = DateToUnitCircleVectorizer(
+            time_period="DayOfWeek").set_input(d).transform_columns([ds["d"]])
+        phase = 2 * np.pi * 3 / 7
+        np.testing.assert_allclose(
+            out.data[0], [np.sin(phase), np.cos(phase)], atol=1e-12)
+
+
+class TestVectorsCombiner:
+    def test_concat_and_metadata_flatten(self):
+        r = _feat("r", Real)
+        p = _feat("p", PickList)
+        ds = Dataset({
+            "r": FeatureColumn.from_values(Real, [1.0, 2.0]),
+            "p": FeatureColumn.from_values(PickList, ["a", "b"])})
+        rv = RealVectorizer(track_nulls=False).set_input(r)
+        pv = OneHotVectorizer(top_k=2, min_support=1,
+                              track_nulls=False).set_input(p)
+        ds2 = rv.fit(ds).transform_dataset(ds)
+        ds2 = ds2.with_column(rv.get_output().name,
+                              ds2[rv.get_output().name])
+        pvm = pv.fit(ds)
+        ds2 = pvm.transform_dataset(ds2)
+        comb = VectorsCombiner().set_input(rv.get_output(), pv.get_output())
+        out = comb.transform_columns(
+            [ds2[rv.get_output().name], ds2[pv.get_output().name]])
+        assert out.width == 1 + 3
+        parents = [c.parent_feature_name for c in out.metadata.columns]
+        assert parents == ["r", "p", "p", "p"]
+
+
+class TestTransmogrify:
+    def test_mixed_types_one_vector(self):
+        feats = [_feat("age", Real), _feat("n", Integral),
+                 _feat("ok", Binary), _feat("sex", PickList),
+                 _feat("note", Text)]
+        combined = transmogrify(feats)
+        ds = Dataset({
+            "age": FeatureColumn.from_values(Real, [20.0, None, 40.0]),
+            "n": FeatureColumn.from_values(Integral, [1, 2, 2]),
+            "ok": FeatureColumn.from_values(Binary, [True, None, False]),
+            "sex": FeatureColumn.from_values(PickList, ["m", "f", "m"]),
+            "note": FeatureColumn.from_values(Text, ["hi there", None, "yo"]),
+        })
+        from transmogrifai_tpu.workflow import Workflow
+        # drive through the workflow engine: transmogrify is a sub-DAG
+        wf = Workflow().set_result_features(combined).set_input_dataset(ds)
+        model = wf.train()
+        out = model.score(ds, keep_intermediate=True)[combined.name]
+        assert out.n_rows == 3
+        assert out.width == out.metadata.size
+        parents = {c.parent_feature_name for c in out.metadata.columns}
+        assert parents == {"age", "n", "ok", "sex", "note"}
+
+    def test_vector_passthrough(self):
+        from transmogrifai_tpu.types import OPVector
+        v = _feat("v", OPVector)
+        r = _feat("x", Real)
+        out = transmogrify([v, r])
+        assert out.origin_stage.operation_name == "combineVector"

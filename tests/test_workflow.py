@@ -1,0 +1,108 @@
+"""Workflow engine tests (reference: core/src/test/.../OpWorkflowTest.scala:61)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.types import Binary, PickList, Real, RealNN
+
+
+def _toy_records(rng, n=200):
+    recs = []
+    for i in range(n):
+        x = rng.normal()
+        cat = rng.choice(["a", "b", "c"])
+        boost = {"a": 1.0, "b": -1.0, "c": 0.0}[cat]
+        y = float(x + boost + 0.3 * rng.normal() > 0)
+        recs.append({"x": x, "cat": str(cat), "flag": bool(x > 1),
+                     "label": y})
+    return recs
+
+
+def _pipeline():
+    label = FeatureBuilder.real_nn("label").extract(
+        lambda r: r["label"]).as_response()
+    x = FeatureBuilder.real("x").extract(lambda r: r["x"]).as_predictor()
+    cat = FeatureBuilder.pick_list("cat").extract(
+        lambda r: r["cat"]).as_predictor()
+    flag = FeatureBuilder.binary("flag").extract(
+        lambda r: r["flag"]).as_predictor()
+    fv = transmogrify([x, cat, flag])
+    pred = LogisticRegression().set_input(label, fv).get_output()
+    return label, fv, pred
+
+
+class TestWorkflowTrainScore:
+    def test_end_to_end(self, rng):
+        recs = _toy_records(rng)
+        label, fv, pred = _pipeline()
+        from transmogrifai_tpu.workflow import Workflow
+        wf = Workflow().set_result_features(pred).set_input_records(recs)
+        # stages derived from the DAG: vectorizers + combiner + LR
+        names = {type(s).__name__ for s in wf.stages()}
+        assert "LogisticRegression" in names
+        assert "VectorsCombiner" in names
+
+        model = wf.train()
+        # after training every origin stage is a transformer/model
+        from transmogrifai_tpu.stages.base import Estimator
+        assert not any(isinstance(s, Estimator) for s in model.stages())
+
+        scored = model.score(recs)
+        assert pred.name in scored.column_names
+        ev = BinaryClassificationEvaluator()
+        scored2, metrics = model.score_and_evaluate(recs, ev)
+        assert metrics.AuROC > 0.85
+        assert ev.label_col == "label"
+        assert ev.prediction_col == pred.name
+
+    def test_score_without_label(self, rng):
+        recs = _toy_records(rng)
+        label, fv, pred = _pipeline()
+        from transmogrifai_tpu.workflow import Workflow
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(recs).train())
+        unlabeled = [{k: v for k, v in r.items() if k != "label"}
+                     for r in recs[:10]]
+        scored = model.score(unlabeled)
+        assert scored.n_rows == 10
+        preds = scored[pred.name].data
+        assert np.all((preds == 0) | (preds == 1))
+
+    def test_dataset_input(self, rng):
+        recs = _toy_records(rng, n=100)
+        label, fv, pred = _pipeline()
+        ds = Dataset({
+            "label": FeatureColumn.from_values(
+                RealNN, [r["label"] for r in recs]),
+            "x": FeatureColumn.from_values(Real, [r["x"] for r in recs]),
+            "cat": FeatureColumn.from_values(
+                PickList, [r["cat"] for r in recs]),
+            "flag": FeatureColumn.from_values(
+                Binary, [r["flag"] for r in recs])})
+        from transmogrifai_tpu.workflow import Workflow
+        model = (Workflow().set_result_features(pred)
+                 .set_input_dataset(ds).train())
+        scored = model.score(ds)
+        assert scored.n_rows == 100
+
+    def test_missing_raw_feature_raises(self, rng):
+        label, fv, pred = _pipeline()
+        ds = Dataset({"x": FeatureColumn.from_values(Real, [1.0])})
+        from transmogrifai_tpu.workflow import Workflow
+        wf = Workflow().set_result_features(pred).set_input_dataset(ds)
+        with pytest.raises(KeyError):
+            wf.train()
+
+    def test_compute_data_up_to(self, rng):
+        recs = _toy_records(rng, n=50)
+        label, fv, pred = _pipeline()
+        from transmogrifai_tpu.workflow import Workflow
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(recs).train())
+        partial = model.compute_data_up_to(fv, recs[:5])
+        assert fv.name in partial.column_names
+        assert pred.name not in partial.column_names

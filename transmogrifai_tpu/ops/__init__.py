@@ -1,0 +1,22 @@
+"""Automated feature engineering: vectorizers + Transmogrifier (SURVEY §2.5;
+core/.../stages/impl/feature/)."""
+from .categorical import (MultiPickListVectorizer, MultiPickListVectorizerModel,
+                          OneHotVectorizer, OneHotVectorizerModel)
+from .combiner import VectorsCombiner
+from .date import DateToUnitCircleVectorizer
+from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
+                      RealVectorizerModel)
+from .text import (SmartTextVectorizer, SmartTextVectorizerModel,
+                   TextHashVectorizer, TextTokenizer, tokenize)
+from .transmogrify import TransmogrifierDefaults, transmogrify
+
+__all__ = [
+    "RealVectorizer", "RealVectorizerModel", "IntegralVectorizer",
+    "BinaryVectorizer",
+    "OneHotVectorizer", "OneHotVectorizerModel",
+    "MultiPickListVectorizer", "MultiPickListVectorizerModel",
+    "SmartTextVectorizer", "SmartTextVectorizerModel", "TextHashVectorizer",
+    "TextTokenizer", "tokenize",
+    "DateToUnitCircleVectorizer", "VectorsCombiner",
+    "TransmogrifierDefaults", "transmogrify",
+]

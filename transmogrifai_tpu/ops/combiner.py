@@ -1,0 +1,41 @@
+"""VectorsCombiner: concatenate OPVector features into one.
+
+TPU-native port of core/src/main/scala/com/salesforce/op/stages/impl/
+feature/VectorsCombiner.scala:51,85 — concatenates vector columns and
+flattens their metadata. Columnar execution makes this a single
+``np.concatenate``; the reference needed a Spark SequenceEstimator pass.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..features.columns import FeatureColumn
+from ..stages.base import SequenceTransformer
+from ..types import OPVector
+from ..utils.vector_meta import VectorMetadata
+
+__all__ = ["VectorsCombiner"]
+
+
+class VectorsCombiner(SequenceTransformer):
+    input_types = (OPVector,)
+    output_type = OPVector
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="combineVector", uid=uid)
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        mats, metas = [], []
+        out_name = self.get_output().name
+        for f, col in zip(self.input_features, cols):
+            if col.kind != "vector":
+                raise TypeError(
+                    f"VectorsCombiner input {f.name!r} is not a vector")
+            mats.append(col.data)
+            metas.append(col.metadata or VectorMetadata(name=f.name))
+        mat = (np.concatenate(mats, axis=1) if mats
+               else np.zeros((0, 0), dtype=np.float64))
+        return FeatureColumn.vector(
+            mat, VectorMetadata.flatten(out_name, metas))

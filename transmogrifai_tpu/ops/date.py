@@ -1,0 +1,92 @@
+"""Date/time vectorization: unit-circle projection.
+
+TPU-native port of the reference DateToUnitCircleTransformer
+(core/src/main/scala/com/salesforce/op/stages/impl/feature/
+DateToUnitCircleTransformer.scala): a timestamp's periodic component
+(hour of day, day of week, ...) is mapped to (sin, cos) on the unit
+circle so midnight and 23:59 are close in feature space. Timestamps are
+epoch milliseconds UTC, as in the reference (joda DateTimeUtils).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..features.columns import FeatureColumn
+from ..stages.base import SequenceTransformer
+from ..types import Date, OPVector
+from .vector_utils import VectorColumnMetadata, vector_output
+
+__all__ = ["DateToUnitCircleVectorizer", "TIME_PERIODS"]
+
+_MS_PER_HOUR = 3600 * 1000
+_MS_PER_DAY = 24 * _MS_PER_HOUR
+
+#: period -> (extractor of phase in [0, 1), period name)
+TIME_PERIODS = {
+    "HourOfDay": lambda ms: (ms % _MS_PER_DAY) / _MS_PER_DAY,
+    # epoch day 0 (1970-01-01) was a Thursday = ISO day-of-week 4
+    "DayOfWeek": lambda ms: (((ms // _MS_PER_DAY) + 3) % 7) / 7.0,
+    "DayOfMonth": lambda ms: _day_of_month_phase(ms),
+    "MonthOfYear": lambda ms: _month_phase(ms),
+}
+
+
+def _civil_from_ms(ms: np.ndarray):
+    days = ms // _MS_PER_DAY
+    # days-from-civil inverse (Howard Hinnant's algorithm), vectorized
+    z = days + 719468
+    era = np.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    return m.astype(np.int64), d.astype(np.int64)
+
+
+def _day_of_month_phase(ms: np.ndarray) -> np.ndarray:
+    _, d = _civil_from_ms(ms)
+    return (d - 1) / 31.0
+
+
+def _month_phase(ms: np.ndarray) -> np.ndarray:
+    m, _ = _civil_from_ms(ms)
+    return (m - 1) / 12.0
+
+
+class DateToUnitCircleVectorizer(SequenceTransformer):
+    """Date(s) -> [sin, cos] per time period, null-safe (missing -> 0,0)."""
+
+    input_types = (Date,)
+    output_type = OPVector
+
+    def __init__(self, time_period: str = "HourOfDay",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="toUnitCircle", uid=uid)
+        if time_period not in TIME_PERIODS:
+            raise ValueError(
+                f"Unknown time period {time_period!r}; "
+                f"choose from {sorted(TIME_PERIODS)}")
+        self.time_period = time_period
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        phase_fn = TIME_PERIODS[self.time_period]
+        blocks, metas = [], []
+        for f, col in zip(self.input_features, cols):
+            vals = np.asarray(col.data, dtype=np.float64)
+            ok = ~np.isnan(vals)
+            ms = np.where(ok, vals, 0.0).astype(np.int64)
+            phase = 2.0 * np.pi * np.asarray(phase_fn(ms), dtype=np.float64)
+            block = np.zeros((len(vals), 2), dtype=np.float64)
+            block[:, 0] = np.where(ok, np.sin(phase), 0.0)
+            block[:, 1] = np.where(ok, np.cos(phase), 0.0)
+            blocks.append(block)
+            for trig in ("sin", "cos"):
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__,
+                    descriptor_value=f"{trig}({self.time_period})"))
+        return vector_output(self.get_output().name, blocks, metas)

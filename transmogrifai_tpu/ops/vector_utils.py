@@ -1,0 +1,34 @@
+"""Shared helpers for vectorizer stages: matrix assembly + metadata."""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..features.columns import FeatureColumn
+from ..utils.vector_meta import (NULL_INDICATOR, OTHER_INDICATOR,
+                                 VectorColumnMetadata, VectorMetadata)
+
+__all__ = ["vector_output", "stable_hash", "NULL_INDICATOR",
+           "OTHER_INDICATOR", "VectorColumnMetadata", "VectorMetadata"]
+
+
+def vector_output(name: str, blocks: Sequence[np.ndarray],
+                  columns: Sequence[VectorColumnMetadata]) -> FeatureColumn:
+    """Assemble per-feature column blocks into one OPVector column."""
+    if blocks:
+        mat = np.concatenate([np.atleast_2d(b.T).T if b.ndim == 1
+                              else b for b in blocks], axis=1)
+    else:
+        mat = np.zeros((0, 0), dtype=np.float64)
+    meta = VectorMetadata(name=name, columns=tuple(columns))
+    return FeatureColumn.vector(mat, meta)
+
+
+def stable_hash(token: str, n_buckets: int) -> int:
+    """Deterministic string hash (reference uses MurmurHash3 via Spark
+    HashingTF, core/.../feature/OPCollectionHashingVectorizer.scala; any
+    stable uniform hash preserves the semantics)."""
+    h = hashlib.md5(token.encode("utf-8")).digest()
+    return int.from_bytes(h[:8], "little") % n_buckets

@@ -1,0 +1,4 @@
+"""Workflow engine (SURVEY §2.4; core/.../OpWorkflow.scala:332)."""
+from .workflow import Workflow, WorkflowModel
+
+__all__ = ["Workflow", "WorkflowModel"]

@@ -1,0 +1,269 @@
+"""The workflow engine: fit a feature DAG, score with the fitted model.
+
+TPU-native re-design of the reference workflow core
+(core/src/main/scala/com/salesforce/op/{OpWorkflow.scala:332,
+OpWorkflowModel.scala:253, OpWorkflowCore.scala:52} and the DAG executor
+core/.../utils/stages/FitStagesUtil.scala:173-305). Differences from the
+Spark design:
+
+- Data is a columnar :class:`Dataset` (host numpy feeding XLA device
+  arrays), not a Spark DataFrame; a "layer" of the DAG is executed as
+  direct columnar kernels instead of one RDD map over row closures
+  (FitStagesUtil.applyOpTransformations:96).
+- Estimator -> fitted-model DAG rewiring uses
+  ``Feature.copy_with_new_stages`` exactly like the reference
+  (OpWorkflow.scala:347).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..evaluators.base import EvaluationMetrics, Evaluator
+from ..features.columns import Dataset, FeatureColumn
+from ..features.feature import Feature, topo_layers
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import Estimator, PipelineStage, Transformer
+
+__all__ = ["Workflow", "WorkflowModel"]
+
+
+def _unique_raw_features(result_features: Sequence[Feature]) -> List[Feature]:
+    uniq: Dict[str, Feature] = {}
+    for rf in result_features:
+        for f in rf.raw_features():
+            uniq.setdefault(f.uid, f)
+    return sorted(uniq.values(), key=lambda f: f.name)
+
+
+def _generate_raw_data(raw_features: Sequence[Feature], data: Any,
+                       require_responses: bool) -> Dataset:
+    """Materialize raw feature columns from a Dataset or record iterable
+    (reference generateRawData, OpWorkflow.scala:222 + readers'
+    DataReader.generateDataFrame, readers/.../DataReader.scala:173).
+
+    At score time (``require_responses=False``) absent response features
+    become all-NaN columns so non-nullable label types don't block
+    label-free scoring.
+    """
+    if isinstance(data, Dataset):
+        n = data.n_rows
+        cols: Dict[str, FeatureColumn] = {}
+        for f in raw_features:
+            if f.name in data:
+                cols[f.name] = data[f.name]
+            elif f.is_response and not require_responses:
+                cols[f.name] = FeatureColumn(
+                    ftype=f.ftype, data=np.full(n, np.nan, dtype=np.float64))
+            else:
+                raise KeyError(
+                    f"Raw feature {f.name!r} not present in input dataset")
+        return Dataset(cols)
+
+    records = list(data)
+    cols = {}
+    for f in raw_features:
+        gen = f.origin_stage
+        if not isinstance(gen, FeatureGeneratorStage):
+            raise TypeError(
+                f"Raw feature {f.name!r} has no generator stage")
+        if f.is_response and not require_responses:
+            # user extract fns may KeyError/None on label-free score data
+            def safe(r, fn=gen.extract_fn):
+                try:
+                    return fn(r)
+                except Exception:
+                    return None
+            vals = [safe(r) for r in records]
+            if all(v is None for v in vals):
+                cols[f.name] = FeatureColumn(
+                    ftype=f.ftype,
+                    data=np.full(len(records), np.nan, dtype=np.float64))
+                continue
+        cols[f.name] = gen.extract_column(records)
+    return Dataset(cols)
+
+
+def _fit_and_transform_layers(
+        layers: List[List[PipelineStage]], ds: Dataset, fit: bool
+        ) -> Tuple[Dataset, Dict[str, PipelineStage]]:
+    """Layer-by-layer DAG execution (reference
+    FitStagesUtil.fitAndTransformDAG:213 / fitAndTransformLayer:254):
+    estimators in a layer are fitted then their models applied; plain
+    transformers are applied directly."""
+    fitted: Dict[str, PipelineStage] = {}
+    for layer in layers:
+        for stage in layer:
+            if isinstance(stage, FeatureGeneratorStage):
+                continue  # raw features are already materialized
+            if isinstance(stage, Estimator):
+                if not fit:
+                    raise RuntimeError(
+                        f"Unfitted estimator {stage!r} in scoring DAG — "
+                        "train the workflow first")
+                model = stage.fit(ds)
+                fitted[stage.uid] = model
+                out = stage.get_output()
+                ds = ds.with_column(
+                    out.name, model.transform_columns(
+                        [ds[f.name] for f in model.input_features]))
+            elif isinstance(stage, Transformer):
+                ds = stage.transform_dataset(ds)
+            else:
+                raise TypeError(f"Cannot execute stage {stage!r}")
+    return ds, fitted
+
+
+class Workflow:
+    """Declare result features + input data, then ``train()``
+    (reference OpWorkflow.scala:59)."""
+
+    def __init__(self):
+        self.result_features: Tuple[Feature, ...] = ()
+        self._input_data: Any = None
+
+    # -- configuration -----------------------------------------------------
+    def set_result_features(self, *features: Feature) -> "Workflow":
+        """(reference setResultFeatures:85; stages are derived from the
+        feature DAG via topological sort, setStagesDAG:195)"""
+        if not features:
+            raise ValueError("At least one result feature required")
+        self.result_features = tuple(features)
+        return self
+
+    def set_input_dataset(self, ds: Dataset) -> "Workflow":
+        """(reference setInputDataset:136)"""
+        self._input_data = ds
+        return self
+
+    def set_input_records(self, records: Iterable[Any]) -> "Workflow":
+        """Row records (dicts/objects); raw features are extracted with
+        their generator stages (reference setInputRDD)."""
+        self._input_data = list(records)
+        return self
+
+    # -- introspection -----------------------------------------------------
+    def raw_features(self) -> List[Feature]:
+        return _unique_raw_features(self.result_features)
+
+    def stages(self) -> List[PipelineStage]:
+        return [s for layer in topo_layers(self.result_features)
+                for s in layer if not isinstance(s, FeatureGeneratorStage)]
+
+    # -- training ----------------------------------------------------------
+    def train(self) -> "WorkflowModel":
+        """Fit all estimators layer-by-layer and return the fitted model
+        (reference OpWorkflow.train:332 / fitStages:368)."""
+        if not self.result_features:
+            raise ValueError("No result features set")
+        if self._input_data is None:
+            raise ValueError("No input data set")
+        raw = self.raw_features()
+        ds = _generate_raw_data(raw, self._input_data,
+                                require_responses=True)
+        layers = topo_layers(self.result_features)
+        train_ds, fitted = _fit_and_transform_layers(layers, ds, fit=True)
+        result = tuple(f.copy_with_new_stages(fitted)
+                       for f in self.result_features)
+        return WorkflowModel(result_features=result,
+                             train_dataset=train_ds)
+
+
+class WorkflowModel:
+    """A fitted workflow: every origin stage in the result-feature DAG is a
+    transformer (reference OpWorkflowModel.scala:58)."""
+
+    def __init__(self, result_features: Tuple[Feature, ...],
+                 train_dataset: Optional[Dataset] = None):
+        self.result_features = tuple(result_features)
+        #: transformed training data (all intermediate columns)
+        self.train_dataset = train_dataset
+
+    def raw_features(self) -> List[Feature]:
+        return _unique_raw_features(self.result_features)
+
+    def stages(self) -> List[PipelineStage]:
+        return [s for layer in topo_layers(self.result_features)
+                for s in layer if not isinstance(s, FeatureGeneratorStage)]
+
+    # -- scoring -----------------------------------------------------------
+    def score(self, data: Any = None, keep_intermediate: bool = False
+              ) -> Dataset:
+        """Transform new data through the fitted DAG
+        (reference OpWorkflowModel.score:253). ``data`` is a Dataset or
+        record iterable; response features may be absent."""
+        raw = self.raw_features()
+        ds = _generate_raw_data(raw, data, require_responses=False)
+        layers = topo_layers(self.result_features)
+        scored, _ = _fit_and_transform_layers(layers, ds, fit=False)
+        if keep_intermediate:
+            return scored
+        keep = [f.name for f in raw if f.name in scored] + \
+               [f.name for f in self.result_features]
+        seen, names = set(), []
+        for n in keep:
+            if n not in seen:
+                seen.add(n)
+                names.append(n)
+        return scored.select(names)
+
+    def score_and_evaluate(self, data: Any, evaluator: Evaluator,
+                           label_feature: Optional[Feature] = None,
+                           prediction_feature: Optional[Feature] = None
+                           ) -> Tuple[Dataset, EvaluationMetrics]:
+        """(reference scoreAndEvaluate:290)"""
+        scored = self.score(data)
+        self._wire_evaluator(evaluator, label_feature, prediction_feature)
+        return scored, evaluator.evaluate_all(scored)
+
+    def evaluate(self, data: Any, evaluator: Evaluator,
+                 label_feature: Optional[Feature] = None,
+                 prediction_feature: Optional[Feature] = None
+                 ) -> EvaluationMetrics:
+        """(reference evaluate:318)"""
+        return self.score_and_evaluate(
+            data, evaluator, label_feature, prediction_feature)[1]
+
+    def _wire_evaluator(self, evaluator: Evaluator,
+                        label_feature: Optional[Feature],
+                        prediction_feature: Optional[Feature]) -> None:
+        if evaluator.label_col is None:
+            if label_feature is None:
+                responses = [f for f in self.raw_features() if f.is_response]
+                if len(responses) != 1:
+                    raise ValueError(
+                        "Cannot infer label column; pass label_feature")
+                label_feature = responses[0]
+            evaluator.label_col = label_feature.name
+        if evaluator.prediction_col is None:
+            pred = (prediction_feature if prediction_feature is not None
+                    else self.result_features[-1])
+            evaluator.prediction_col = pred.name
+
+    def compute_data_up_to(self, feature: Feature, data: Any) -> Dataset:
+        """Materialize all columns needed to produce ``feature``
+        (reference computeDataUpTo:105). ``feature`` may be the
+        pre-training handle; it is resolved into the fitted DAG by uid."""
+        feature = self._resolve(feature)
+        raw = _unique_raw_features([feature])
+        ds = _generate_raw_data(raw, data, require_responses=False)
+        layers = topo_layers([feature])
+        out, _ = _fit_and_transform_layers(layers, ds, fit=False)
+        return out
+
+    def _resolve(self, feature: Feature) -> Feature:
+        """Find the fitted-DAG feature with the same uid (features keep
+        their uid through copy_with_new_stages)."""
+        found: List[Feature] = []
+
+        def visit(f: Feature):
+            if f.uid == feature.uid:
+                found.append(f)
+
+        for rf in self.result_features:
+            rf.traverse(visit)
+            if found:
+                return found[0]
+        raise KeyError(
+            f"Feature {feature.name!r} is not part of this workflow model")
