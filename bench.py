@@ -1,0 +1,40 @@
+"""Benchmark: Titanic end-to-end train + holdout evaluation.
+
+Parity target (BASELINE.md / reference README.md:88): holdout AuPR 0.8225
+from the reference's BinaryClassificationModelSelector on Spark. Prints
+ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_AUPR = 0.8225
+
+
+def main() -> None:
+    try:
+        from examples.titanic import run
+        t0 = time.perf_counter()
+        metrics, fit_seconds, _ = run(verbose=False)
+        total = time.perf_counter() - t0
+        out = {
+            "metric": "titanic_holdout_aupr",
+            "value": round(float(metrics.AuPR), 4),
+            "unit": "AuPR",
+            "vs_baseline": round(float(metrics.AuPR) / BASELINE_AUPR, 4),
+            "auroc": round(float(metrics.AuROC), 4),
+            "f1": round(float(metrics.F1), 4),
+            "error": round(float(metrics.Error), 4),
+            "train_eval_seconds": round(fit_seconds, 2),
+            "total_seconds": round(total, 2),
+        }
+    except Exception as e:  # never die silently — emit a diagnostic line
+        out = {"metric": "titanic_holdout_aupr", "value": 0.0,
+               "unit": "AuPR", "vs_baseline": 0.0, "error_msg": repr(e)}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,71 @@ class Feature:
 
         return rebuild(self)
 
+    # -- DSL enrichments (reference core/.../dsl/RichNumericFeature.scala,
+    # RichTextFeature.scala, RichFeature.scala) -----------------------------
+    def _arith(self, other, op: str, swapped: bool = False) -> "Feature":
+        from ..ops.dsl import NumericBinaryTransformer, NumericScalarTransformer
+        if isinstance(other, Feature):
+            a, b = (other, self) if swapped else (self, other)
+            return NumericBinaryTransformer(op=op).set_input(a, b).get_output()
+        return NumericScalarTransformer(
+            op=op, scalar=float(other), swapped=swapped
+        ).set_input(self).get_output()
+
+    def __add__(self, other):
+        return self._arith(other, "add")
+
+    def __radd__(self, other):
+        return self._arith(other, "add", swapped=True)
+
+    def __sub__(self, other):
+        return self._arith(other, "sub")
+
+    def __rsub__(self, other):
+        return self._arith(other, "sub", swapped=True)
+
+    def __mul__(self, other):
+        return self._arith(other, "mul")
+
+    def __rmul__(self, other):
+        return self._arith(other, "mul", swapped=True)
+
+    def __truediv__(self, other):
+        return self._arith(other, "div")
+
+    def __rtruediv__(self, other):
+        return self._arith(other, "div", swapped=True)
+
+    def map(self, fn: Callable, output_type: Type[FeatureType]) -> "Feature":
+        """Row-wise boxed map (reference RichFeature.map)."""
+        from ..stages.base import LambdaTransformer
+        return LambdaTransformer(fn=fn, output_type=output_type
+                                 ).set_input(self).get_output()
+
+    def fill_missing_with_mean(self) -> "Feature":
+        """(reference RichNumericFeature.fillMissingWithMean)"""
+        from ..ops.dsl import FillMissingWithMean
+        return FillMissingWithMean().set_input(self).get_output()
+
+    def z_normalize(self) -> "Feature":
+        """(reference RichNumericFeature.zNormalize:325)"""
+        from ..ops.dsl import StandardScaler
+        return StandardScaler().set_input(self).get_output()
+
+    def pivot(self, top_k: int = 20, min_support: int = 10) -> "Feature":
+        """One-hot pivot of a categorical text feature
+        (reference RichTextFeature.pivot)."""
+        from ..ops.categorical import OneHotVectorizer
+        return OneHotVectorizer(top_k=top_k, min_support=min_support
+                                ).set_input(self).get_output()
+
+    def alias(self, name: str) -> "Feature":
+        """Rename via an identity stage (reference RichFeature.alias /
+        AliasTransformer)."""
+        from ..ops.dsl import AliasTransformer
+        return AliasTransformer(alias=name, output_type=self.ftype
+                                ).set_input(self).get_output()
+
     # -- dunder ------------------------------------------------------------
     def __repr__(self) -> str:
         kind = "response" if self.is_response else "predictor"

@@ -4,6 +4,9 @@ from .categorical import (MultiPickListVectorizer, MultiPickListVectorizerModel,
                           OneHotVectorizer, OneHotVectorizerModel)
 from .combiner import VectorsCombiner
 from .date import DateToUnitCircleVectorizer
+from .dsl import (AliasTransformer, FillMissingWithMean,
+                  NumericBinaryTransformer, NumericScalarTransformer,
+                  StandardScaler)
 from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
                       RealVectorizerModel)
 from .text import (SmartTextVectorizer, SmartTextVectorizerModel,
@@ -19,4 +22,6 @@ __all__ = [
     "TextTokenizer", "tokenize",
     "DateToUnitCircleVectorizer", "VectorsCombiner",
     "TransmogrifierDefaults", "transmogrify",
+    "AliasTransformer", "FillMissingWithMean", "NumericBinaryTransformer",
+    "NumericScalarTransformer", "StandardScaler",
 ]
