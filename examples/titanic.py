@@ -136,6 +136,14 @@ def stratified_split(records, label_key="survived", test_fraction=0.25,
     return train, test
 
 
+def default_selector(num_folds: int = 3, seed: int = 42):
+    """BinaryClassificationModelSelector with CV over the default model
+    pool (reference README.md:61-63: 3 LR + 16 RF under 3-fold CV)."""
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+    return BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=num_folds, seed=seed, stratify=True)
+
+
 def run(csv_path: str = None, model_stage=None, verbose: bool = True):
     """Train on a 75% split, evaluate on the 25% holdout.
 
@@ -144,7 +152,7 @@ def run(csv_path: str = None, model_stage=None, verbose: bool = True):
     records = load_titanic(csv_path)
     train, test = stratified_split(records)
     survived, features = build_features()
-    stage = model_stage or LogisticRegression(reg_param=0.01)
+    stage = model_stage if model_stage is not None else default_selector()
     prediction = stage.set_input(survived, features).get_output()
 
     t0 = time.perf_counter()
@@ -158,6 +166,10 @@ def run(csv_path: str = None, model_stage=None, verbose: bool = True):
     elapsed = time.perf_counter() - t0
 
     if verbose:
+        from transmogrifai_tpu.selector import SelectedModel
+        for s in model.stages():
+            if isinstance(s, SelectedModel) and s.summary is not None:
+                print(s.summary.pretty())
         print(f"Train rows: {len(train)}, holdout rows: {len(test)}")
         print(f"Holdout AuPR:   {metrics.AuPR:.4f}  (reference 0.8225)")
         print(f"Holdout AuROC:  {metrics.AuROC:.4f}  (reference 0.8822)")

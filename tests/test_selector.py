@@ -1,0 +1,166 @@
+"""ModelSelector / validator / splitter tests (reference analogues:
+core/src/test/.../ModelSelectorTest.scala,
+BinaryClassificationModelSelectorTest.scala, DataBalancerTest.scala,
+DataCutterTest.scala, OpCrossValidationTest.scala)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import (BinaryClassificationEvaluator,
+                                          RegressionEvaluator)
+from transmogrifai_tpu.models import (LinearRegression, LinearSVC,
+                                      LogisticRegression)
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        CrossValidation, DataBalancer,
+                                        DataCutter, DataSplitter,
+                                        ModelSelector,
+                                        RegressionModelSelector,
+                                        SelectedModel, Splitter,
+                                        TrainValidationSplit)
+
+
+def _binary(rng, n=300, d=4):
+    X = rng.normal(size=(n, d))
+    y = ((X[:, 0] * 2 - X[:, 1] + rng.logistic(size=n) * 0.5) > 0
+         ).astype(float)
+    return X, y
+
+
+class TestSplitters:
+    def test_split_stratified(self):
+        y = np.array([0] * 80 + [1] * 20, dtype=float)
+        tr, te = Splitter(reserve_test_fraction=0.25).split(y)
+        assert len(te) == 25
+        assert np.isclose(np.mean(y[te] == 1), 0.2)
+        assert len(np.intersect1d(tr, te)) == 0
+
+    def test_balancer_downsamples(self):
+        y = np.array([0] * 900 + [1] * 30, dtype=float)
+        b = DataBalancer(sample_fraction=0.25)
+        idx = b.prepare(y)
+        frac = np.mean(y[idx] == 1)
+        assert frac >= 0.24
+        assert b.summary.results["balanced"] is True
+        # all minority rows kept
+        assert np.sum(y[idx] == 1) == 30
+
+    def test_balancer_noop_when_balanced(self):
+        y = np.array([0] * 50 + [1] * 50, dtype=float)
+        b = DataBalancer(sample_fraction=0.1)
+        idx = b.prepare(y)
+        assert len(idx) == 100
+        assert b.summary.results["balanced"] is False
+
+    def test_cutter_drops_rare_labels(self):
+        y = np.array([0] * 50 + [1] * 45 + [2] * 5, dtype=float)
+        c = DataCutter(min_label_fraction=0.1)
+        idx = c.prepare(y)
+        assert set(y[idx]) == {0.0, 1.0}
+        assert c.summary.results["labelsDropped"] == [2.0]
+
+    def test_data_splitter_reserves(self):
+        y = np.arange(100, dtype=float)
+        tr, te = DataSplitter(reserve_test_fraction=0.1).split(y)
+        assert len(te) == 10 and len(tr) == 90
+
+
+class TestValidators:
+    def test_cv_picks_sensible_winner(self, rng):
+        X, y = _binary(rng)
+        ev = BinaryClassificationEvaluator(default_metric="AuROC")
+        cv = CrossValidation(ev, num_folds=3, stratify=True)
+        models = [
+            (LogisticRegression(),
+             [{"reg_param": 0.01}, {"reg_param": 100.0}]),
+            (LinearSVC(), [{"reg_param": 0.01}]),
+        ]
+        best = cv.validate(models, X, y)
+        # absurd regularization must not win
+        assert best.params.get("reg_param") != 100.0
+        assert len(best.results) == 3
+        assert all(len(r.metric_values) == 3 for r in best.results)
+        assert 0.5 < best.metric <= 1.0
+
+    def test_tvs_single_split(self, rng):
+        X, y = _binary(rng, n=200)
+        ev = BinaryClassificationEvaluator()
+        tvs = TrainValidationSplit(ev, train_ratio=0.75)
+        best = tvs.validate([(LogisticRegression(),
+                              [{"reg_param": 0.1}])], X, y)
+        assert len(best.results[0].metric_values) == 1
+
+    def test_smaller_is_better_metric(self, rng):
+        X = rng.normal(size=(200, 3))
+        y = X @ np.array([1.0, 2.0, -1.0]) + 0.05 * rng.normal(size=200)
+        ev = RegressionEvaluator()  # RMSE, smaller better
+        cv = CrossValidation(ev, num_folds=3)
+        best = cv.validate(
+            [(LinearRegression(),
+              [{"reg_param": 0.0}, {"reg_param": 1000.0}])], X, y)
+        assert best.params["reg_param"] == 0.0
+
+
+class TestModelSelector:
+    def test_binary_selector_end_to_end(self, rng):
+        X, y = _binary(rng)
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3,
+            models=[(LogisticRegression(),
+                     [{"reg_param": r} for r in (0.01, 0.1)]),
+                    (LinearSVC(), [{"reg_param": 0.01}])])
+        model = sel.fit_arrays(X, y)
+        assert isinstance(model, SelectedModel)
+        s = model.summary
+        assert s.validation_type == "CrossValidation"
+        assert s.problem_type == "BinaryClassification"
+        assert s.evaluation_metric == "AuPR"
+        assert len(s.validation_results) == 3
+        assert s.best_model_name in ("LogisticRegression", "LinearSVC")
+        assert s.train_evaluation is not None
+        assert "Selected model" in s.pretty()
+        pred = model.predict_arrays(X)
+        assert np.mean(pred.data == y) > 0.8
+        # summary serializes
+        import json
+        json.dumps(s.to_json())
+
+    def test_selector_as_stage(self, rng):
+        """The selector is a Predictor stage: wire label+features, fit via
+        the workflow machinery."""
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+        from transmogrifai_tpu.types import OPVector, RealNN
+        from transmogrifai_tpu.utils.vector_meta import (VectorColumnMetadata,
+                                                         VectorMetadata)
+        X, y = _binary(rng, n=120)
+        label = FeatureBuilder.real_nn("y").extract(
+            lambda r: r["y"]).as_response()
+        feats = FeatureBuilder.op_vector("X").extract(
+            lambda r: r["X"]).as_predictor()
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(LogisticRegression(), [{"reg_param": 0.1}])])
+        out = sel.set_input(label, feats).get_output()
+        meta = VectorMetadata("X", tuple(
+            VectorColumnMetadata(f"x{i}", "Real") for i in range(4)))
+        ds = Dataset({"y": FeatureColumn.from_values(RealNN, list(y)),
+                      "X": FeatureColumn.vector(X, meta)})
+        model = sel.fit(ds)
+        assert model.uid == sel.uid
+        scored = model.transform_dataset(ds)
+        assert scored[out.name].n_rows == 120
+
+    def test_regression_selector(self, rng):
+        X = rng.normal(size=(200, 3))
+        y = X @ np.array([1.0, -1.0, 0.5]) + 0.1 * rng.normal(size=200)
+        sel = RegressionModelSelector.with_cross_validation(
+            models=[(LinearRegression(),
+                     [{"reg_param": 0.0}, {"reg_param": 0.1}])])
+        model = sel.fit_arrays(X, y)
+        assert model.summary.problem_type == "Regression"
+        r2 = 1 - np.sum((model.predict_arrays(X).data - y) ** 2) \
+            / np.sum((y - y.mean()) ** 2)
+        assert r2 > 0.9
+
+    def test_model_types_filter(self):
+        with pytest.raises(ValueError):
+            BinaryClassificationModelSelector.with_cross_validation(
+                model_types_to_use=["NoSuchModel"])

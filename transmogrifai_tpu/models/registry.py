@@ -1,0 +1,57 @@
+"""Default model pools for the selector factories.
+
+Tree families (RF/GBT) join these pools as they land in the zoo —
+centralizing here keeps selector/factories.py free of conditional
+imports (reference: the modelsAndParameters defaults in
+BinaryClassificationModelSelector.scala:68-128).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .base import Predictor
+
+__all__ = ["default_binary_tree_models", "default_multiclass_models",
+           "default_regression_tree_models"]
+
+
+def default_binary_tree_models() -> List[Tuple[Predictor, List[Dict]]]:
+    try:
+        from .trees import GBTClassifier, RandomForestClassifier
+    except ImportError:
+        return []
+    return [
+        (RandomForestClassifier(),
+         [{"max_depth": d, "num_trees": t, "min_instances_per_node": m}
+          for d in (3, 6, 12) for t in (10, 50) for m in (10, 100)]),
+        (GBTClassifier(),
+         [{"max_depth": d, "num_rounds": r}
+          for d in (3, 6) for r in (50, 100)]),
+    ]
+
+
+def default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
+    try:
+        from .trees import RandomForestClassifier
+    except ImportError:
+        return []
+    return [
+        (RandomForestClassifier(),
+         [{"max_depth": d, "num_trees": t}
+          for d in (3, 6, 12) for t in (10, 50)]),
+    ]
+
+
+def default_regression_tree_models() -> List[Tuple[Predictor, List[Dict]]]:
+    try:
+        from .trees import GBTRegressor, RandomForestRegressor
+    except ImportError:
+        return []
+    return [
+        (RandomForestRegressor(),
+         [{"max_depth": d, "num_trees": t}
+          for d in (3, 6, 12) for t in (10, 50)]),
+        (GBTRegressor(),
+         [{"max_depth": d, "num_rounds": r}
+          for d in (3, 6) for r in (50, 100)]),
+    ]

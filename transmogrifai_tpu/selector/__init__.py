@@ -1,0 +1,20 @@
+"""Model selection & tuning (SURVEY §2.7; core/.../selector/
+ModelSelector.scala:74 — the north-star TPU-acceleration target)."""
+from .factories import (BinaryClassificationModelSelector,
+                        MultiClassificationModelSelector,
+                        RegressionModelSelector)
+from .selector import ModelSelector, ModelSelectorSummary, SelectedModel
+from .splitters import (DataBalancer, DataCutter, DataSplitter, Splitter,
+                        SplitterSummary)
+from .validator import (BestEstimator, CrossValidation,
+                        TrainValidationSplit, ValidationResult)
+
+__all__ = [
+    "ModelSelector", "ModelSelectorSummary", "SelectedModel",
+    "BinaryClassificationModelSelector", "MultiClassificationModelSelector",
+    "RegressionModelSelector",
+    "Splitter", "SplitterSummary", "DataSplitter", "DataBalancer",
+    "DataCutter",
+    "CrossValidation", "TrainValidationSplit", "BestEstimator",
+    "ValidationResult",
+]

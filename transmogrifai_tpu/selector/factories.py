@@ -1,0 +1,150 @@
+"""Problem-typed selector factories with default model grids.
+
+TPU-native ports of the reference factories
+(core/src/main/scala/com/salesforce/op/stages/impl/classification/
+BinaryClassificationModelSelector.scala:47, MultiClassificationModelSelector
+.scala:47, .../regression/RegressionModelSelector.scala:47, default grids
+DefaultSelectorParams.scala:38-60). Model families appear in the default
+pool as they land in the zoo; ``model_types_to_use`` narrows the pool the
+same way the reference's ``modelTypesToUse`` does.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+from ..evaluators import (BinaryClassificationEvaluator, Evaluator,
+                          MultiClassificationEvaluator, RegressionEvaluator)
+from ..models import (LinearRegression, LinearSVC, LogisticRegression,
+                      Predictor)
+from .selector import ModelSelector
+from .splitters import DataBalancer, DataCutter, DataSplitter, Splitter
+from .validator import CrossValidation, TrainValidationSplit
+
+__all__ = ["BinaryClassificationModelSelector",
+           "MultiClassificationModelSelector", "RegressionModelSelector"]
+
+
+def _default_binary_models() -> List[Tuple[Predictor, List[Dict]]]:
+    """(reference BinaryClassificationModelSelector defaults :68-128;
+    grids follow DefaultSelectorParams)"""
+    from ..models import registry
+    models: List[Tuple[Predictor, List[Dict]]] = [
+        (LogisticRegression(),
+         [{"reg_param": r, "elastic_net_param": e}
+          for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
+        (LinearSVC(), [{"reg_param": r} for r in (0.01, 0.1)]),
+    ]
+    models.extend(registry.default_binary_tree_models())
+    return models
+
+
+def _default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from ..models import registry
+    models: List[Tuple[Predictor, List[Dict]]] = [
+        (LogisticRegression(),
+         [{"reg_param": r, "elastic_net_param": e}
+          for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
+    ]
+    models.extend(registry.default_multiclass_models())
+    return models
+
+
+def _default_regression_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from ..models import registry
+    models: List[Tuple[Predictor, List[Dict]]] = [
+        (LinearRegression(),
+         [{"reg_param": r, "elastic_net_param": e}
+          for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]),
+    ]
+    models.extend(registry.default_regression_tree_models())
+    return models
+
+
+def _filter_models(models, model_types_to_use):
+    if model_types_to_use is None:
+        return models
+    allowed = {t.__name__ if isinstance(t, type) else str(t)
+               for t in model_types_to_use}
+    kept = [(est, grid) for est, grid in models
+            if type(est).__name__ in allowed]
+    if not kept:
+        raise ValueError(f"No candidate models left after filtering to "
+                         f"{sorted(allowed)}")
+    return kept
+
+
+class _SelectorFactory:
+    problem_type = ""
+    default_evaluator: Type[Evaluator] = Evaluator
+    default_splitter: Type[Splitter] = Splitter
+
+    @classmethod
+    def _default_models(cls):
+        raise NotImplementedError
+
+    @classmethod
+    def with_cross_validation(cls, num_folds: int = 3, seed: int = 42,
+                              evaluator: Optional[Evaluator] = None,
+                              splitter: Optional[Splitter] = None,
+                              models: Optional[Sequence] = None,
+                              model_types_to_use: Optional[Sequence] = None,
+                              stratify: bool = False) -> ModelSelector:
+        """(reference withCrossValidation:159)"""
+        ev = evaluator or cls.default_evaluator()
+        return ModelSelector(
+            models=_filter_models(list(models or cls._default_models()),
+                                  model_types_to_use),
+            validator=CrossValidation(ev, num_folds=num_folds, seed=seed,
+                                      stratify=stratify),
+            splitter=(splitter if splitter is not None
+                      else cls.default_splitter(seed=seed)),
+            problem_type=cls.problem_type)
+
+    @classmethod
+    def with_train_validation_split(cls, train_ratio: float = 0.75,
+                                    seed: int = 42,
+                                    evaluator: Optional[Evaluator] = None,
+                                    splitter: Optional[Splitter] = None,
+                                    models: Optional[Sequence] = None,
+                                    model_types_to_use: Optional[Sequence]
+                                    = None,
+                                    stratify: bool = False) -> ModelSelector:
+        ev = evaluator or cls.default_evaluator()
+        return ModelSelector(
+            models=_filter_models(list(models or cls._default_models()),
+                                  model_types_to_use),
+            validator=TrainValidationSplit(ev, train_ratio=train_ratio,
+                                           seed=seed, stratify=stratify),
+            splitter=(splitter if splitter is not None
+                      else cls.default_splitter(seed=seed)),
+            problem_type=cls.problem_type)
+
+
+class BinaryClassificationModelSelector(_SelectorFactory):
+    problem_type = "BinaryClassification"
+    default_evaluator = BinaryClassificationEvaluator
+    default_splitter = DataBalancer
+
+    @classmethod
+    def _default_models(cls):
+        return _default_binary_models()
+
+
+class MultiClassificationModelSelector(_SelectorFactory):
+    problem_type = "MultiClassification"
+    default_evaluator = MultiClassificationEvaluator
+    default_splitter = DataCutter
+
+    @classmethod
+    def _default_models(cls):
+        return _default_multiclass_models()
+
+
+class RegressionModelSelector(_SelectorFactory):
+    problem_type = "Regression"
+    default_evaluator = RegressionEvaluator
+    default_splitter = DataSplitter
+
+    @classmethod
+    def _default_models(cls):
+        return _default_regression_models()

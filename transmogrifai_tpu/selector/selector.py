@@ -1,0 +1,158 @@
+"""ModelSelector: automated model selection — pillar #3.
+
+TPU-native port of core/src/main/scala/com/salesforce/op/stages/impl/
+selector/{ModelSelector.scala:74,136, ModelSelectorSummary.scala:59}. The
+selector is an estimator over (label, features): it prepares the data
+with an optional splitter (balance / cut), validates every candidate
+(family x grid point) under CV or TVS, refits the winner on the full
+prepared training set, and emits a ``SelectedModel`` carrying the full
+``ModelSelectorSummary`` (every model x grid x metric).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..evaluators.base import EvaluationMetrics, Evaluator
+from ..features.columns import PredictionColumn
+from ..models.base import PredictionModel, Predictor
+from .splitters import Splitter, SplitterSummary
+from .validator import BestEstimator, CrossValidation, ValidationResult, \
+    _ValidatorBase
+
+__all__ = ["ModelSelector", "SelectedModel", "ModelSelectorSummary"]
+
+
+@dataclass
+class ModelSelectorSummary:
+    """Full validation record (reference ModelSelectorSummary.scala:59)."""
+    validation_type: str = ""
+    validation_parameters: Dict = field(default_factory=dict)
+    data_prep_parameters: Dict = field(default_factory=dict)
+    data_prep_results: Dict = field(default_factory=dict)
+    evaluation_metric: str = ""
+    problem_type: str = ""
+    best_model_name: str = ""
+    best_model_uid: str = ""
+    best_model_params: Dict = field(default_factory=dict)
+    best_validation_metric: float = 0.0
+    validation_results: List[ValidationResult] = field(default_factory=list)
+    train_evaluation: Optional[EvaluationMetrics] = None
+    holdout_evaluation: Optional[EvaluationMetrics] = None
+
+    def to_json(self) -> dict:
+        return {
+            "validationType": self.validation_type,
+            "validationParameters": self.validation_parameters,
+            "dataPrepParameters": self.data_prep_parameters,
+            "dataPrepResults": self.data_prep_results,
+            "evaluationMetric": self.evaluation_metric,
+            "problemType": self.problem_type,
+            "bestModelName": self.best_model_name,
+            "bestModelUID": self.best_model_uid,
+            "bestModelParams": self.best_model_params,
+            "bestValidationMetric": self.best_validation_metric,
+            "validationResults": [r.to_json()
+                                  for r in self.validation_results],
+            "trainEvaluation": (self.train_evaluation.to_json()
+                                if self.train_evaluation else None),
+            "holdoutEvaluation": (self.holdout_evaluation.to_json()
+                                  if self.holdout_evaluation else None),
+        }
+
+    def pretty(self) -> str:
+        """Human summary (reference summaryPretty,
+        OpWorkflowModel.scala:204)."""
+        lines = [
+            f"Selected model: {self.best_model_name} "
+            f"({self.evaluation_metric}={self.best_validation_metric:.4f} "
+            f"under {self.validation_type})",
+            f"Best params: {self.best_model_params}",
+            "Validation results (mean metric per grid point):",
+        ]
+        for r in sorted(self.validation_results,
+                        key=lambda r: -r.mean_metric):
+            lines.append(f"  {r.model_name}[{r.grid_index}] "
+                         f"{r.params} -> {r.mean_metric:.4f}")
+        return "\n".join(lines)
+
+
+class SelectedModel(PredictionModel):
+    """The winning fitted model + selection summary (reference
+    SelectedModel, ModelSelector.scala:214). Delegates prediction to the
+    wrapped inner model."""
+
+    def __init__(self, inner: PredictionModel = None,
+                 summary: Optional[ModelSelectorSummary] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="modelSelector", uid=uid)
+        self.inner = inner
+        self.summary = summary
+
+    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
+        return self.inner.predict_arrays(X)
+
+
+class ModelSelector(Predictor):
+    """Run candidates x grids under a validator, pick the winner
+    (reference ModelSelector.scala:74)."""
+
+    def __init__(self,
+                 models: Sequence[Tuple[Predictor, Sequence[Dict]]] = (),
+                 validator: Optional[_ValidatorBase] = None,
+                 splitter: Optional[Splitter] = None,
+                 problem_type: str = "",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.models = list(models)
+        self.validator = validator
+        self.splitter = splitter
+        self.problem_type = problem_type
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> SelectedModel:
+        if not self.models:
+            raise ValueError("ModelSelector has no candidate models")
+        if self.validator is None:
+            raise ValueError("ModelSelector requires a validator")
+
+        # 1. data prep (reference splitter.prepare, ModelSelector.scala:152)
+        prep_params: Dict = {}
+        prep_results: Dict = {}
+        if self.splitter is not None:
+            idx = self.splitter.prepare(y)
+            Xp, yp = X[idx], y[idx]
+            summ = self.splitter.summary or SplitterSummary()
+            prep_params = summ.parameters
+            prep_results = summ.results
+        else:
+            Xp, yp = X, y
+
+        # 2. validation (reference validator.validate)
+        best: BestEstimator = self.validator.validate(self.models, Xp, yp)
+
+        # 3. refit winner on the full prepared train set
+        # (reference ModelSelector.scala:163)
+        inner = best.estimator.fit_arrays(Xp, yp)
+
+        # 4. training-set evaluation (reference :172)
+        evaluator = self.validator.evaluator
+        train_eval = evaluator.evaluate_arrays(
+            yp, inner.predict_arrays(Xp))
+
+        summary = ModelSelectorSummary(
+            validation_type=type(self.validator).__name__,
+            validation_parameters=self.validator.get_params(),
+            data_prep_parameters=prep_params,
+            data_prep_results=prep_results,
+            evaluation_metric=evaluator.default_metric,
+            problem_type=self.problem_type,
+            best_model_name=best.name,
+            best_model_uid=best.estimator.uid,
+            best_model_params=best.params,
+            best_validation_metric=best.metric,
+            validation_results=best.results,
+            train_evaluation=train_eval,
+        )
+        return SelectedModel(inner=inner, summary=summary)

@@ -1,0 +1,172 @@
+"""Data splitters: holdout reserve, class balancing, label cutting.
+
+TPU-native ports of the reference tuning splitters
+(core/src/main/scala/com/salesforce/op/stages/impl/tuning/
+{Splitter.scala:56, DataSplitter.scala:62, DataBalancer.scala:72,
+DataCutter.scala:74}). All splitters are pure index computations over the
+label vector — the feature matrix itself never moves; downstream fits
+gather rows by index (cheap on host, one device transfer after).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SplitterSummary", "Splitter", "DataSplitter", "DataBalancer",
+           "DataCutter"]
+
+
+@dataclass
+class SplitterSummary:
+    """Data-prep record attached to ModelSelectorSummary
+    (reference SplitterSummary in Splitter.scala)."""
+    splitter: str = ""
+    parameters: Dict = field(default_factory=dict)
+    results: Dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"splitter": self.splitter, "parameters": self.parameters,
+                "results": self.results}
+
+
+class Splitter:
+    """Base: optionally reserve a test fraction, then prepare (resample)
+    the training portion (reference Splitter.scala:56,64)."""
+
+    def __init__(self, reserve_test_fraction: float = 0.0, seed: int = 42):
+        if not 0.0 <= reserve_test_fraction < 1.0:
+            raise ValueError("reserve_test_fraction must be in [0, 1)")
+        self.reserve_test_fraction = reserve_test_fraction
+        self.seed = seed
+        self.summary: Optional[SplitterSummary] = None
+
+    def split(self, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(train_idx, test_idx) — stratified on the label."""
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        if self.reserve_test_fraction <= 0.0:
+            return np.arange(n), np.zeros(0, dtype=np.int64)
+        test = []
+        for cls in np.unique(y):
+            idx = np.nonzero(y == cls)[0]
+            perm = rng.permutation(idx)
+            test.extend(perm[:int(round(len(idx)
+                                        * self.reserve_test_fraction))])
+        mask = np.zeros(n, dtype=bool)
+        mask[test] = True
+        return np.nonzero(~mask)[0], np.nonzero(mask)[0]
+
+    def prepare(self, y: np.ndarray) -> np.ndarray:
+        """Row indices (possibly resampled) to train on."""
+        self.summary = SplitterSummary(splitter=type(self).__name__)
+        return np.arange(len(y))
+
+    def get_params(self) -> Dict:
+        return {"reserve_test_fraction": self.reserve_test_fraction,
+                "seed": self.seed}
+
+
+class DataSplitter(Splitter):
+    """Plain splitter for regression problems
+    (reference DataSplitter.scala:62)."""
+
+    def split(self, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        # no stratification for continuous labels
+        n = len(y)
+        if self.reserve_test_fraction <= 0.0:
+            return np.arange(n), np.zeros(0, dtype=np.int64)
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        n_test = int(round(n * self.reserve_test_fraction))
+        return np.sort(perm[n_test:]), np.sort(perm[:n_test])
+
+
+class DataBalancer(Splitter):
+    """Binary-label balancer: up-sample the minority / down-sample the
+    majority until the positive fraction reaches ``sample_fraction``
+    (reference DataBalancer.scala:72,125)."""
+
+    def __init__(self, sample_fraction: float = 0.1,
+                 max_training_sample: int = 1_000_000,
+                 reserve_test_fraction: float = 0.0, seed: int = 42):
+        super().__init__(reserve_test_fraction, seed)
+        if not 0.0 < sample_fraction < 0.5:
+            raise ValueError("sample_fraction must be in (0, 0.5)")
+        self.sample_fraction = sample_fraction
+        self.max_training_sample = max_training_sample
+
+    def prepare(self, y: np.ndarray) -> np.ndarray:
+        rng = np.random.default_rng(self.seed)
+        pos_idx = np.nonzero(y == 1)[0]
+        neg_idx = np.nonzero(y != 1)[0]
+        n_pos, n_neg = len(pos_idx), len(neg_idx)
+        small, big = ((pos_idx, neg_idx) if n_pos <= n_neg
+                      else (neg_idx, pos_idx))
+        frac = len(small) / max(len(y), 1)
+        already_balanced = frac >= self.sample_fraction
+        if already_balanced:
+            idx = np.arange(len(y))
+            if len(idx) > self.max_training_sample:
+                idx = rng.choice(idx, self.max_training_sample,
+                                 replace=False)
+            self.summary = SplitterSummary(
+                splitter="DataBalancer",
+                parameters=self.get_params(),
+                results={"positiveCount": n_pos, "negativeCount": n_neg,
+                         "balanced": False})
+            return np.sort(idx)
+        # down-sample the majority class so the minority reaches the
+        # target fraction (reference keeps all minority rows)
+        target_big = int(len(small) * (1.0 - self.sample_fraction)
+                         / self.sample_fraction)
+        big_sampled = rng.choice(big, min(target_big, len(big)),
+                                 replace=False)
+        idx = np.concatenate([small, big_sampled])
+        if len(idx) > self.max_training_sample:
+            idx = rng.choice(idx, self.max_training_sample, replace=False)
+        self.summary = SplitterSummary(
+            splitter="DataBalancer", parameters=self.get_params(),
+            results={"positiveCount": n_pos, "negativeCount": n_neg,
+                     "balanced": True,
+                     "downSampleFraction": len(big_sampled) / max(len(big), 1)})
+        return np.sort(idx)
+
+    def get_params(self) -> Dict:
+        p = super().get_params()
+        p.update({"sample_fraction": self.sample_fraction,
+                  "max_training_sample": self.max_training_sample})
+        return p
+
+
+class DataCutter(Splitter):
+    """Multiclass label cutter: drop labels with too few instances and cap
+    the number of label categories (reference DataCutter.scala:74,85)."""
+
+    def __init__(self, min_label_fraction: float = 0.0,
+                 max_label_categories: int = 100,
+                 reserve_test_fraction: float = 0.0, seed: int = 42):
+        super().__init__(reserve_test_fraction, seed)
+        if not 0.0 <= min_label_fraction < 0.5:
+            raise ValueError("min_label_fraction must be in [0, 0.5)")
+        self.min_label_fraction = min_label_fraction
+        self.max_label_categories = max_label_categories
+        self.labels_kept: Optional[np.ndarray] = None
+
+    def prepare(self, y: np.ndarray) -> np.ndarray:
+        labels, counts = np.unique(y, return_counts=True)
+        frac = counts / max(len(y), 1)
+        keep = labels[frac >= self.min_label_fraction]
+        if len(keep) > self.max_label_categories:
+            order = np.argsort(-counts[np.isin(labels, keep)])
+            keep = keep[order[:self.max_label_categories]]
+        self.labels_kept = np.sort(keep)
+        dropped = sorted(set(labels.tolist()) - set(keep.tolist()))
+        self.summary = SplitterSummary(
+            splitter="DataCutter",
+            parameters={"min_label_fraction": self.min_label_fraction,
+                        "max_label_categories": self.max_label_categories},
+            results={"labelsKept": self.labels_kept.tolist(),
+                     "labelsDropped": dropped})
+        return np.nonzero(np.isin(y, self.labels_kept))[0]

