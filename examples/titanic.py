@@ -138,7 +138,9 @@ def stratified_split(records, label_key="survived", test_fraction=0.25,
 
 def default_selector(num_folds: int = 3, seed: int = 42):
     """BinaryClassificationModelSelector with CV over the default model
-    pool (reference README.md:61-63: 3 LR + 16 RF under 3-fold CV)."""
+    pool (the reference README.md:61-63 runs 3 LR + 16 RF under 3-fold
+    CV; our pool is whatever ``default_binary_models`` currently
+    registers — linear families always, tree families once present)."""
     from transmogrifai_tpu.selector import BinaryClassificationModelSelector
     return BinaryClassificationModelSelector.with_cross_validation(
         num_folds=num_folds, seed=seed, stratify=True)

@@ -62,6 +62,11 @@ class TestSplitters:
         tr, te = DataSplitter(reserve_test_fraction=0.1).split(y)
         assert len(te) == 10 and len(tr) == 90
 
+    def test_cutter_raises_when_all_labels_dropped(self):
+        y = np.array([0] * 34 + [1] * 33 + [2] * 33, dtype=float)
+        with pytest.raises(ValueError, match="dropped every label"):
+            DataCutter(min_label_fraction=0.4).prepare(y)
+
 
 class TestValidators:
     def test_cv_picks_sensible_winner(self, rng):
@@ -87,6 +92,25 @@ class TestValidators:
         best = tvs.validate([(LogisticRegression(),
                               [{"reg_param": 0.1}])], X, y)
         assert len(best.results[0].metric_values) == 1
+
+    def test_tvs_honors_exact_ratio(self, rng):
+        X, y = _binary(rng, n=200)
+        ev = BinaryClassificationEvaluator()
+        tvs = TrainValidationSplit(ev, train_ratio=0.6)
+        (tr, va), = tvs._splits(y)
+        assert len(va) == 80 and len(tr) == 120
+
+    def test_all_nan_metrics_raise(self, rng):
+        X, y = _binary(rng, n=60)
+
+        class NanEvaluator(BinaryClassificationEvaluator):
+            def metric_from(self, metrics):
+                return float("nan")
+
+        cv = CrossValidation(NanEvaluator(), num_folds=2)
+        with pytest.raises(ValueError, match="non-finite"):
+            cv.validate([(LogisticRegression(), [{"reg_param": 0.1}])],
+                        X, y)
 
     def test_smaller_is_better_metric(self, rng):
         X = rng.normal(size=(200, 3))
@@ -159,6 +183,32 @@ class TestModelSelector:
         r2 = 1 - np.sum((model.predict_arrays(X).data - y) ** 2) \
             / np.sum((y - y.mean()) ** 2)
         assert r2 > 0.9
+
+    def test_selector_reserves_holdout(self, rng):
+        X, y = _binary(rng, n=400)
+        sel = ModelSelector(
+            models=[(LogisticRegression(), [{"reg_param": 0.1}])],
+            validator=CrossValidation(
+                BinaryClassificationEvaluator(), num_folds=2,
+                stratify=True),
+            splitter=Splitter(reserve_test_fraction=0.25),
+            problem_type="BinaryClassification")
+        model = sel.fit_arrays(X, y)
+        hold = model.summary.holdout_evaluation
+        assert hold is not None
+        assert 0.5 < hold.AuROC <= 1.0
+
+    def test_regression_pretty_ranks_winner_first(self, rng):
+        X = rng.normal(size=(200, 3))
+        y = X @ np.array([1.0, -1.0, 0.5]) + 0.1 * rng.normal(size=200)
+        sel = RegressionModelSelector.with_cross_validation(
+            models=[(LinearRegression(),
+                     [{"reg_param": 0.0}, {"reg_param": 1000.0}])])
+        model = sel.fit_arrays(X, y)
+        lines = model.summary.pretty().splitlines()
+        ranked = [ln for ln in lines if "->" in ln]
+        vals = [float(ln.rsplit("->", 1)[1]) for ln in ranked]
+        assert vals == sorted(vals)  # best (smallest RMSE) first
 
     def test_model_types_filter(self):
         with pytest.raises(ValueError):

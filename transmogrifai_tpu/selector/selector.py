@@ -41,6 +41,7 @@ class ModelSelectorSummary:
     validation_results: List[ValidationResult] = field(default_factory=list)
     train_evaluation: Optional[EvaluationMetrics] = None
     holdout_evaluation: Optional[EvaluationMetrics] = None
+    metric_larger_better: bool = True
 
     def to_json(self) -> dict:
         return {
@@ -56,6 +57,7 @@ class ModelSelectorSummary:
             "bestValidationMetric": self.best_validation_metric,
             "validationResults": [r.to_json()
                                   for r in self.validation_results],
+            "metricLargerBetter": self.metric_larger_better,
             "trainEvaluation": (self.train_evaluation.to_json()
                                 if self.train_evaluation else None),
             "holdoutEvaluation": (self.holdout_evaluation.to_json()
@@ -72,8 +74,13 @@ class ModelSelectorSummary:
             f"Best params: {self.best_model_params}",
             "Validation results (mean metric per grid point):",
         ]
-        for r in sorted(self.validation_results,
-                        key=lambda r: -r.mean_metric):
+        sign = -1.0 if self.metric_larger_better else 1.0
+
+        def rank(r):  # non-finite metrics sort last
+            m = r.mean_metric
+            return sign * m if np.isfinite(m) else np.inf
+
+        for r in sorted(self.validation_results, key=rank):
             lines.append(f"  {r.model_name}[{r.grid_index}] "
                          f"{r.params} -> {r.mean_metric:.4f}")
         return "\n".join(lines)
@@ -117,12 +124,27 @@ class ModelSelector(Predictor):
         if self.validator is None:
             raise ValueError("ModelSelector requires a validator")
 
-        # 1. data prep (reference splitter.prepare, ModelSelector.scala:152)
+        # 1. data prep (reference splitter.split + splitter.prepare,
+        # ModelSelector.scala:140-152, tuning/Splitter.scala:56,64):
+        # reserve a holdout first, then resample the training portion.
         prep_params: Dict = {}
         prep_results: Dict = {}
+        X_hold = y_hold = None
         if self.splitter is not None:
-            idx = self.splitter.prepare(y)
-            Xp, yp = X[idx], y[idx]
+            train_idx, test_idx = self.splitter.split(y)
+            if len(test_idx):
+                X_hold, y_hold = X[test_idx], y[test_idx]
+            X_tr, y_tr = X[train_idx], y[train_idx]
+            idx = self.splitter.prepare(y_tr)
+            Xp, yp = X_tr[idx], y_tr[idx]
+            kept = getattr(self.splitter, "labels_kept", None)
+            if kept is not None and X_hold is not None:
+                # score the holdout only on labels the cutter kept —
+                # the refit model cannot predict dropped classes
+                hold_mask = np.isin(y_hold, kept)
+                X_hold, y_hold = X_hold[hold_mask], y_hold[hold_mask]
+                if not len(y_hold):
+                    X_hold = y_hold = None
             summ = self.splitter.summary or SplitterSummary()
             prep_params = summ.parameters
             prep_results = summ.results
@@ -140,6 +162,10 @@ class ModelSelector(Predictor):
         evaluator = self.validator.evaluator
         train_eval = evaluator.evaluate_arrays(
             yp, inner.predict_arrays(Xp))
+        holdout_eval = None
+        if X_hold is not None:
+            holdout_eval = evaluator.evaluate_arrays(
+                y_hold, inner.predict_arrays(X_hold))
 
         summary = ModelSelectorSummary(
             validation_type=type(self.validator).__name__,
@@ -154,5 +180,7 @@ class ModelSelector(Predictor):
             best_validation_metric=best.metric,
             validation_results=best.results,
             train_evaluation=train_eval,
+            holdout_evaluation=holdout_eval,
+            metric_larger_better=evaluator.is_larger_better,
         )
         return SelectedModel(inner=inner, summary=summary)

@@ -161,6 +161,11 @@ class DataCutter(Splitter):
         if len(keep) > self.max_label_categories:
             order = np.argsort(-counts[np.isin(labels, keep)])
             keep = keep[order[:self.max_label_categories]]
+        if len(keep) == 0:
+            raise ValueError(
+                f"DataCutter dropped every label: no class reaches "
+                f"min_label_fraction={self.min_label_fraction} "
+                f"(label fractions: {dict(zip(labels.tolist(), np.round(frac, 4).tolist()))})")
         self.labels_kept = np.sort(keep)
         dropped = sorted(set(labels.tolist()) - set(keep.tolist()))
         self.summary = SplitterSummary(

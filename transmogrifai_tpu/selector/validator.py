@@ -105,7 +105,13 @@ class _ValidatorBase:
                 results.append(res)
 
         sign = 1.0 if self.evaluator.is_larger_better else -1.0
-        best = max(results, key=lambda r: sign * r.mean_metric)
+        finite = [r for r in results if np.isfinite(r.mean_metric)]
+        if not finite:
+            raise ValueError(
+                "all validation metrics are non-finite; cannot select a "
+                "model (check for degenerate folds — e.g. a fold with a "
+                "single class; stratify=True may help)")
+        best = max(finite, key=lambda r: sign * r.mean_metric)
         by_uid = {est.uid: est for est, _ in models}
         winner = by_uid[best.model_uid].with_params(**best.params)
         return BestEstimator(estimator=winner, name=best.model_name,
@@ -148,9 +154,23 @@ class TrainValidationSplit(_ValidatorBase):
         self.train_ratio = train_ratio
 
     def _splits(self, y):
-        k = max(2, int(round(1.0 / max(1e-9, 1.0 - self.train_ratio))))
-        assign = self._assignments(y, k)
-        return [(np.nonzero(assign != 0)[0], np.nonzero(assign == 0)[0])]
+        # exact single split honoring train_ratio (stratified on request)
+        rng = np.random.default_rng(self.seed)
+        val_mask = np.zeros(len(y), dtype=bool)
+        if self.stratify:
+            for cls in np.unique(y):
+                idx = rng.permutation(np.nonzero(y == cls)[0])
+                n_val = int(round(len(idx) * (1.0 - self.train_ratio)))
+                val_mask[idx[:n_val]] = True
+        else:
+            perm = rng.permutation(len(y))
+            n_val = int(round(len(y) * (1.0 - self.train_ratio)))
+            val_mask[perm[:n_val]] = True
+        if not val_mask.any() or val_mask.all():
+            raise ValueError(
+                f"train_ratio={self.train_ratio} leaves an empty train or "
+                f"validation set for n={len(y)} rows")
+        return [(np.nonzero(~val_mask)[0], np.nonzero(val_mask)[0])]
 
     def get_params(self):
         return {"trainRatio": self.train_ratio, "seed": self.seed,
