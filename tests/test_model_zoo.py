@@ -1,0 +1,108 @@
+"""MLP / NaiveBayes / GLM tests (reference
+OpMultilayerPerceptronClassifierTest, OpNaiveBayesTest,
+OpGeneralizedLinearRegressionTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import (
+    GeneralizedLinearRegression, MultilayerPerceptronClassifier, NaiveBayes)
+
+
+class TestMLP:
+    def test_learns_xor(self):
+        rng = np.random.default_rng(0)
+        X = rng.uniform(-1, 1, size=(400, 2))
+        y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float64)
+        model = MultilayerPerceptronClassifier(
+            hidden_layers=(16,), max_iter=300, seed=3).fit_arrays(X, y)
+        pred = model.predict_arrays(X).data
+        assert np.mean(pred == y) > 0.95
+
+    def test_multiclass_probabilities(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(300, 4))
+        y = np.argmax(X[:, :3], axis=1).astype(np.float64)
+        model = MultilayerPerceptronClassifier(
+            hidden_layers=(8,), max_iter=200).fit_arrays(X, y)
+        out = model.predict_arrays(X)
+        assert out.probability.shape == (300, 3)
+        np.testing.assert_allclose(out.probability.sum(axis=1), 1.0,
+                                   atol=1e-9)
+        assert np.mean(out.data == y) > 0.85
+
+
+class TestNaiveBayes:
+    def test_multinomial_counts(self):
+        rng = np.random.default_rng(2)
+        n = 500
+        y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        # class-conditional count features
+        lam = np.where(y[:, None] > 0, [5.0, 1.0, 2.0], [1.0, 5.0, 2.0])
+        X = rng.poisson(lam).astype(np.float64)
+        model = NaiveBayes(smoothing=1.0).fit_arrays(X, y)
+        pred = model.predict_arrays(X).data
+        assert np.mean(pred == y) > 0.85
+
+    def test_rejects_negative_features(self):
+        X = np.array([[1.0, -0.5], [0.0, 2.0]])
+        y = np.array([0.0, 1.0])
+        with pytest.raises(ValueError, match="non-negative"):
+            NaiveBayes().fit_arrays(X, y)
+
+    def test_bernoulli(self):
+        rng = np.random.default_rng(3)
+        n = 400
+        y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        p = np.where(y[:, None] > 0, [0.8, 0.2], [0.2, 0.8])
+        X = (rng.uniform(size=(n, 2)) < p).astype(np.float64)
+        model = NaiveBayes(model_type="bernoulli").fit_arrays(X, y)
+        assert np.mean(model.predict_arrays(X).data == y) > 0.8
+
+
+class TestGLM:
+    def test_gaussian_identity_matches_ols(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(200, 3))
+        w_true = np.array([1.5, -2.0, 0.5])
+        y = X @ w_true + 0.7 + 0.01 * rng.normal(size=200)
+        model = GeneralizedLinearRegression(family="gaussian").fit_arrays(X, y)
+        np.testing.assert_allclose(model.coefficients, w_true, atol=0.02)
+        assert model.intercept == pytest.approx(0.7, abs=0.02)
+
+    def test_poisson_log(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(800, 2)) * 0.5
+        mu = np.exp(0.4 * X[:, 0] - 0.3 * X[:, 1] + 1.0)
+        y = rng.poisson(mu).astype(np.float64)
+        model = GeneralizedLinearRegression(family="poisson").fit_arrays(X, y)
+        np.testing.assert_allclose(model.coefficients, [0.4, -0.3], atol=0.1)
+        pred = model.predict_values(X)
+        assert (pred > 0).all()
+
+    def test_binomial_logit(self):
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(600, 2))
+        p = 1 / (1 + np.exp(-(2.0 * X[:, 0] - 1.0 * X[:, 1])))
+        y = (rng.uniform(size=600) < p).astype(np.float64)
+        model = GeneralizedLinearRegression(family="binomial").fit_arrays(X, y)
+        assert model.coefficients[0] > 1.0
+        assert model.coefficients[1] < -0.3
+        pred = model.predict_values(X)
+        assert ((pred >= 0) & (pred <= 1)).all()
+
+    def test_gamma_inverse_runs(self):
+        rng = np.random.default_rng(7)
+        X = np.abs(rng.normal(size=(300, 2))) + 0.1
+        y = 1.0 / (0.5 * X[:, 0] + 0.3 * X[:, 1] + 1.0) \
+            * (1 + 0.01 * rng.normal(size=300))
+        model = GeneralizedLinearRegression(family="gamma").fit_arrays(X, y)
+        pred = model.predict_values(X)
+        assert np.isfinite(pred).all()
+
+    def test_tweedie_runs(self):
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(300, 2)) * 0.3
+        y = np.exp(X[:, 0] * 0.5 + 1.0) * (1 + 0.05 * rng.normal(size=300))
+        model = GeneralizedLinearRegression(
+            family="tweedie", variance_power=1.3).fit_arrays(X, y)
+        assert np.isfinite(model.predict_values(X)).all()

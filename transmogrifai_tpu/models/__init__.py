@@ -6,6 +6,17 @@ from .base import (ClassifierModel, PredictionModel, Predictor,
 from .linear import (LinearRegression, LinearRegressionModel, LinearSVC,
                      LinearSVCModel, LogisticRegression,
                      LogisticRegressionModel)
+from .bayes import NaiveBayes, NaiveBayesModel
+from .glm import (GeneralizedLinearRegression,
+                  GeneralizedLinearRegressionModel)
+from .mlp import (MultilayerPerceptronClassifier,
+                  MultilayerPerceptronClassifierModel)
+from .trees import (DecisionTreeClassifier, DecisionTreeRegressor,
+                    GBTClassifier, GBTClassifierModel, GBTRegressor,
+                    GBTRegressorModel, RandomForestClassifier,
+                    RandomForestRegressor, TreeEnsembleClassifierModel,
+                    TreeEnsembleRegressorModel, XGBoostClassifier,
+                    XGBoostRegressor)
 
 __all__ = [
     "Predictor", "PredictionModel", "ClassifierModel", "RegressionModel",
@@ -13,4 +24,13 @@ __all__ = [
     "LogisticRegression", "LogisticRegressionModel",
     "LinearRegression", "LinearRegressionModel",
     "LinearSVC", "LinearSVCModel",
+    "DecisionTreeClassifier", "DecisionTreeRegressor",
+    "RandomForestClassifier", "RandomForestRegressor",
+    "GBTClassifier", "GBTClassifierModel",
+    "GBTRegressor", "GBTRegressorModel",
+    "XGBoostClassifier", "XGBoostRegressor",
+    "TreeEnsembleClassifierModel", "TreeEnsembleRegressorModel",
+    "NaiveBayes", "NaiveBayesModel",
+    "GeneralizedLinearRegression", "GeneralizedLinearRegressionModel",
+    "MultilayerPerceptronClassifier", "MultilayerPerceptronClassifierModel",
 ]

@@ -1,0 +1,82 @@
+"""Naive Bayes classifier.
+
+TPU-native replacement for the reference's OpNaiveBayes
+(core/.../classification/OpNaiveBayes.scala), wrapping MLlib NaiveBayes
+(multinomial or bernoulli model type, additive smoothing). The fit is a
+pair of segment-sums over class labels — one XLA program, no iteration.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ClassifierModel, Predictor
+
+__all__ = ["NaiveBayes", "NaiveBayesModel"]
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes", "model_type"))
+def _fit_nb(X, y, smoothing, *, num_classes: int, model_type: str):
+    labels = y.astype(jnp.int32)
+    counts = jax.ops.segment_sum(jnp.ones_like(y), labels,
+                                 num_segments=num_classes)
+    pi = jnp.log(counts) - jnp.log(jnp.sum(counts))
+    if model_type == "bernoulli":
+        X = (X != 0).astype(X.dtype)
+    feat = jax.ops.segment_sum(X, labels, num_segments=num_classes)  # (K, d)
+    if model_type == "bernoulli":
+        theta = (jnp.log(feat + smoothing)
+                 - jnp.log(counts[:, None] + 2.0 * smoothing))
+    else:  # multinomial
+        theta = (jnp.log(feat + smoothing)
+                 - jnp.log(jnp.sum(feat, axis=1, keepdims=True)
+                           + smoothing * X.shape[1]))
+    return pi, theta
+
+
+class NaiveBayes(Predictor):
+    """Multinomial/Bernoulli naive Bayes (reference OpNaiveBayes.scala).
+    Requires non-negative features, as in MLlib."""
+
+    def __init__(self, smoothing: float = 1.0,
+                 model_type: str = "multinomial",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.smoothing = smoothing
+        self.model_type = model_type
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> "NaiveBayesModel":
+        if (X < 0).any():
+            raise ValueError("NaiveBayes requires non-negative features")
+        k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+        pi, theta = _fit_nb(jnp.asarray(X), jnp.asarray(y),
+                            jnp.asarray(self.smoothing, dtype=jnp.float64),
+                            num_classes=k, model_type=self.model_type)
+        return NaiveBayesModel(pi=np.asarray(pi), theta=np.asarray(theta),
+                               model_type=self.model_type)
+
+
+class NaiveBayesModel(ClassifierModel):
+    def __init__(self, pi, theta, model_type: str = "multinomial",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.pi = np.asarray(pi, dtype=np.float64)          # (K,)
+        self.theta = np.asarray(theta, dtype=np.float64)    # (K, d)
+        self.model_type = model_type
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        if self.model_type == "bernoulli":
+            Xb = (X != 0).astype(np.float64)
+            neg = np.log1p(-np.minimum(np.exp(self.theta), 1 - 1e-12))
+            return (self.pi + Xb @ self.theta.T
+                    + (1.0 - Xb) @ neg.T)
+        return self.pi + X @ self.theta.T
+
+    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        raw = raw - np.max(raw, axis=1, keepdims=True)
+        e = np.exp(raw)
+        return e / np.sum(e, axis=1, keepdims=True)

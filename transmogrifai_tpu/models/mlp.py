@@ -1,0 +1,104 @@
+"""Multilayer perceptron classifier.
+
+TPU-native replacement for the reference's
+OpMultilayerPerceptronClassifier (core/.../classification/
+OpMultilayerPerceptronClassifier.scala:48), which wraps MLlib's
+feed-forward network (sigmoid hidden layers, softmax output, L-BFGS
+solver on the stacked-weights vector). Here the network is a direct JAX
+pytree of per-layer (W, b), the loss is cross-entropy, and the solver is
+the shared optax L-BFGS program (models/solvers.py) — the whole fit is
+one XLA program, all matmuls on the MXU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ClassifierModel, Predictor
+from .solvers import lbfgs_minimize
+
+__all__ = ["MultilayerPerceptronClassifier",
+           "MultilayerPerceptronClassifierModel"]
+
+
+def _init_params(key, sizes: Tuple[int, ...], dtype):
+    """MLlib-style scaled uniform init per layer."""
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(6.0 / (fan_in + fan_out)).astype(dtype)
+        W = jax.random.uniform(sub, (fan_in, fan_out), dtype,
+                               minval=-scale, maxval=scale)
+        params.append((W, jnp.zeros((fan_out,), dtype)))
+    return params
+
+
+def _forward(params, X):
+    """Sigmoid hidden layers, raw logits at the top (MLlib topology)."""
+    h = X
+    for W, b in params[:-1]:
+        h = jax.nn.sigmoid(h @ W + b)
+    W, b = params[-1]
+    return h @ W + b
+
+
+@functools.partial(jax.jit, static_argnames=("sizes", "max_iter"))
+def _fit_mlp(X, y, key, *, sizes: Tuple[int, ...], max_iter: int):
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), sizes[-1], dtype=X.dtype)
+
+    def loss(params):
+        logits = _forward(params, X)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=1))
+
+    params0 = _init_params(key, sizes, X.dtype)
+    return lbfgs_minimize(loss, params0, max_iter=max_iter)
+
+
+class MultilayerPerceptronClassifier(Predictor):
+    """Feed-forward classifier (reference
+    OpMultilayerPerceptronClassifier.scala:48). ``hidden_layers`` are the
+    intermediate layer widths; input/output widths come from the data."""
+
+    def __init__(self, hidden_layers: Sequence[int] = (10,),
+                 max_iter: int = 100, tol: float = 1e-6, seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.hidden_layers = tuple(int(h) for h in hidden_layers)
+        self.max_iter = max_iter
+        self.tol = tol
+        self.seed = seed
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray
+                   ) -> "MultilayerPerceptronClassifierModel":
+        k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+        sizes = (X.shape[1],) + self.hidden_layers + (k,)
+        params = _fit_mlp(jnp.asarray(X), jnp.asarray(y),
+                          jax.random.PRNGKey(self.seed), sizes=sizes,
+                          max_iter=self.max_iter)
+        weights = [np.asarray(W) for W, _ in params]
+        biases = [np.asarray(b) for _, b in params]
+        return MultilayerPerceptronClassifierModel(weights=weights,
+                                                   biases=biases)
+
+
+class MultilayerPerceptronClassifierModel(ClassifierModel):
+    def __init__(self, weights: List[np.ndarray], biases: List[np.ndarray],
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.weights = [np.asarray(W, dtype=np.float64) for W in weights]
+        self.biases = [np.asarray(b, dtype=np.float64) for b in biases]
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        h = X
+        for W, b in zip(self.weights[:-1], self.biases[:-1]):
+            h = 1.0 / (1.0 + np.exp(-(h @ W + b)))
+        return h @ self.weights[-1] + self.biases[-1]
+
+    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        raw = raw - np.max(raw, axis=1, keepdims=True)
+        e = np.exp(raw)
+        return e / np.sum(e, axis=1, keepdims=True)

@@ -1,9 +1,11 @@
 """Default model pools for the selector factories.
 
-Tree families (RF/GBT) join these pools as they land in the zoo —
-centralizing here keeps selector/factories.py free of conditional
-imports (reference: the modelsAndParameters defaults in
-BinaryClassificationModelSelector.scala:68-128).
+Centralizes the per-problem-type candidate pools + hyperparameter grids
+(reference: the modelsAndParameters defaults in
+BinaryClassificationModelSelector.scala:68-128,
+MultiClassificationModelSelector.scala:138-183,
+RegressionModelSelector.scala:150-193, grid values from
+DefaultSelectorParams.scala:38-60).
 """
 from __future__ import annotations
 
@@ -11,15 +13,14 @@ from typing import Dict, List, Tuple
 
 from .base import Predictor
 
-__all__ = ["default_binary_tree_models", "default_multiclass_models",
-           "default_regression_tree_models"]
+__all__ = ["default_binary_extra_models", "default_multiclass_extra_models",
+           "default_regression_extra_models"]
 
 
-def default_binary_tree_models() -> List[Tuple[Predictor, List[Dict]]]:
-    try:
-        from .trees import GBTClassifier, RandomForestClassifier
-    except ImportError:
-        return []
+def default_binary_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from .bayes import NaiveBayes
+    from .trees import (DecisionTreeClassifier, GBTClassifier,
+                        RandomForestClassifier)
     return [
         (RandomForestClassifier(),
          [{"max_depth": d, "num_trees": t, "min_instances_per_node": m}
@@ -27,26 +28,31 @@ def default_binary_tree_models() -> List[Tuple[Predictor, List[Dict]]]:
         (GBTClassifier(),
          [{"max_depth": d, "num_rounds": r}
           for d in (3, 6) for r in (50, 100)]),
+        (DecisionTreeClassifier(),
+         [{"max_depth": d, "min_instances_per_node": m}
+          for d in (3, 6, 12) for m in (10, 100)]),
+        (NaiveBayes(), [{"smoothing": 1.0}]),
     ]
 
 
-def default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
-    try:
-        from .trees import RandomForestClassifier
-    except ImportError:
-        return []
+def default_multiclass_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from .bayes import NaiveBayes
+    from .trees import DecisionTreeClassifier, RandomForestClassifier
     return [
         (RandomForestClassifier(),
          [{"max_depth": d, "num_trees": t}
           for d in (3, 6, 12) for t in (10, 50)]),
+        (DecisionTreeClassifier(),
+         [{"max_depth": d, "min_instances_per_node": m}
+          for d in (3, 6, 12) for m in (10, 100)]),
+        (NaiveBayes(), [{"smoothing": 1.0}]),
     ]
 
 
-def default_regression_tree_models() -> List[Tuple[Predictor, List[Dict]]]:
-    try:
-        from .trees import GBTRegressor, RandomForestRegressor
-    except ImportError:
-        return []
+def default_regression_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from .glm import GeneralizedLinearRegression
+    from .trees import (DecisionTreeRegressor, GBTRegressor,
+                        RandomForestRegressor)
     return [
         (RandomForestRegressor(),
          [{"max_depth": d, "num_trees": t}
@@ -54,4 +60,9 @@ def default_regression_tree_models() -> List[Tuple[Predictor, List[Dict]]]:
         (GBTRegressor(),
          [{"max_depth": d, "num_rounds": r}
           for d in (3, 6) for r in (50, 100)]),
+        (DecisionTreeRegressor(),
+         [{"max_depth": d, "min_instances_per_node": m}
+          for d in (3, 6, 12) for m in (10, 100)]),
+        (GeneralizedLinearRegression(),
+         [{"family": "gaussian", "reg_param": r} for r in (0.001, 0.01, 0.1)]),
     ]

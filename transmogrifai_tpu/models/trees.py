@@ -1,0 +1,708 @@
+"""Tree model family: decision tree, random forest, gradient-boosted trees.
+
+TPU-native replacements for the reference's Spark MLlib / XGBoost wrappers:
+- OpDecisionTreeClassifier / OpDecisionTreeRegressor
+  (core/.../classification/OpDecisionTreeClassifier.scala,
+   core/.../regression/OpDecisionTreeRegressor.scala)
+- OpRandomForestClassifier / OpRandomForestRegressor
+  (core/.../classification/OpRandomForestClassifier.scala)
+- OpGBTClassifier / OpGBTRegressor
+  (core/.../classification/OpGBTClassifier.scala)
+- OpXGBoostClassifier / OpXGBoostRegressor
+  (core/.../classification/OpXGBoostClassifier.scala:47 — xgboost4j JNI,
+   the reference's only native-C++ compute; see SURVEY.md §2.9)
+
+Design (histogram GBDT, XLA-first — no CUDA/Rabit translation):
+
+- Features are quantile-binned once into <= ``max_bins`` integer bins
+  (MLlib ``maxBins``/XGBoost ``tree_method=hist`` equivalent).
+- Trees grow **level-wise over a dense complete binary tree** of static
+  depth: every level computes per-(node, feature, bin) statistic
+  histograms via ``segment_sum`` (a ``lax.scan`` over features keeps
+  memory at O(n*S)), turns them into split gains with one cumulative
+  sum over bins, and advances every row one level. No data-dependent
+  shapes anywhere, so the whole builder jits into one XLA program;
+  a forest is a ``lax.scan`` of that program over bootstrap keys and
+  boosting is a ``lax.scan`` of it over rounds with margin updates.
+- Nodes that fail the gain/min-weight checks emit a +inf threshold
+  ("everything goes left"), which makes dead branches self-propagating
+  without ragged control flow.
+- Split histograms sum 2nd-order grad/hess stats (XGBoost objective)
+  or class-count/variance stats (MLlib gini/variance impurity).
+
+Distributed fit: histograms are linear in rows, so data-parallel
+multi-chip training is a ``psum`` of per-shard histograms over ICI —
+the TPU equivalent of XGBoost's Rabit allreduce (see parallel/cv.py for
+the mesh machinery). The builders here take already-materialized
+device arrays and are safe to call inside ``shard_map``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..features.columns import PredictionColumn
+from .base import ClassifierModel, Predictor, RegressionModel
+
+__all__ = [
+    "DecisionTreeClassifier", "DecisionTreeRegressor",
+    "RandomForestClassifier", "RandomForestRegressor",
+    "GBTClassifier", "GBTRegressor",
+    "XGBoostClassifier", "XGBoostRegressor",
+    "TreeEnsembleClassifierModel", "TreeEnsembleRegressorModel",
+    "GBTClassifierModel", "GBTRegressorModel",
+]
+
+
+# ---------------------------------------------------------------------------
+# binning
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_bins",))
+def _quantile_edges(X: jnp.ndarray, max_bins: int) -> jnp.ndarray:
+    """Per-feature quantile cut points, shape (d, B-1). Duplicated edges
+    (constant features) just leave some bins empty."""
+    qs = jnp.linspace(0.0, 1.0, max_bins + 1)[1:-1]
+    return jnp.quantile(X, qs, axis=0).T
+
+
+@jax.jit
+def _bin_matrix(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """bin(x) = #{edges < x} so that bin(x) <= b  <=>  x <= edges[b]."""
+    def col(xc, ec):
+        return jnp.searchsorted(ec, xc, side="left")
+    return jax.vmap(col, in_axes=(1, 0), out_axes=1)(X, edges).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# generic level-wise tree builder
+# ---------------------------------------------------------------------------
+
+def _level_histograms(binned_T: jnp.ndarray, node: jnp.ndarray,
+                      stats: jnp.ndarray, num_nodes: int,
+                      max_bins: int) -> jnp.ndarray:
+    """(d, num_nodes, B, S) histograms; scan over features bounds memory."""
+    def per_feat(_, bcol):
+        seg = node * max_bins + bcol
+        h = jax.ops.segment_sum(stats, seg,
+                                num_segments=num_nodes * max_bins)
+        return None, h.reshape(num_nodes, max_bins, -1)
+    _, hists = jax.lax.scan(per_feat, None, binned_T)
+    return hists
+
+
+def _grow_tree(binned: jnp.ndarray, stats: jnp.ndarray, edges: jnp.ndarray,
+               *, depth: int, max_bins: int, gain_fn, min_info_gain: float,
+               feat_key: Optional[jnp.ndarray] = None,
+               max_features: Optional[int] = None):
+    """Grow one complete tree of static ``depth``.
+
+    gain_fn(left, right, total) -> (..., ) gains with -inf where a split
+    is invalid; ``left/right/total`` are stat tensors with trailing dim S.
+
+    Returns (feat_heap (2^depth - 1,), thr_heap (2^depth - 1,),
+    leaf_stats (2^depth, S), final node assignment (n,)).
+    """
+    n, d = binned.shape
+    binned_T = binned.T
+    node = jnp.zeros((n,), jnp.int32)
+    feats_levels, thr_levels = [], []
+    key = feat_key
+    for level in range(depth):
+        num_nodes = 2 ** level
+        hist = _level_histograms(binned_T, node, stats, num_nodes, max_bins)
+        hist = jnp.moveaxis(hist, 0, 1)          # (nodes, d, B, S)
+        left = jnp.cumsum(hist, axis=2)           # split at b: bins<=b left
+        total = left[:, 0:1, -1:, :]              # (nodes,1,1,S)
+        right = total - left
+        gain = gain_fn(left, right, total)        # (nodes, d, B)
+        # the last bin puts everything left — not a split
+        gain = gain.at[:, :, -1].set(-jnp.inf)
+        if max_features is not None and max_features < d:
+            key, sub = jax.random.split(key)
+            u = jax.random.uniform(sub, (num_nodes, d))
+            kth = jnp.sort(u, axis=1)[:, max_features - 1:max_features]
+            gain = jnp.where((u <= kth)[:, :, None], gain, -jnp.inf)
+        flat = gain.reshape(num_nodes, d * max_bins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bfeat = (best // max_bins).astype(jnp.int32)
+        bbin = (best % max_bins).astype(jnp.int32)
+        split_ok = best_gain >= jnp.maximum(min_info_gain, 1e-12)
+        bfeat = jnp.where(split_ok, bfeat, 0)
+        bbin = jnp.where(split_ok, bbin, max_bins - 1)
+        thr = jnp.where(bbin >= max_bins - 1, jnp.inf, edges[bfeat, jnp.minimum(bbin, max_bins - 2)])
+        feats_levels.append(bfeat)
+        thr_levels.append(thr)
+        go_left = binned[jnp.arange(n), bfeat[node]] <= bbin[node]
+        node = 2 * node + (1 - go_left.astype(jnp.int32))  # within-level idx
+    leaf_stats = jax.ops.segment_sum(stats, node, num_segments=2 ** depth)
+    feat_heap = jnp.concatenate(feats_levels) if depth else jnp.zeros((0,), jnp.int32)
+    thr_heap = jnp.concatenate(thr_levels) if depth else jnp.zeros((0,))
+    return feat_heap, thr_heap, leaf_stats, node
+
+
+def _traverse(X: jnp.ndarray, feat_heap: jnp.ndarray, thr_heap: jnp.ndarray,
+              depth: int) -> jnp.ndarray:
+    """Leaf index in [0, 2^depth) for every row; static-depth descent."""
+    n = X.shape[0]
+    node = jnp.zeros((n,), jnp.int32)
+    rows = jnp.arange(n)
+    for level in range(depth):
+        heap = 2 ** level - 1 + node     # levels concatenate into the heap
+        f = feat_heap[heap]
+        t = thr_heap[heap]
+        go_left = X[rows, f] <= t
+        node = 2 * node + (1 - go_left.astype(jnp.int32))
+    return node
+
+
+# ---------------------------------------------------------------------------
+# split criteria
+# ---------------------------------------------------------------------------
+
+def _xgb_gain(reg_lambda: float, gamma: float, min_child_weight: float):
+    """Second-order gain (stats = [grad, hess]); XGBoost objective."""
+    def gain(left, right, total):
+        def score(s):
+            return s[..., 0] ** 2 / (s[..., 1] + reg_lambda)
+        g = 0.5 * (score(left) + score(right) - score(total)) - gamma
+        ok = ((left[..., 1] >= min_child_weight)
+              & (right[..., 1] >= min_child_weight))
+        return jnp.where(ok, g, -jnp.inf)
+    return gain
+
+
+def _gini_gain(min_instances: float):
+    """Weighted gini impurity gain (stats = per-class weights); MLlib
+    'gini' impurity, tree/impurity/Gini in Spark MLlib."""
+    def impurity_weighted(s):               # sum_c s_c - sum_c s_c^2 / w
+        w = jnp.sum(s, axis=-1)
+        return w - jnp.sum(s * s, axis=-1) / jnp.maximum(w, 1e-12)
+    def gain(left, right, total):
+        wl = jnp.sum(left, axis=-1)
+        wr = jnp.sum(right, axis=-1)
+        wp = jnp.maximum(jnp.sum(total, axis=-1), 1e-12)
+        g = (impurity_weighted(total) - impurity_weighted(left)
+             - impurity_weighted(right)) / wp
+        ok = (wl >= min_instances) & (wr >= min_instances)
+        return jnp.where(ok, g, -jnp.inf)
+    return gain
+
+
+def _entropy_gain(min_instances: float):
+    def impurity_weighted(s):
+        w = jnp.maximum(jnp.sum(s, axis=-1, keepdims=True), 1e-12)
+        p = s / w
+        ent = -jnp.sum(jnp.where(s > 0, p * jnp.log(p), 0.0), axis=-1)
+        return w[..., 0] * ent
+    def gain(left, right, total):
+        wl = jnp.sum(left, axis=-1)
+        wr = jnp.sum(right, axis=-1)
+        wp = jnp.maximum(jnp.sum(total, axis=-1), 1e-12)
+        g = (impurity_weighted(total) - impurity_weighted(left)
+             - impurity_weighted(right)) / wp
+        ok = (wl >= min_instances) & (wr >= min_instances)
+        return jnp.where(ok, g, -jnp.inf)
+    return gain
+
+
+def _variance_gain(min_instances: float):
+    """SSE-reduction gain (stats = [w, wy, wyy]); MLlib 'variance'."""
+    def sse(s):
+        return s[..., 2] - s[..., 1] ** 2 / jnp.maximum(s[..., 0], 1e-12)
+    def gain(left, right, total):
+        wp = jnp.maximum(total[..., 0], 1e-12)
+        g = (sse(total) - sse(left) - sse(right)) / wp
+        ok = ((left[..., 0] >= min_instances)
+              & (right[..., 0] >= min_instances))
+        return jnp.where(ok, g, -jnp.inf)
+    return gain
+
+
+# ---------------------------------------------------------------------------
+# jitted fit programs
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit, static_argnames=("depth", "max_bins", "num_classes", "num_trees",
+                              "max_features", "impurity", "bootstrap"))
+def _fit_forest_classifier(X, y, key, *, depth: int, max_bins: int,
+                           num_classes: int, num_trees: int,
+                           max_features: Optional[int], impurity: str,
+                           min_instances: float, min_info_gain: float,
+                           subsample: float, bootstrap: bool):
+    n, d = X.shape
+    edges = _quantile_edges(X, max_bins)
+    binned = _bin_matrix(X, edges)
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=X.dtype)
+    gain_fn = (_gini_gain(min_instances) if impurity == "gini"
+               else _entropy_gain(min_instances))
+
+    def one_tree(carry, tkey):
+        wkey, fkey = jax.random.split(tkey)
+        if bootstrap:
+            w = jax.random.poisson(wkey, subsample, (n,)).astype(X.dtype)
+        else:
+            w = jnp.ones((n,), X.dtype)
+        feat, thr, leaf_stats, _ = _grow_tree(
+            binned, onehot * w[:, None], edges, depth=depth,
+            max_bins=max_bins, gain_fn=gain_fn,
+            min_info_gain=min_info_gain, feat_key=fkey,
+            max_features=max_features)
+        lw = jnp.sum(leaf_stats, axis=-1, keepdims=True)
+        probs = jnp.where(lw > 0, leaf_stats / jnp.maximum(lw, 1e-12),
+                          1.0 / num_classes)
+        return carry, (feat, thr, probs)
+    _, (feats, thrs, leaves) = jax.lax.scan(
+        one_tree, None, jax.random.split(key, num_trees))
+    return feats, thrs, leaves
+
+
+@functools.partial(
+    jax.jit, static_argnames=("depth", "max_bins", "num_trees",
+                              "max_features", "bootstrap"))
+def _fit_forest_regressor(X, y, key, *, depth: int, max_bins: int,
+                          num_trees: int, max_features: Optional[int],
+                          min_instances: float, min_info_gain: float,
+                          subsample: float, bootstrap: bool):
+    n, d = X.shape
+    edges = _quantile_edges(X, max_bins)
+    binned = _bin_matrix(X, edges)
+    gain_fn = _variance_gain(min_instances)
+
+    def one_tree(carry, tkey):
+        wkey, fkey = jax.random.split(tkey)
+        if bootstrap:
+            w = jax.random.poisson(wkey, subsample, (n,)).astype(X.dtype)
+        else:
+            w = jnp.ones((n,), X.dtype)
+        stats = jnp.stack([w, w * y, w * y * y], axis=1)
+        feat, thr, leaf_stats, _ = _grow_tree(
+            binned, stats, edges, depth=depth, max_bins=max_bins,
+            gain_fn=gain_fn, min_info_gain=min_info_gain, feat_key=fkey,
+            max_features=max_features)
+        vals = leaf_stats[:, 1] / jnp.maximum(leaf_stats[:, 0], 1e-12)
+        return carry, (feat, thr, vals)
+    _, (feats, thrs, leaves) = jax.lax.scan(
+        one_tree, None, jax.random.split(key, num_trees))
+    return feats, thrs, leaves
+
+
+@functools.partial(
+    jax.jit, static_argnames=("depth", "max_bins", "num_rounds", "objective",
+                              "subsample"))
+def _fit_gbt(X, y, key, *, depth: int, max_bins: int, num_rounds: int,
+             step_size: float, reg_lambda: float, gamma: float,
+             min_child_weight: float, subsample: float, objective: str):
+    n, d = X.shape
+    edges = _quantile_edges(X, max_bins)
+    binned = _bin_matrix(X, edges)
+    gain_fn = _xgb_gain(reg_lambda, gamma, min_child_weight)
+    if objective == "logistic":
+        p0 = jnp.clip(jnp.mean(y), 1e-6, 1 - 1e-6)
+        base = jnp.log(p0 / (1 - p0))
+    else:
+        base = jnp.mean(y)
+    margins0 = jnp.full((n,), base, X.dtype)
+
+    def one_round(carry, rkey):
+        margins = carry
+        if objective == "logistic":
+            p = jax.nn.sigmoid(margins)
+            g, h = p - y, jnp.maximum(p * (1 - p), 1e-12)
+        else:
+            g, h = margins - y, jnp.ones_like(y)
+        if subsample < 1.0:
+            m = jax.random.bernoulli(rkey, subsample, (n,)).astype(X.dtype)
+            g, h = g * m, h * m
+        feat, thr, leaf_stats, node = _grow_tree(
+            binned, jnp.stack([g, h], axis=1), edges, depth=depth,
+            max_bins=max_bins, gain_fn=gain_fn, min_info_gain=0.0)
+        vals = -step_size * leaf_stats[:, 0] / (leaf_stats[:, 1] + reg_lambda)
+        vals = jnp.where(jnp.sum(jnp.abs(leaf_stats), axis=1) > 0, vals, 0.0)
+        margins = margins + vals[node]
+        return margins, (feat, thr, vals)
+    _, (feats, thrs, leaves) = jax.lax.scan(
+        one_round, margins0, jax.random.split(key, num_rounds))
+    return feats, thrs, leaves, base
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _predict_leaves(X, feats, thrs, depth: int):
+    """(T, n) leaf index per tree via vmapped static-depth traversal."""
+    return jax.vmap(lambda f, t: _traverse(X, f, t, depth))(feats, thrs)
+
+
+# ---------------------------------------------------------------------------
+# fitted models
+# ---------------------------------------------------------------------------
+
+class TreeEnsembleClassifierModel(ClassifierModel):
+    """RF/DT classifier model: averages per-tree leaf class distributions
+    (reference RandomForestClassificationModel normalized vote averaging)."""
+
+    def __init__(self, feats, thrs, leaves, depth: int,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.feats = np.asarray(feats, dtype=np.int32)
+        self.thrs = np.asarray(thrs, dtype=np.float64)
+        self.leaves = np.asarray(leaves, dtype=np.float64)  # (T, L, K)
+        self.depth = int(depth)
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        leaf_idx = np.asarray(_predict_leaves(
+            jnp.asarray(X), jnp.asarray(self.feats),
+            jnp.asarray(self.thrs), self.depth))              # (T, n)
+        probs = self.leaves[np.arange(len(self.feats))[:, None], leaf_idx]
+        return np.mean(probs, axis=0)                          # (n, K)
+
+    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        s = np.sum(raw, axis=1, keepdims=True)
+        return raw / np.where(s > 0, s, 1.0)
+
+    @property
+    def feature_importances(self) -> np.ndarray:
+        return _split_count_importances(self.feats, self.thrs)
+
+
+class TreeEnsembleRegressorModel(RegressionModel):
+    def __init__(self, feats, thrs, leaves, depth: int,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.feats = np.asarray(feats, dtype=np.int32)
+        self.thrs = np.asarray(thrs, dtype=np.float64)
+        self.leaves = np.asarray(leaves, dtype=np.float64)  # (T, L)
+        self.depth = int(depth)
+
+    def predict_values(self, X: np.ndarray) -> np.ndarray:
+        leaf_idx = np.asarray(_predict_leaves(
+            jnp.asarray(X), jnp.asarray(self.feats),
+            jnp.asarray(self.thrs), self.depth))
+        vals = self.leaves[np.arange(len(self.feats))[:, None], leaf_idx]
+        return np.mean(vals, axis=0)
+
+    @property
+    def feature_importances(self) -> np.ndarray:
+        return _split_count_importances(self.feats, self.thrs)
+
+
+class GBTClassifierModel(ClassifierModel):
+    """Boosted binary classifier: sigmoid over summed leaf margins."""
+
+    def __init__(self, feats, thrs, leaves, depth: int, base: float = 0.0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.feats = np.asarray(feats, dtype=np.int32)
+        self.thrs = np.asarray(thrs, dtype=np.float64)
+        self.leaves = np.asarray(leaves, dtype=np.float64)
+        self.depth = int(depth)
+        self.base = float(base)
+
+    def margins(self, X: np.ndarray) -> np.ndarray:
+        leaf_idx = np.asarray(_predict_leaves(
+            jnp.asarray(X), jnp.asarray(self.feats),
+            jnp.asarray(self.thrs), self.depth))
+        vals = self.leaves[np.arange(len(self.feats))[:, None], leaf_idx]
+        return self.base + np.sum(vals, axis=0)
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        m = self.margins(X)
+        return np.stack([-m, m], axis=1)
+
+    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
+        p = 1.0 / (1.0 + np.exp(-raw[:, 1]))
+        return np.stack([1 - p, p], axis=1)
+
+    @property
+    def feature_importances(self) -> np.ndarray:
+        return _split_count_importances(self.feats, self.thrs)
+
+
+class GBTRegressorModel(RegressionModel):
+    def __init__(self, feats, thrs, leaves, depth: int, base: float = 0.0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.feats = np.asarray(feats, dtype=np.int32)
+        self.thrs = np.asarray(thrs, dtype=np.float64)
+        self.leaves = np.asarray(leaves, dtype=np.float64)
+        self.depth = int(depth)
+        self.base = float(base)
+
+    def predict_values(self, X: np.ndarray) -> np.ndarray:
+        leaf_idx = np.asarray(_predict_leaves(
+            jnp.asarray(X), jnp.asarray(self.feats),
+            jnp.asarray(self.thrs), self.depth))
+        vals = self.leaves[np.arange(len(self.feats))[:, None], leaf_idx]
+        return self.base + np.sum(vals, axis=0)
+
+    @property
+    def feature_importances(self) -> np.ndarray:
+        return _split_count_importances(self.feats, self.thrs)
+
+
+def _split_count_importances(feats: np.ndarray, thrs: np.ndarray) -> np.ndarray:
+    """Normalized real-split counts per feature (a threshold of +inf marks
+    a dead/no-split node)."""
+    real = np.isfinite(thrs)
+    if feats.size == 0 or not real.any():
+        return np.zeros(0)
+    d = int(feats.max()) + 1
+    counts = np.bincount(feats[real].ravel(), minlength=d).astype(np.float64)
+    total = counts.sum()
+    return counts / total if total > 0 else counts
+
+
+# ---------------------------------------------------------------------------
+# estimators
+# ---------------------------------------------------------------------------
+
+def _resolve_max_features(strategy: str, d: int, classification: bool
+                          ) -> Optional[int]:
+    """MLlib featureSubsetStrategy (RandomForestParams)."""
+    s = str(strategy).lower()
+    if s == "auto":
+        s = "sqrt" if classification else "onethird"
+    if s == "all":
+        return None
+    if s == "sqrt":
+        return max(1, int(np.sqrt(d)))
+    if s == "log2":
+        return max(1, int(np.log2(d)))
+    if s == "onethird":
+        return max(1, d // 3)
+    return max(1, min(d, int(float(s) * d) if "." in s else int(s)))
+
+
+class _ForestClassifierBase(Predictor):
+    num_trees = 1
+    bootstrap = False
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray
+                   ) -> TreeEnsembleClassifierModel:
+        k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+        d = X.shape[1]
+        mf = _resolve_max_features(self.feature_subset_strategy, d, True) \
+            if self.bootstrap else None
+        feats, thrs, leaves = _fit_forest_classifier(
+            jnp.asarray(X), jnp.asarray(y),
+            jax.random.PRNGKey(self.seed), depth=self.max_depth,
+            max_bins=self.max_bins, num_classes=k,
+            num_trees=self.num_trees, max_features=mf,
+            impurity=self.impurity,
+            min_instances=float(self.min_instances_per_node),
+            min_info_gain=self.min_info_gain,
+            subsample=self.subsampling_rate, bootstrap=self.bootstrap)
+        return TreeEnsembleClassifierModel(feats, thrs, leaves,
+                                           depth=self.max_depth)
+
+
+class _ForestRegressorBase(Predictor):
+    num_trees = 1
+    bootstrap = False
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray
+                   ) -> TreeEnsembleRegressorModel:
+        d = X.shape[1]
+        mf = _resolve_max_features(self.feature_subset_strategy, d, False) \
+            if self.bootstrap else None
+        feats, thrs, leaves = _fit_forest_regressor(
+            jnp.asarray(X), jnp.asarray(y),
+            jax.random.PRNGKey(self.seed), depth=self.max_depth,
+            max_bins=self.max_bins, num_trees=self.num_trees,
+            max_features=mf,
+            min_instances=float(self.min_instances_per_node),
+            min_info_gain=self.min_info_gain,
+            subsample=self.subsampling_rate, bootstrap=self.bootstrap)
+        return TreeEnsembleRegressorModel(feats, thrs, leaves,
+                                          depth=self.max_depth)
+
+
+class DecisionTreeClassifier(_ForestClassifierBase):
+    """Single CART tree, gini/entropy impurity
+    (reference OpDecisionTreeClassifier.scala)."""
+
+    def __init__(self, max_depth: int = 5, max_bins: int = 32,
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 impurity: str = "gini", seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_instances_per_node = min_instances_per_node
+        self.min_info_gain = min_info_gain
+        self.impurity = impurity
+        self.seed = seed
+        self.num_trees = 1
+        self.bootstrap = False
+        self.subsampling_rate = 1.0
+        self.feature_subset_strategy = "all"
+
+
+class DecisionTreeRegressor(_ForestRegressorBase):
+    """(reference OpDecisionTreeRegressor.scala)"""
+
+    def __init__(self, max_depth: int = 5, max_bins: int = 32,
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_instances_per_node = min_instances_per_node
+        self.min_info_gain = min_info_gain
+        self.seed = seed
+        self.num_trees = 1
+        self.bootstrap = False
+        self.subsampling_rate = 1.0
+        self.feature_subset_strategy = "all"
+
+
+class RandomForestClassifier(_ForestClassifierBase):
+    """Bagged gini trees with per-node feature subsampling
+    (reference OpRandomForestClassifier.scala). Bootstrap resampling uses
+    Poisson(subsamplingRate) row weights — the same approximation Spark
+    MLlib's BaggedPoint uses for sampling with replacement."""
+
+    def __init__(self, num_trees: int = 20, max_depth: int = 5,
+                 max_bins: int = 32, min_instances_per_node: int = 1,
+                 min_info_gain: float = 0.0, subsampling_rate: float = 1.0,
+                 feature_subset_strategy: str = "auto", impurity: str = "gini",
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.num_trees = num_trees
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_instances_per_node = min_instances_per_node
+        self.min_info_gain = min_info_gain
+        self.subsampling_rate = subsampling_rate
+        self.feature_subset_strategy = feature_subset_strategy
+        self.impurity = impurity
+        self.seed = seed
+        self.bootstrap = True
+
+
+class RandomForestRegressor(_ForestRegressorBase):
+    """(reference OpRandomForestRegressor.scala)"""
+
+    def __init__(self, num_trees: int = 20, max_depth: int = 5,
+                 max_bins: int = 32, min_instances_per_node: int = 1,
+                 min_info_gain: float = 0.0, subsampling_rate: float = 1.0,
+                 feature_subset_strategy: str = "auto", seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.num_trees = num_trees
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_instances_per_node = min_instances_per_node
+        self.min_info_gain = min_info_gain
+        self.subsampling_rate = subsampling_rate
+        self.feature_subset_strategy = feature_subset_strategy
+        self.seed = seed
+        self.bootstrap = True
+
+
+class GBTClassifier(Predictor):
+    """Gradient-boosted binary classifier with second-order (XGBoost-style)
+    split gains on the logistic objective (reference OpGBTClassifier.scala;
+    MLlib GBT uses first-order residual fitting — the second-order variant
+    strictly dominates and is the XGBoost parity path, SURVEY §2.9)."""
+
+    def __init__(self, num_rounds: int = 20, max_depth: int = 5,
+                 step_size: float = 0.1, max_bins: int = 32,
+                 reg_lambda: float = 1.0, gamma: float = 0.0,
+                 min_child_weight: float = 1.0, subsample: float = 1.0,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.num_rounds = num_rounds
+        self.max_depth = max_depth
+        self.step_size = step_size
+        self.max_bins = max_bins
+        self.reg_lambda = reg_lambda
+        self.gamma = gamma
+        self.min_child_weight = min_child_weight
+        self.subsample = subsample
+        self.seed = seed
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTClassifierModel:
+        feats, thrs, leaves, base = _fit_gbt(
+            jnp.asarray(X), jnp.asarray(y),
+            jax.random.PRNGKey(self.seed), depth=self.max_depth,
+            max_bins=self.max_bins, num_rounds=self.num_rounds,
+            step_size=self.step_size, reg_lambda=self.reg_lambda,
+            gamma=self.gamma, min_child_weight=self.min_child_weight,
+            subsample=self.subsample, objective="logistic")
+        return GBTClassifierModel(feats, thrs, leaves, depth=self.max_depth,
+                                  base=float(base))
+
+
+class GBTRegressor(Predictor):
+    """Gradient-boosted regressor, squared loss
+    (reference OpGBTRegressor.scala)."""
+
+    def __init__(self, num_rounds: int = 20, max_depth: int = 5,
+                 step_size: float = 0.1, max_bins: int = 32,
+                 reg_lambda: float = 1.0, gamma: float = 0.0,
+                 min_child_weight: float = 1.0, subsample: float = 1.0,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.num_rounds = num_rounds
+        self.max_depth = max_depth
+        self.step_size = step_size
+        self.max_bins = max_bins
+        self.reg_lambda = reg_lambda
+        self.gamma = gamma
+        self.min_child_weight = min_child_weight
+        self.subsample = subsample
+        self.seed = seed
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTRegressorModel:
+        feats, thrs, leaves, base = _fit_gbt(
+            jnp.asarray(X), jnp.asarray(y),
+            jax.random.PRNGKey(self.seed), depth=self.max_depth,
+            max_bins=self.max_bins, num_rounds=self.num_rounds,
+            step_size=self.step_size, reg_lambda=self.reg_lambda,
+            gamma=self.gamma, min_child_weight=self.min_child_weight,
+            subsample=self.subsample, objective="squared")
+        return GBTRegressorModel(feats, thrs, leaves, depth=self.max_depth,
+                                 base=float(base))
+
+
+class XGBoostClassifier(GBTClassifier):
+    """XGBoost-parameter-named facade over the same histogram booster
+    (reference OpXGBoostClassifier.scala:47 — the reference's only native
+    C++ component, xgboost4j + Rabit; here the booster IS the second-order
+    histogram GBT above, with multi-chip reduction via psum, SURVEY §2.9)."""
+
+    def __init__(self, eta: float = 0.3, max_depth: int = 6,
+                 num_round: int = 100, reg_lambda: float = 1.0,
+                 gamma: float = 0.0, min_child_weight: float = 1.0,
+                 subsample: float = 1.0, max_bins: int = 256,
+                 seed: int = 42, uid: Optional[str] = None):
+        GBTClassifier.__init__(
+            self, num_rounds=num_round, max_depth=max_depth, step_size=eta,
+            max_bins=max_bins, reg_lambda=reg_lambda, gamma=gamma,
+            min_child_weight=min_child_weight, subsample=subsample,
+            seed=seed, uid=uid)
+        self.eta = eta
+        self.num_round = num_round
+
+
+class XGBoostRegressor(GBTRegressor):
+    """(reference OpXGBoostRegressor.scala)"""
+
+    def __init__(self, eta: float = 0.3, max_depth: int = 6,
+                 num_round: int = 100, reg_lambda: float = 1.0,
+                 gamma: float = 0.0, min_child_weight: float = 1.0,
+                 subsample: float = 1.0, max_bins: int = 256,
+                 seed: int = 42, uid: Optional[str] = None):
+        GBTRegressor.__init__(
+            self, num_rounds=num_round, max_depth=max_depth, step_size=eta,
+            max_bins=max_bins, reg_lambda=reg_lambda, gamma=gamma,
+            min_child_weight=min_child_weight, subsample=subsample,
+            seed=seed, uid=uid)
+        self.eta = eta
+        self.num_round = num_round

@@ -34,7 +34,7 @@ def _default_binary_models() -> List[Tuple[Predictor, List[Dict]]]:
           for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
         (LinearSVC(), [{"reg_param": r} for r in (0.01, 0.1)]),
     ]
-    models.extend(registry.default_binary_tree_models())
+    models.extend(registry.default_binary_extra_models())
     return models
 
 
@@ -45,7 +45,7 @@ def _default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
          [{"reg_param": r, "elastic_net_param": e}
           for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
     ]
-    models.extend(registry.default_multiclass_models())
+    models.extend(registry.default_multiclass_extra_models())
     return models
 
 
@@ -56,7 +56,7 @@ def _default_regression_models() -> List[Tuple[Predictor, List[Dict]]]:
          [{"reg_param": r, "elastic_net_param": e}
           for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]),
     ]
-    models.extend(registry.default_regression_tree_models())
+    models.extend(registry.default_regression_extra_models())
     return models
 
 

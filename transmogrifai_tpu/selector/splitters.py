@@ -15,7 +15,21 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 __all__ = ["SplitterSummary", "Splitter", "DataSplitter", "DataBalancer",
-           "DataCutter"]
+           "DataCutter", "stratified_split"]
+
+
+def stratified_split(y: np.ndarray, test_fraction: float,
+                     rng: np.random.Generator
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """(train_idx, test_idx) with per-class proportional sampling — the
+    one stratified-split implementation shared by holdout reservation and
+    TrainValidationSplit."""
+    n = len(y)
+    mask = np.zeros(n, dtype=bool)
+    for cls in np.unique(y):
+        idx = rng.permutation(np.nonzero(y == cls)[0])
+        mask[idx[:int(round(len(idx) * test_fraction))]] = True
+    return np.nonzero(~mask)[0], np.nonzero(mask)[0]
 
 
 @dataclass
@@ -45,18 +59,10 @@ class Splitter:
     def split(self, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """(train_idx, test_idx) — stratified on the label."""
         n = len(y)
-        rng = np.random.default_rng(self.seed)
         if self.reserve_test_fraction <= 0.0:
             return np.arange(n), np.zeros(0, dtype=np.int64)
-        test = []
-        for cls in np.unique(y):
-            idx = np.nonzero(y == cls)[0]
-            perm = rng.permutation(idx)
-            test.extend(perm[:int(round(len(idx)
-                                        * self.reserve_test_fraction))])
-        mask = np.zeros(n, dtype=bool)
-        mask[test] = True
-        return np.nonzero(~mask)[0], np.nonzero(mask)[0]
+        rng = np.random.default_rng(self.seed)
+        return stratified_split(y, self.reserve_test_fraction, rng)
 
     def prepare(self, y: np.ndarray) -> np.ndarray:
         """Row indices (possibly resampled) to train on."""

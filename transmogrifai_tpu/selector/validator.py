@@ -13,10 +13,13 @@ reference's per-fold / per-family ``Future`` task parallelism maps to:
 """
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from ..evaluators.base import Evaluator
 from ..models.base import PredictionModel, Predictor
@@ -95,13 +98,21 @@ class _ValidatorBase:
                     model_uid=estimator.uid, grid_index=gi,
                     params=dict(params))
                 for train_idx, val_idx in splits:
-                    model: PredictionModel = candidate.fit_arrays(
-                        X[train_idx], y[train_idx])
-                    pred = model.predict_arrays(X[val_idx])
-                    metrics = self.evaluator.evaluate_arrays(
-                        y[val_idx], pred)
-                    res.metric_values.append(
-                        self.evaluator.metric_from(metrics))
+                    try:
+                        model: PredictionModel = candidate.fit_arrays(
+                            X[train_idx], y[train_idx])
+                        pred = model.predict_arrays(X[val_idx])
+                        metrics = self.evaluator.evaluate_arrays(
+                            y[val_idx], pred)
+                        res.metric_values.append(
+                            self.evaluator.metric_from(metrics))
+                    except (ValueError, FloatingPointError) as e:
+                        # a family whose preconditions the data violates
+                        # (e.g. NaiveBayes on negative features) drops out
+                        # of the race instead of failing the whole search
+                        _log.warning("candidate %s%s failed on a fold: %s",
+                                     res.model_name, params, e)
+                        res.metric_values.append(float("nan"))
                 results.append(res)
 
         sign = 1.0 if self.evaluator.is_larger_better else -1.0
@@ -155,22 +166,20 @@ class TrainValidationSplit(_ValidatorBase):
 
     def _splits(self, y):
         # exact single split honoring train_ratio (stratified on request)
+        from .splitters import stratified_split
         rng = np.random.default_rng(self.seed)
-        val_mask = np.zeros(len(y), dtype=bool)
         if self.stratify:
-            for cls in np.unique(y):
-                idx = rng.permutation(np.nonzero(y == cls)[0])
-                n_val = int(round(len(idx) * (1.0 - self.train_ratio)))
-                val_mask[idx[:n_val]] = True
+            train_idx, val_idx = stratified_split(
+                y, 1.0 - self.train_ratio, rng)
         else:
             perm = rng.permutation(len(y))
             n_val = int(round(len(y) * (1.0 - self.train_ratio)))
-            val_mask[perm[:n_val]] = True
-        if not val_mask.any() or val_mask.all():
+            train_idx, val_idx = np.sort(perm[n_val:]), np.sort(perm[:n_val])
+        if len(val_idx) == 0 or len(train_idx) == 0:
             raise ValueError(
                 f"train_ratio={self.train_ratio} leaves an empty train or "
                 f"validation set for n={len(y)} rows")
-        return [(np.nonzero(~val_mask)[0], np.nonzero(val_mask)[0])]
+        return [(train_idx, val_idx)]
 
     def get_params(self):
         return {"trainRatio": self.train_ratio, "seed": self.seed,
