@@ -1,0 +1,150 @@
+"""Contract battery over the stage library via StageSpecBase
+(reference pattern: every stage suite extends OpTransformerSpec /
+OpEstimatorSpec, features/.../test/OpTransformerSpec.scala:51)."""
+import numpy as np
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+from transmogrifai_tpu.models import (LinearRegression, LogisticRegression,
+                                      RandomForestClassifier)
+from transmogrifai_tpu.ops import (BinaryVectorizer,
+                                   DateToUnitCircleVectorizer,
+                                   FillMissingWithMean, IntegralVectorizer,
+                                   MultiPickListVectorizer, OneHotVectorizer,
+                                   RealVectorizer, SmartTextVectorizer,
+                                   StandardScaler, TextHashVectorizer,
+                                   VectorsCombiner)
+from transmogrifai_tpu.testkit import (RandomBinary, RandomIntegral,
+                                       RandomReal, RandomSet, RandomText,
+                                       StageSpecBase)
+from transmogrifai_tpu.types import (Binary, Date, Integral, MultiPickList,
+                                     OPVector, PickList, Real, RealNN, Text)
+
+
+def _feat(name, ftype, response=False):
+    b = FeatureBuilder.of(name, ftype).extract(lambda r: r.get(name))
+    return b.as_response() if response else b.as_predictor()
+
+
+def _vector_ds(n=20, d=4, seed=0, with_label=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    y = (X[:, 0] > 0).astype(np.float64)
+    cols = {"features": FeatureColumn(ftype=OPVector, data=X)}
+    if with_label:
+        cols["label"] = FeatureColumn(ftype=RealNN, data=y)
+    return Dataset(cols)
+
+
+class TestRealVectorizerSpec(StageSpecBase):
+    def build(self):
+        ds = Dataset({
+            "age": RandomReal.normal(30, 10, seed=1)
+            .with_probability_of_empty(0.2).column(25),
+            "fare": RandomReal.uniform(0, 100, seed=2).column(25)})
+        return RealVectorizer().set_input(
+            _feat("age", Real), _feat("fare", Real)), ds
+
+
+class TestIntegralVectorizerSpec(StageSpecBase):
+    def build(self):
+        ds = Dataset({"sib": RandomIntegral.integers(0, 5, seed=3)
+                      .with_probability_of_empty(0.3).column(25)})
+        return IntegralVectorizer().set_input(_feat("sib", Integral)), ds
+
+
+class TestBinaryVectorizerSpec(StageSpecBase):
+    def build(self):
+        ds = Dataset({"survived": RandomBinary(0.4, seed=4)
+                      .with_probability_of_empty(0.1).column(25)})
+        return BinaryVectorizer().set_input(_feat("survived", Binary)), ds
+
+
+class TestOneHotVectorizerSpec(StageSpecBase):
+    def build(self):
+        gen = RandomText.picklists(["a", "b", "c", "d"], seed=5) \
+            .with_probability_of_empty(0.2)
+        ds = Dataset({"cat": gen.column(40)})
+        return OneHotVectorizer(top_k=3, min_support=1).set_input(
+            _feat("cat", PickList)), ds
+
+
+class TestMultiPickListVectorizerSpec(StageSpecBase):
+    def build(self):
+        gen = RandomSet(["x", "y", "z"], seed=6) \
+            .with_probability_of_empty(0.2)
+        ds = Dataset({"tags": gen.column(30)})
+        return MultiPickListVectorizer(top_k=3, min_support=1).set_input(
+            _feat("tags", MultiPickList)), ds
+
+
+class TestSmartTextVectorizerSpec(StageSpecBase):
+    def build(self):
+        gen = RandomText.strings(3, 6, seed=7).with_probability_of_empty(0.1)
+        ds = Dataset({"desc": gen.column(30)})
+        return SmartTextVectorizer(max_cardinality=5, num_hashes=16
+                                   ).set_input(_feat("desc", Text)), ds
+
+
+class TestTextHashVectorizerSpec(StageSpecBase):
+    def build(self):
+        ds = Dataset({"words": RandomText.strings(seed=8).column(20)})
+        return TextHashVectorizer(num_hashes=8).set_input(
+            _feat("words", Text)), ds
+
+
+class TestDateVectorizerSpec(StageSpecBase):
+    def build(self):
+        ds = Dataset({"ts": RandomIntegral.dates(seed=9).column(20)})
+        return DateToUnitCircleVectorizer().set_input(_feat("ts", Date)), ds
+
+
+class TestVectorsCombinerSpec(StageSpecBase):
+    def build(self):
+        rng = np.random.default_rng(10)
+        ds = Dataset({
+            "v1": FeatureColumn(ftype=OPVector, data=rng.normal(size=(15, 2))),
+            "v2": FeatureColumn(ftype=OPVector, data=rng.normal(size=(15, 3)))})
+        return VectorsCombiner().set_input(
+            _feat("v1", OPVector), _feat("v2", OPVector)), ds
+
+
+class TestFillMissingWithMeanSpec(StageSpecBase):
+    def build(self):
+        ds = Dataset({"x": RandomReal.normal(5, 2, seed=11)
+                      .with_probability_of_empty(0.3).column(25)})
+        return FillMissingWithMean().set_input(_feat("x", Real)), ds
+
+
+class TestStandardScalerSpec(StageSpecBase):
+    def build(self):
+        ds = Dataset({"x": RandomReal.normal(5, 2, seed=12).column(25)})
+        return StandardScaler().set_input(_feat("x", Real)), ds
+
+
+class TestLogisticRegressionSpec(StageSpecBase):
+    def build(self):
+        ds = _vector_ds(seed=13)
+        return LogisticRegression(reg_param=0.01).set_input(
+            _feat("label", RealNN, response=True),
+            _feat("features", OPVector)), ds
+
+
+class TestLinearRegressionSpec(StageSpecBase):
+    def build(self):
+        rng = np.random.default_rng(14)
+        X = rng.normal(size=(20, 3))
+        y = X @ np.array([1.0, -1.0, 2.0]) + 0.5
+        ds = Dataset({"features": FeatureColumn(ftype=OPVector, data=X),
+                      "label": FeatureColumn(ftype=RealNN, data=y)})
+        return LinearRegression().set_input(
+            _feat("label", RealNN, response=True),
+            _feat("features", OPVector)), ds
+
+
+class TestRandomForestSpec(StageSpecBase):
+    def build(self):
+        ds = _vector_ds(n=40, seed=15)
+        return RandomForestClassifier(num_trees=5, max_depth=3).set_input(
+            _feat("label", RealNN, response=True),
+            _feat("features", OPVector)), ds

@@ -112,7 +112,10 @@ class ClassifierModel(PredictionModel):
         raise NotImplementedError
 
     def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
-        raise NotImplementedError
+        """Default: max-shifted softmax over the raw margins."""
+        raw = raw - np.max(raw, axis=1, keepdims=True)
+        e = np.exp(raw)
+        return e / np.sum(e, axis=1, keepdims=True)
 
     def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
         raw = np.asarray(self.predict_raw(X), dtype=np.float64)

@@ -75,8 +75,3 @@ class NaiveBayesModel(ClassifierModel):
             return (self.pi + Xb @ self.theta.T
                     + (1.0 - Xb) @ neg.T)
         return self.pi + X @ self.theta.T
-
-    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
-        raw = raw - np.max(raw, axis=1, keepdims=True)
-        e = np.exp(raw)
-        return e / np.sum(e, axis=1, keepdims=True)

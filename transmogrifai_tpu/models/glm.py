@@ -76,7 +76,7 @@ def _init_mu(family: str, y):
 
 @functools.partial(jax.jit, static_argnames=("family", "link", "max_iter",
                                              "fit_intercept"))
-def _fit_glm_irls(X, y, reg, var_power, *, family: str, link: str,
+def _fit_glm_irls(X, y, reg, var_power, tol, *, family: str, link: str,
                   max_iter: int, fit_intercept: bool):
     n, d = X.shape
     g, ginv, gprime = _link_fns(link)
@@ -89,7 +89,7 @@ def _fit_glm_irls(X, y, reg, var_power, *, family: str, link: str,
         Xa, pen = X, jnp.full((d,), reg, X.dtype)
     p = Xa.shape[1]
 
-    def body(_, beta):
+    def irls_step(beta):
         eta = Xa @ beta
         mu = ginv(eta)
         gp = gprime(mu)
@@ -99,12 +99,25 @@ def _fit_glm_irls(X, y, reg, var_power, *, family: str, link: str,
         b = (Xa * w[:, None]).T @ z / n
         return jnp.linalg.solve(A, b)
 
+    def body(carry):
+        beta, _, it = carry
+        beta_next = irls_step(beta)
+        delta = jnp.linalg.norm(beta_next - beta) \
+            / jnp.maximum(jnp.linalg.norm(beta), 1.0)
+        return beta_next, delta, it + 1
+
+    def continuing(carry):
+        _, delta, it = carry
+        return (it == 0) | ((it < max_iter) & (delta >= tol))
+
     mu0 = _init_mu(family, y)
     eta0 = g(mu0)
     # start from the weighted LS fit of eta0
     beta0 = jnp.linalg.solve(Xa.T @ Xa / n + jnp.diag(pen + _EPS),
                              Xa.T @ eta0 / n)
-    beta = jax.lax.fori_loop(0, max_iter, body, beta0)
+    beta, _, _ = jax.lax.while_loop(
+        continuing, body,
+        (beta0, jnp.asarray(jnp.inf, X.dtype), jnp.asarray(0)))
     if fit_intercept:
         return beta[:d], beta[d]
     return beta, jnp.asarray(0.0, X.dtype)
@@ -130,8 +143,9 @@ class GeneralizedLinearRegression(Predictor):
                    ) -> "GeneralizedLinearRegressionModel":
         w, b = _fit_glm_irls(
             jnp.asarray(X), jnp.asarray(y), self.reg_param,
-            self.variance_power, family=self.family, link=self.link,
-            max_iter=self.max_iter, fit_intercept=self.fit_intercept)
+            self.variance_power, self.tol, family=self.family,
+            link=self.link, max_iter=self.max_iter,
+            fit_intercept=self.fit_intercept)
         return GeneralizedLinearRegressionModel(
             coefficients=np.asarray(w), intercept=float(b), link=self.link)
 

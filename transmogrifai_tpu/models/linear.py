@@ -175,11 +175,6 @@ class LogisticRegressionModel(ClassifierModel):
             return np.stack([-m, m], axis=1)
         return X @ self.coefficients.T + self.intercept
 
-    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
-        raw = raw - np.max(raw, axis=1, keepdims=True)
-        e = np.exp(raw)
-        return e / np.sum(e, axis=1, keepdims=True)
-
 
 # ---------------------------------------------------------------------------
 # linear regression

@@ -46,8 +46,9 @@ def _forward(params, X):
     return h @ W + b
 
 
-@functools.partial(jax.jit, static_argnames=("sizes", "max_iter"))
-def _fit_mlp(X, y, key, *, sizes: Tuple[int, ...], max_iter: int):
+@functools.partial(jax.jit, static_argnames=("sizes", "max_iter", "tol"))
+def _fit_mlp(X, y, key, *, sizes: Tuple[int, ...], max_iter: int,
+             tol: float):
     onehot = jax.nn.one_hot(y.astype(jnp.int32), sizes[-1], dtype=X.dtype)
 
     def loss(params):
@@ -55,7 +56,7 @@ def _fit_mlp(X, y, key, *, sizes: Tuple[int, ...], max_iter: int):
         return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=1))
 
     params0 = _init_params(key, sizes, X.dtype)
-    return lbfgs_minimize(loss, params0, max_iter=max_iter)
+    return lbfgs_minimize(loss, params0, max_iter=max_iter, tol=tol)
 
 
 class MultilayerPerceptronClassifier(Predictor):
@@ -78,7 +79,7 @@ class MultilayerPerceptronClassifier(Predictor):
         sizes = (X.shape[1],) + self.hidden_layers + (k,)
         params = _fit_mlp(jnp.asarray(X), jnp.asarray(y),
                           jax.random.PRNGKey(self.seed), sizes=sizes,
-                          max_iter=self.max_iter)
+                          max_iter=self.max_iter, tol=self.tol)
         weights = [np.asarray(W) for W, _ in params]
         biases = [np.asarray(b) for _, b in params]
         return MultilayerPerceptronClassifierModel(weights=weights,
@@ -97,8 +98,3 @@ class MultilayerPerceptronClassifierModel(ClassifierModel):
         for W, b in zip(self.weights[:-1], self.biases[:-1]):
             h = 1.0 / (1.0 + np.exp(-(h @ W + b)))
         return h @ self.weights[-1] + self.biases[-1]
-
-    def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
-        raw = raw - np.max(raw, axis=1, keepdims=True)
-        e = np.exp(raw)
-        return e / np.sum(e, axis=1, keepdims=True)

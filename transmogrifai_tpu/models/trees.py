@@ -347,12 +347,13 @@ class TreeEnsembleClassifierModel(ClassifierModel):
     (reference RandomForestClassificationModel normalized vote averaging)."""
 
     def __init__(self, feats, thrs, leaves, depth: int,
-                 uid: Optional[str] = None):
+                 n_features: int = 0, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.feats = np.asarray(feats, dtype=np.int32)
         self.thrs = np.asarray(thrs, dtype=np.float64)
         self.leaves = np.asarray(leaves, dtype=np.float64)  # (T, L, K)
         self.depth = int(depth)
+        self.n_features = int(n_features)
 
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         leaf_idx = np.asarray(_predict_leaves(
@@ -367,17 +368,18 @@ class TreeEnsembleClassifierModel(ClassifierModel):
 
     @property
     def feature_importances(self) -> np.ndarray:
-        return _split_count_importances(self.feats, self.thrs)
+        return _split_count_importances(self.feats, self.thrs, self.n_features)
 
 
 class TreeEnsembleRegressorModel(RegressionModel):
     def __init__(self, feats, thrs, leaves, depth: int,
-                 uid: Optional[str] = None):
+                 n_features: int = 0, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.feats = np.asarray(feats, dtype=np.int32)
         self.thrs = np.asarray(thrs, dtype=np.float64)
         self.leaves = np.asarray(leaves, dtype=np.float64)  # (T, L)
         self.depth = int(depth)
+        self.n_features = int(n_features)
 
     def predict_values(self, X: np.ndarray) -> np.ndarray:
         leaf_idx = np.asarray(_predict_leaves(
@@ -388,20 +390,21 @@ class TreeEnsembleRegressorModel(RegressionModel):
 
     @property
     def feature_importances(self) -> np.ndarray:
-        return _split_count_importances(self.feats, self.thrs)
+        return _split_count_importances(self.feats, self.thrs, self.n_features)
 
 
 class GBTClassifierModel(ClassifierModel):
     """Boosted binary classifier: sigmoid over summed leaf margins."""
 
     def __init__(self, feats, thrs, leaves, depth: int, base: float = 0.0,
-                 uid: Optional[str] = None):
+                 n_features: int = 0, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.feats = np.asarray(feats, dtype=np.int32)
         self.thrs = np.asarray(thrs, dtype=np.float64)
         self.leaves = np.asarray(leaves, dtype=np.float64)
         self.depth = int(depth)
         self.base = float(base)
+        self.n_features = int(n_features)
 
     def margins(self, X: np.ndarray) -> np.ndarray:
         leaf_idx = np.asarray(_predict_leaves(
@@ -420,18 +423,19 @@ class GBTClassifierModel(ClassifierModel):
 
     @property
     def feature_importances(self) -> np.ndarray:
-        return _split_count_importances(self.feats, self.thrs)
+        return _split_count_importances(self.feats, self.thrs, self.n_features)
 
 
 class GBTRegressorModel(RegressionModel):
     def __init__(self, feats, thrs, leaves, depth: int, base: float = 0.0,
-                 uid: Optional[str] = None):
+                 n_features: int = 0, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.feats = np.asarray(feats, dtype=np.int32)
         self.thrs = np.asarray(thrs, dtype=np.float64)
         self.leaves = np.asarray(leaves, dtype=np.float64)
         self.depth = int(depth)
         self.base = float(base)
+        self.n_features = int(n_features)
 
     def predict_values(self, X: np.ndarray) -> np.ndarray:
         leaf_idx = np.asarray(_predict_leaves(
@@ -442,17 +446,18 @@ class GBTRegressorModel(RegressionModel):
 
     @property
     def feature_importances(self) -> np.ndarray:
-        return _split_count_importances(self.feats, self.thrs)
+        return _split_count_importances(self.feats, self.thrs, self.n_features)
 
 
-def _split_count_importances(feats: np.ndarray, thrs: np.ndarray) -> np.ndarray:
-    """Normalized real-split counts per feature (a threshold of +inf marks
-    a dead/no-split node)."""
+def _split_count_importances(feats: np.ndarray, thrs: np.ndarray,
+                             n_features: int) -> np.ndarray:
+    """Normalized real-split counts per feature, aligned with the training
+    feature columns (a threshold of +inf marks a dead/no-split node)."""
     real = np.isfinite(thrs)
     if feats.size == 0 or not real.any():
-        return np.zeros(0)
-    d = int(feats.max()) + 1
-    counts = np.bincount(feats[real].ravel(), minlength=d).astype(np.float64)
+        return np.zeros(n_features)
+    counts = np.bincount(feats[real].ravel(),
+                         minlength=n_features).astype(np.float64)
     total = counts.sum()
     return counts / total if total > 0 else counts
 
@@ -498,7 +503,8 @@ class _ForestClassifierBase(Predictor):
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap)
         return TreeEnsembleClassifierModel(feats, thrs, leaves,
-                                           depth=self.max_depth)
+                                           depth=self.max_depth,
+                                           n_features=d)
 
 
 class _ForestRegressorBase(Predictor):
@@ -519,7 +525,8 @@ class _ForestRegressorBase(Predictor):
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap)
         return TreeEnsembleRegressorModel(feats, thrs, leaves,
-                                          depth=self.max_depth)
+                                          depth=self.max_depth,
+                                          n_features=d)
 
 
 class DecisionTreeClassifier(_ForestClassifierBase):
@@ -628,6 +635,13 @@ class GBTClassifier(Predictor):
         self.seed = seed
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTClassifierModel:
+        bad = np.setdiff1d(np.unique(y), [0.0, 1.0])
+        if bad.size:
+            raise ValueError(
+                f"GBTClassifier supports binary labels {{0, 1}} only "
+                f"(as MLlib GBTClassifier does); got extra labels "
+                f"{bad.tolist()} — use RandomForestClassifier or "
+                f"LogisticRegression for multiclass")
         feats, thrs, leaves, base = _fit_gbt(
             jnp.asarray(X), jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
@@ -636,7 +650,7 @@ class GBTClassifier(Predictor):
             gamma=self.gamma, min_child_weight=self.min_child_weight,
             subsample=self.subsample, objective="logistic")
         return GBTClassifierModel(feats, thrs, leaves, depth=self.max_depth,
-                                  base=float(base))
+                                  base=float(base), n_features=X.shape[1])
 
 
 class GBTRegressor(Predictor):
@@ -668,7 +682,7 @@ class GBTRegressor(Predictor):
             gamma=self.gamma, min_child_weight=self.min_child_weight,
             subsample=self.subsample, objective="squared")
         return GBTRegressorModel(feats, thrs, leaves, depth=self.max_depth,
-                                 base=float(base))
+                                 base=float(base), n_features=X.shape[1])
 
 
 class XGBoostClassifier(GBTClassifier):

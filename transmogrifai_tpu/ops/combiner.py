@@ -14,7 +14,7 @@ import numpy as np
 from ..features.columns import FeatureColumn
 from ..stages.base import SequenceTransformer
 from ..types import OPVector
-from ..utils.vector_meta import VectorMetadata
+from ..utils.vector_meta import VectorColumnMetadata, VectorMetadata
 
 __all__ = ["VectorsCombiner"]
 
@@ -34,7 +34,15 @@ class VectorsCombiner(SequenceTransformer):
                 raise TypeError(
                     f"VectorsCombiner input {f.name!r} is not a vector")
             mats.append(col.data)
-            metas.append(col.metadata or VectorMetadata(name=f.name))
+            meta = col.metadata
+            if meta is None or meta.size != col.data.shape[1]:
+                # raw vectors (no vectorizer provenance) get anonymous
+                # per-column records so flatten stays index-consistent
+                meta = VectorMetadata(name=f.name, columns=tuple(
+                    VectorColumnMetadata(parent_feature_name=f.name,
+                                         parent_feature_type="OPVector")
+                    for _ in range(col.data.shape[1])))
+            metas.append(meta)
         mat = (np.concatenate(mats, axis=1) if mats
                else np.zeros((0, 0), dtype=np.float64))
         return FeatureColumn.vector(

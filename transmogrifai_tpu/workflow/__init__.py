@@ -1,4 +1,5 @@
 """Workflow engine (SURVEY §2.4; core/.../OpWorkflow.scala:332)."""
+from .persistence import load_model, save_model
 from .workflow import Workflow, WorkflowModel
 
-__all__ = ["Workflow", "WorkflowModel"]
+__all__ = ["Workflow", "WorkflowModel", "save_model", "load_model"]

@@ -1,0 +1,256 @@
+"""Workflow-model persistence: save/load a fitted DAG.
+
+TPU-native re-design of the reference model writer/reader
+(core/src/main/scala/com/salesforce/op/{OpWorkflowModelWriter.scala:52-123,
+OpWorkflowModelReader.scala} and the stage writer/reader
+features/.../stages/{OpPipelineStageWriter.scala:78-120,
+OpPipelineStageReader.scala:89-135}).
+
+Layout: a directory with
+- ``op-model.json`` — result-feature uids, the full feature DAG (uids,
+  types, parent links), and every stage's class name + ctor args
+  (the reference's reflective ctor capture becomes the explicit
+  ``_ctor_args`` record taken at construction, stages/base.py),
+- ``arrays.npz`` — every numpy array referenced from ctor args (model
+  coefficients, tree heaps, …), keyed ``<stage-uid>/<path>``.
+
+Functions (``extract_fn`` of raw-feature generators, ``fn`` of lambda
+transformers) round-trip only when importable (``module:qualname``);
+otherwise they are dropped and the generator falls back to dict/attr
+lookup by feature name — the reference has the same limitation (it
+stores the lambda's *source text* for display only, and requires the
+class to be on the classpath to reload).
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..features.feature import Feature
+from ..stages.base import Estimator, PipelineStage, stage_class_by_name
+from ..types.base import feature_type_by_name
+from ..utils.vector_meta import VectorMetadata
+
+__all__ = ["save_model", "load_model", "stage_to_json", "stage_from_json",
+           "encode_value", "decode_value"]
+
+MODEL_JSON = "op-model.json"
+ARRAYS_NPZ = "arrays.npz"
+
+
+# ---------------------------------------------------------------------------
+# value encoding (replaces reference AnyValueTypes,
+# OpPipelineStageReadWriteShared.scala)
+# ---------------------------------------------------------------------------
+
+def encode_value(v: Any, arrays: Dict[str, np.ndarray], key: str) -> Any:
+    """JSON-safe encoding; arrays are swapped for ``{"$array": key}`` refs
+    stored in the npz sidecar."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        arrays[key] = v
+        return {"$array": key}
+    if hasattr(v, "__array__") and not isinstance(v, (list, tuple, dict)):
+        # device arrays (jax) captured in ctor args before np conversion
+        arrays[key] = np.asarray(v)
+        return {"$array": key}
+    if isinstance(v, (list, tuple)):
+        return {"$seq": [encode_value(x, arrays, f"{key}/{i}")
+                         for i, x in enumerate(v)],
+                "$tuple": isinstance(v, tuple)}
+    if isinstance(v, dict):
+        return {"$dict": {str(k): encode_value(x, arrays, f"{key}/{k}")
+                          for k, x in v.items()}}
+    if isinstance(v, type):
+        from ..types.base import FeatureType
+        if issubclass(v, FeatureType):
+            return {"$ftype": v.__name__}
+        raise ValueError(f"Cannot serialize class {v!r} at {key}")
+    if isinstance(v, VectorMetadata):
+        return {"$vmeta": v.to_json()}
+    if callable(v):
+        mod = getattr(v, "__module__", None)
+        qual = getattr(v, "__qualname__", "")
+        if mod and qual and "<" not in qual:
+            return {"$fn": f"{mod}:{qual}"}
+        return {"$fn": None}  # non-importable closure/lambda — dropped
+    raise ValueError(
+        f"Cannot serialize ctor arg of type {type(v).__name__} at {key}")
+
+
+def decode_value(v: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    if isinstance(v, dict):
+        if "$array" in v:
+            return np.asarray(arrays[v["$array"]])
+        if "$seq" in v:
+            seq = [decode_value(x, arrays) for x in v["$seq"]]
+            return tuple(seq) if v.get("$tuple") else seq
+        if "$dict" in v:
+            return {k: decode_value(x, arrays) for k, x in v["$dict"].items()}
+        if "$ftype" in v:
+            return feature_type_by_name(v["$ftype"])
+        if "$vmeta" in v:
+            return VectorMetadata.from_json(v["$vmeta"])
+        if "$fn" in v:
+            if v["$fn"] is None:
+                return None
+            mod, qual = v["$fn"].split(":", 1)
+            obj = importlib.import_module(mod)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            return obj
+        return {k: decode_value(x, arrays) for k, x in v.items()}
+    if isinstance(v, list):
+        return [decode_value(x, arrays) for x in v]
+    return v
+
+
+# ---------------------------------------------------------------------------
+# stage serde
+# ---------------------------------------------------------------------------
+
+def stage_to_json(stage: PipelineStage, arrays: Dict[str, np.ndarray]) -> dict:
+    """(reference OpPipelineStageWriter.scala:78-120)"""
+    params = stage.get_params()
+    params.pop("uid", None)
+    d = {
+        "className": type(stage).__name__,
+        "uid": stage.uid,
+        "operationName": stage.operation_name,
+        "ctorArgs": {k: encode_value(v, arrays, f"{stage.uid}/{k}")
+                     for k, v in params.items()},
+    }
+    pec = getattr(stage, "parent_estimator_class", None)
+    if pec:
+        d["parentEstimatorClass"] = pec
+    vmeta = getattr(stage, "vector_metadata", None)
+    if isinstance(vmeta, VectorMetadata):
+        d["vectorMetadata"] = vmeta.to_json()
+    return d
+
+
+def stage_from_json(d: dict, arrays: Dict[str, np.ndarray]) -> PipelineStage:
+    """(reference OpPipelineStageReader.scala:89-135)"""
+    cls = stage_class_by_name(d["className"])
+    kwargs = {k: decode_value(v, arrays) for k, v in d["ctorArgs"].items()}
+    kwargs["uid"] = d["uid"]
+    if kwargs.get("extract_fn", "missing") is None:
+        kwargs.pop("extract_fn")  # fall back to by-name record lookup
+    if cls.__name__ == "LambdaTransformer" and kwargs.get("fn") is None:
+        raise ValueError(
+            f"Stage {d['uid']}: LambdaTransformer function was not "
+            "importable at save time and cannot be restored")
+    stage = cls(**kwargs)
+    stage.operation_name = d.get("operationName", stage.operation_name)
+    if "parentEstimatorClass" in d:
+        stage.parent_estimator_class = d["parentEstimatorClass"]
+    if "vectorMetadata" in d:
+        stage.vector_metadata = VectorMetadata.from_json(d["vectorMetadata"])
+    return stage
+
+
+# ---------------------------------------------------------------------------
+# feature DAG serde (reference FeatureJsonHelper)
+# ---------------------------------------------------------------------------
+
+def _feature_to_json(f: Feature) -> dict:
+    return {
+        "name": f.name,
+        "uid": f.uid,
+        "typeName": f.ftype.__name__,
+        "isResponse": f.is_response,
+        "originStageUid": f.origin_stage.uid if f.origin_stage else None,
+        "parentUids": [p.uid for p in f.parents],
+    }
+
+
+def _collect_features_topo(result_features) -> List[Feature]:
+    """All DAG features, parents before children."""
+    seen: Dict[str, Feature] = {}
+    order: List[Feature] = []
+
+    def go(f: Feature):
+        if f.uid in seen:
+            return
+        seen[f.uid] = f
+        for p in f.parents:
+            go(p)
+        order.append(f)
+
+    for rf in result_features:
+        go(rf)
+    return order
+
+
+# ---------------------------------------------------------------------------
+# model save / load
+# ---------------------------------------------------------------------------
+
+def save_model(model, path: str) -> None:
+    """Write a fitted WorkflowModel to ``path`` (a directory)
+    (reference OpWorkflowModelWriter.toJson:75-120)."""
+    feats = _collect_features_topo(model.result_features)
+    for f in feats:
+        if f.origin_stage is not None and isinstance(f.origin_stage,
+                                                     Estimator):
+            raise ValueError(
+                f"Feature {f.name!r} still points at unfitted estimator "
+                f"{f.origin_stage!r}; save the model returned by train()")
+    arrays: Dict[str, np.ndarray] = {}
+    stages, staged = [], set()
+    for f in feats:
+        s = f.origin_stage
+        if s is not None and s.uid not in staged:
+            staged.add(s.uid)
+            stages.append(stage_to_json(s, arrays))
+    doc = {
+        "formatVersion": 1,
+        "resultFeatureUids": [f.uid for f in model.result_features],
+        "features": [_feature_to_json(f) for f in feats],
+        "stages": stages,
+    }
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, MODEL_JSON), "w") as fh:
+        json.dump(doc, fh, indent=1)
+    np.savez(os.path.join(path, ARRAYS_NPZ),
+             **{k: v for k, v in arrays.items()})
+
+
+def load_model(path: str):
+    """Load a fitted WorkflowModel from ``path``
+    (reference OpWorkflowModelReader / OpWorkflow.loadModel)."""
+    from .workflow import WorkflowModel
+    with open(os.path.join(path, MODEL_JSON)) as fh:
+        doc = json.load(fh)
+    npz_path = os.path.join(path, ARRAYS_NPZ)
+    arrays: Dict[str, np.ndarray] = {}
+    if os.path.exists(npz_path):
+        with np.load(npz_path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+
+    stages: Dict[str, PipelineStage] = {}
+    for sd in doc["stages"]:
+        stages[sd["uid"]] = stage_from_json(sd, arrays)
+
+    features: Dict[str, Feature] = {}
+    for fd in doc["features"]:
+        parents = tuple(features[u] for u in fd["parentUids"])
+        stage = stages.get(fd["originStageUid"]) \
+            if fd["originStageUid"] else None
+        f = Feature(name=fd["name"],
+                    ftype=feature_type_by_name(fd["typeName"]),
+                    is_response=fd["isResponse"], origin_stage=stage,
+                    parents=parents, uid=fd["uid"])
+        features[f.uid] = f
+        if stage is not None:
+            stage.input_features = parents
+            stage._output_feature = f
+    result = tuple(features[u] for u in doc["resultFeatureUids"])
+    return WorkflowModel(result_features=result)

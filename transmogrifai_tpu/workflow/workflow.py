@@ -252,6 +252,19 @@ class WorkflowModel:
         out, _ = _fit_and_transform_layers(layers, ds, fit=False)
         return out
 
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Persist the fitted DAG to a directory
+        (reference OpWorkflowModel.save:218)."""
+        from .persistence import save_model
+        save_model(self, path)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        """(reference OpWorkflow.loadModel)"""
+        from .persistence import load_model
+        return load_model(path)
+
     def _resolve(self, feature: Feature) -> Feature:
         """Find the fitted-DAG feature with the same uid (features keep
         their uid through copy_with_new_stages)."""
