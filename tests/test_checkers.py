@@ -1,0 +1,246 @@
+"""SanityChecker + RawFeatureFilter tests (reference SanityCheckerTest,
+RawFeatureFilterTest, BadFeatureZooTest in core/src/test/)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.checkers import (RawFeatureFilter, SanityChecker,
+                                        rewire_without)
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.testkit import RandomData, RandomReal, RandomText
+from transmogrifai_tpu.types import (OPVector, PickList, Real, RealNN, Text)
+from transmogrifai_tpu.utils.vector_meta import (VectorColumnMetadata,
+                                                 VectorMetadata)
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _feat(name, ftype, response=False):
+    b = FeatureBuilder.of(name, ftype).extract(lambda r: r.get(name))
+    return b.as_response() if response else b.as_predictor()
+
+
+def _vmeta(name, specs):
+    """specs: list of (parent, grouping, indicator)"""
+    return VectorMetadata(name=name, columns=tuple(
+        VectorColumnMetadata(parent_feature_name=p, parent_feature_type=t,
+                             grouping=g, indicator_value=iv)
+        for p, t, g, iv in specs))
+
+
+class TestSanityChecker:
+    def _fit(self, X, y, meta=None, **params):
+        label = _feat("label", RealNN, response=True)
+        vec = _feat("features", OPVector)
+        ds = Dataset({
+            "label": FeatureColumn(ftype=RealNN, data=np.asarray(y)),
+            "features": FeatureColumn(ftype=OPVector, data=np.asarray(X),
+                                      metadata=meta)})
+        checker = SanityChecker(**params).set_input(label, vec)
+        model = checker.fit(ds)
+        out = model.transform_columns([ds["label"], ds["features"]])
+        return model, out
+
+    def test_low_variance_pruned(self):
+        rng = np.random.default_rng(0)
+        n = 200
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        X = np.stack([rng.normal(size=n),
+                      np.full(n, 3.0)], axis=1)  # col 1 constant
+        model, out = self._fit(X, y)
+        assert model.kept_indices == [0]
+        assert out.data.shape == (n, 1)
+        assert "minVariance" in model.summary.column_stats[1].reasons[0]
+
+    def test_label_leakage_pruned(self):
+        rng = np.random.default_rng(1)
+        n = 300
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        leaky = y + 0.001 * rng.normal(size=n)   # |corr| ~ 1
+        honest = rng.normal(size=n) + 0.3 * y    # moderate corr
+        X = np.stack([honest, leaky], axis=1)
+        model, _ = self._fit(X, y)
+        assert 0 in model.kept_indices
+        assert 1 not in model.kept_indices
+        rec = model.summary.column_stats[1]
+        assert rec.is_dropped and "maxCorrelation" in rec.reasons[0]
+
+    def test_categorical_group_cramers_v(self):
+        rng = np.random.default_rng(2)
+        n = 400
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        # leaky one-hot group: indicator == label
+        leak_a = (y == 1).astype(float)
+        leak_b = (y == 0).astype(float)
+        honest = rng.normal(size=n)
+        X = np.stack([honest, leak_a, leak_b], axis=1)
+        meta = _vmeta("features", [
+            ("num", "Real", None, None),
+            ("cat", "PickList", "cat", "a"),
+            ("cat", "PickList", "cat", "b")])
+        model, out = self._fit(X, y, meta=meta, max_correlation=2.0)
+        # whole categorical group dropped together by Cramér's V
+        assert model.kept_indices == [0]
+        assert out.metadata.size == 1
+        reasons = model.summary.column_stats[1].reasons
+        assert any("Cram" in r for r in reasons)
+
+    def test_all_dropped_raises(self):
+        rng = np.random.default_rng(3)
+        n = 100
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        X = np.zeros((n, 2))
+        with pytest.raises(ValueError, match="dropped every"):
+            self._fit(X, y)
+
+    def test_metadata_survives_pruning(self):
+        rng = np.random.default_rng(4)
+        n = 200
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        X = np.stack([rng.normal(size=n), np.zeros(n),
+                      rng.normal(size=n)], axis=1)
+        meta = _vmeta("features", [("a", "Real", None, None),
+                                   ("b", "Real", None, None),
+                                   ("c", "Real", None, None)])
+        model, out = self._fit(X, y, meta=meta)
+        assert [c.parent_feature_name for c in out.metadata.columns] == \
+            ["a", "c"]
+
+    def test_in_workflow_before_model(self):
+        """Leakage zoo: end-to-end workflow where the checker removes the
+        leaky column before the model sees it."""
+        records = (RandomData(seed=5)
+                   .with_column("honest", RandomReal.normal(0, 1, seed=1))
+                   ).records(300)
+        rng = np.random.default_rng(6)
+        for r in records:
+            r["label"] = float((r["honest"] or 0) + 0.5
+                               * rng.normal() > 0)
+            r["leak"] = r["label"] + 0.0001 * rng.normal()
+        honest = _feat("honest", Real)
+        leak = _feat("leak", Real)
+        label = _feat("label", RealNN, response=True)
+        vec = transmogrify([honest, leak])
+        checked = vec.sanity_check(label)
+        pred = LogisticRegression().set_input(label, checked).get_output()
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(records).train())
+        checker_model = [s for s in model.stages()
+                         if type(s).__name__ == "SanityCheckerModel"][0]
+        stats = {c.name: c for c in checker_model.summary.column_stats}
+        # the leaky value column is pruned, the honest value column is kept
+        # (its zero-variance null indicator may be pruned, which is fine)
+        leak_value = [c for n, c in stats.items()
+                      if "leak" in n and "Null" not in n]
+        honest_value = [c for n, c in stats.items()
+                        if "honest" in n and "Null" not in n]
+        assert leak_value and all(c.is_dropped for c in leak_value)
+        assert honest_value and all(not c.is_dropped for c in honest_value)
+
+
+class TestRawFeatureFilter:
+    def test_low_fill_excluded(self):
+        f_ok = _feat("ok", Real)
+        f_sparse = _feat("sparse", Real)
+        n = 500
+        rng = np.random.default_rng(7)
+        ds = Dataset({
+            "ok": FeatureColumn.from_values(
+                Real, list(rng.normal(size=n))),
+            "sparse": FeatureColumn.from_values(
+                Real, [None] * (n - 1) + [1.0])})
+        rff = RawFeatureFilter(min_fill=0.01)
+        res = rff.compute_exclusions([f_ok, f_sparse], ds)
+        assert res.excluded_names == ["sparse"]
+        assert "minFill" in res.exclusions[0].reason
+
+    def test_distribution_shift_excluded(self):
+        f = _feat("x", Real)
+        rng = np.random.default_rng(8)
+        train = Dataset({"x": FeatureColumn.from_values(
+            Real, list(rng.normal(0, 1, size=800)))})
+        score = Dataset({"x": FeatureColumn.from_values(
+            Real, list(rng.normal(30, 1, size=800)))})  # huge shift
+        rff = RawFeatureFilter(max_js_divergence=0.5)
+        res = rff.compute_exclusions([f], train, score)
+        assert res.excluded_names == ["x"]
+        assert "JS divergence" in res.exclusions[0].reason
+
+    def test_no_shift_kept(self):
+        f = _feat("x", Real)
+        rng = np.random.default_rng(9)
+        train = Dataset({"x": FeatureColumn.from_values(
+            Real, list(rng.normal(0, 1, size=800)))})
+        score = Dataset({"x": FeatureColumn.from_values(
+            Real, list(rng.normal(0, 1, size=800)))})
+        res = RawFeatureFilter(max_js_divergence=0.5).compute_exclusions(
+            [f], train, score)
+        assert res.excluded_names == []
+
+    def test_text_shift(self):
+        f = _feat("t", PickList)
+        train = Dataset({"t": FeatureColumn.from_values(
+            PickList, ["a"] * 200 + ["b"] * 200)})
+        score = Dataset({"t": FeatureColumn.from_values(
+            PickList, ["zzz"] * 400)})
+        res = RawFeatureFilter(max_js_divergence=0.5).compute_exclusions(
+            [f], train, score)
+        assert res.excluded_names == ["t"]
+
+    def test_protected_feature_kept(self):
+        f = _feat("sparse", Real)
+        ds = Dataset({"sparse": FeatureColumn.from_values(
+            Real, [None] * 99 + [1.0])})
+        res = RawFeatureFilter(
+            min_fill=0.5, protected_features=("sparse",)
+        ).compute_exclusions([f], ds)
+        assert res.excluded_names == []
+
+    def test_workflow_integration(self):
+        """RFF drops a dead feature pre-DAG; training still succeeds."""
+        records = (RandomData(seed=10)
+                   .with_column("x", RandomReal.normal(0, 1, seed=1))
+                   .with_column("cat", RandomText.picklists(
+                       ["u", "v"], seed=2))).records(300)
+        rng = np.random.default_rng(11)
+        for i, r in enumerate(records):
+            r["label"] = float((r["x"] or 0) > 0)
+            r["dead"] = 1.0 if i == 0 else None  # ~0 fill
+        x = _feat("x", Real)
+        cat = _feat("cat", PickList)
+        dead = _feat("dead", Real)
+        label = _feat("label", RealNN, response=True)
+        vec = transmogrify([x, cat, dead])
+        pred = LogisticRegression().set_input(label, vec).get_output()
+        wf = (Workflow().set_result_features(pred)
+              .set_input_records(records)
+              .with_raw_feature_filter(RawFeatureFilter(min_fill=0.05)))
+        model = wf.train()
+        assert [f.name for f in wf.blacklisted_features] == ["dead"]
+        assert "dead" not in [f.name for f in model.raw_features()]
+        # scoring works without the dead feature
+        scored = model.score(records[:5])
+        assert scored[model.result_features[0].name].data.shape == (5,)
+
+
+class TestRewire:
+    def test_sequence_stage_loses_input(self):
+        a, b = _feat("a", Real), _feat("b", Real)
+        vec = transmogrify([a, b])
+        new, removed = rewire_without([vec], ["b"])
+        assert [f.name for f in removed] == ["b"]
+        assert [f.name for f in new[0].raw_features()] == ["a"]
+
+    def test_untouched_dag_shared(self):
+        a, b = _feat("a", Real), _feat("b", Real)
+        vec = transmogrify([a, b])
+        new, removed = rewire_without([vec], ["zzz"])
+        assert new[0] is vec and removed == []
+
+    def test_nonsequence_stage_raises(self):
+        a = _feat("a", Real)
+        b = _feat("b", Real)
+        combined = a + b  # fixed-arity binary stage
+        with pytest.raises(ValueError, match="non-sequence"):
+            rewire_without([combined], ["b"])

@@ -148,3 +148,12 @@ class TestRandomForestSpec(StageSpecBase):
         return RandomForestClassifier(num_trees=5, max_depth=3).set_input(
             _feat("label", RealNN, response=True),
             _feat("features", OPVector)), ds
+
+
+class TestSanityCheckerSpec(StageSpecBase):
+    def build(self):
+        from transmogrifai_tpu.checkers import SanityChecker
+        ds = _vector_ds(n=60, seed=16)
+        return SanityChecker().set_input(
+            _feat("label", RealNN, response=True),
+            _feat("features", OPVector)), ds

@@ -1,0 +1,141 @@
+"""ModelInsights + LOCO tests (reference ModelInsightsTest,
+RecordInsightsLOCOTest in core/src/test/)."""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+from transmogrifai_tpu.insights import RecordInsightsLOCO
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.testkit import RandomData, RandomReal, RandomText
+from transmogrifai_tpu.types import OPVector, PickList, Real, RealNN
+from transmogrifai_tpu.utils.vector_meta import (VectorColumnMetadata,
+                                                 VectorMetadata)
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _records(n=250, seed=0):
+    records = (RandomData(seed=seed)
+               .with_column("strong", RandomReal.normal(0, 1, seed=1))
+               .with_column("weak", RandomReal.normal(0, 1, seed=2))
+               .with_column("cat", RandomText.picklists(
+                   ["a", "b"], seed=3))).records(n)
+    rng = np.random.default_rng(4)
+    for r in records:
+        m = 3.0 * (r["strong"] or 0) + 0.1 * (r["weak"] or 0)
+        r["label"] = float(rng.uniform() < 1 / (1 + np.exp(-m)))
+    return records
+
+
+def _feat(name, ftype, response=False):
+    b = FeatureBuilder.of(name, ftype).extract(lambda r, n=name: r.get(n))
+    return b.as_response() if response else b.as_predictor()
+
+
+@pytest.fixture(scope="module")
+def trained_with_selector():
+    records = _records()
+    strong = _feat("strong", Real)
+    weak = _feat("weak", Real)
+    cat = _feat("cat", PickList)
+    label = _feat("label", RealNN, response=True)
+    vec = transmogrify([strong, weak, cat])
+    checked = vec.sanity_check(label)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models=[(LogisticRegression(), [{"reg_param": r}
+                                        for r in (0.0, 0.1)])])
+    pred = sel.set_input(label, checked).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(records).train())
+    return model, records, pred
+
+
+class TestModelInsights:
+    def test_label_summary(self, trained_with_selector):
+        model, _, _ = trained_with_selector
+        insights = model.model_insights()
+        assert insights.label.name == "label"
+        assert insights.label.distinct_count == 2
+        assert 0.0 < insights.label.mean < 1.0
+
+    def test_feature_contributions_ranked(self, trained_with_selector):
+        model, _, _ = trained_with_selector
+        insights = model.model_insights()
+        by_name = {f.feature_name: f for f in insights.features}
+        assert "strong" in by_name
+        assert by_name["strong"].total_contribution > \
+            by_name["weak"].total_contribution
+
+    def test_selected_model_info(self, trained_with_selector):
+        model, _, _ = trained_with_selector
+        insights = model.model_insights()
+        assert insights.selected_model is not None
+        assert insights.selected_model["bestModelName"] == \
+            "LogisticRegression"
+        assert len(insights.selected_model["validationResults"]) == 2
+
+    def test_sanity_checker_stats_attached(self, trained_with_selector):
+        model, _, _ = trained_with_selector
+        insights = model.model_insights()
+        derived = [d for f in insights.features for d in f.derived]
+        assert any(d.corr_label is not None for d in derived)
+        # zero-variance null indicators recorded as dropped
+        assert any(d.is_dropped for d in derived)
+
+    def test_json_and_pretty(self, trained_with_selector):
+        model, _, _ = trained_with_selector
+        js = model.summary()
+        parsed = json.loads(js)
+        assert "label" in parsed and "features" in parsed
+        pretty = model.summary_pretty()
+        assert "Selected model: LogisticRegression" in pretty
+        assert "Top feature contributions" in pretty
+
+
+class TestLOCO:
+    def test_strong_feature_dominates(self, trained_with_selector):
+        model, records, pred = trained_with_selector
+        scored = model.score(records[:30], keep_intermediate=True)
+        sel_model = model.result_features[0].origin_stage
+        vec_feature = model.result_features[0].parents[-1]
+        loco = RecordInsightsLOCO(model=sel_model, top_k=5).set_input(
+            vec_feature)
+        out = loco.transform_columns([scored[vec_feature.name]])
+        assert out.n_rows == 30
+        strong_wins = 0
+        for i in range(30):
+            row = out.boxed(i).value
+            top_name = max(row, key=lambda k: abs(float(json.loads(row[k]))))
+            if top_name == "strong":
+                strong_wins += 1
+        assert strong_wins > 20
+
+    def test_top_k_limits(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(10, 4))
+        y = (X[:, 0] > 0).astype(float)
+        inner = LogisticRegression().fit_arrays(X, y)
+        meta = VectorMetadata(name="v", columns=tuple(
+            VectorColumnMetadata(parent_feature_name=f"p{j}",
+                                 parent_feature_type="Real")
+            for j in range(4)))
+        col = FeatureColumn.vector(X, meta)
+        f = _feat("v", OPVector)
+        loco = RecordInsightsLOCO(model=inner, top_k=2).set_input(f)
+        out = loco.transform_columns([col])
+        assert all(len(out.boxed(i).value) == 2 for i in range(10))
+
+    def test_requires_model(self):
+        f = _feat("v", OPVector)
+        col = FeatureColumn.vector(np.zeros((3, 2)), VectorMetadata(
+            name="v", columns=tuple(
+                VectorColumnMetadata(parent_feature_name=f"p{j}",
+                                     parent_feature_type="Real")
+                for j in range(2))))
+        with pytest.raises(ValueError, match="requires a fitted model"):
+            RecordInsightsLOCO().set_input(f).transform_columns([col])

@@ -1,0 +1,202 @@
+"""Reader + aggregator tests (reference DataReaderTest,
+AggregateDataReaderTest, ConditionalDataReaderTest, CSVReadersTest in
+readers/src/test/ and aggregator tests in features/src/test/)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.aggregators import (
+    ConcatText, CutOffTime, Event, FirstAggregator, GeolocationMidpoint,
+    LastAggregator, LogicalOr, MaxNumeric, MeanNumeric, SumNumeric,
+    UnionMap, UnionSet, default_aggregator)
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.readers import (AggregateDataReader,
+                                       ConditionalDataReader, CSVAutoReader,
+                                       CSVProductReader, DataReaders)
+from transmogrifai_tpu.types import (Binary, Integral, MultiPickList,
+                                     PickList, Real, RealMap, RealNN, Text)
+from transmogrifai_tpu.workflow import Workflow
+
+
+class TestAggregators:
+    def test_sum_skips_nulls(self):
+        assert SumNumeric().reduce([1.0, None, 2.5]) == 3.5
+        assert SumNumeric().reduce([None, None]) is None
+
+    def test_mean(self):
+        assert MeanNumeric().reduce([1.0, 2.0, None, 6.0]) == 3.0
+
+    def test_max_or_concat(self):
+        assert MaxNumeric().reduce([3, 9, 4]) == 9
+        assert LogicalOr().reduce([False, None, True]) is True
+        assert ConcatText(",").reduce(["a", None, "b"]) == "a,b"
+
+    def test_union_set_and_map(self):
+        assert UnionSet().reduce([{"a"}, {"b"}, None]) == {"a", "b"}
+        assert UnionMap().reduce([{"x": 1.0}, {"x": 2.0, "y": "s"}]) == \
+            {"x": 3.0, "y": "s"}
+
+    def test_geolocation_midpoint(self):
+        mid = GeolocationMidpoint().reduce([[0.0, 0.0, 1.0],
+                                            [0.0, 90.0, 1.0]])
+        assert mid[0] == pytest.approx(0.0, abs=1e-6)
+        assert mid[1] == pytest.approx(45.0, abs=1e-6)
+
+    def test_first_last_by_event_date(self):
+        events = [Event(30, "c"), Event(10, "a"), Event(20, "b")]
+        assert LastAggregator().reduce_events(events) == "c"
+        assert FirstAggregator().reduce_events(events) == "a"
+
+    def test_defaults_registry(self):
+        assert isinstance(default_aggregator(Real), SumNumeric)
+        assert isinstance(default_aggregator(Binary), LogicalOr)
+        assert isinstance(default_aggregator(MultiPickList), UnionSet)
+        assert isinstance(default_aggregator(RealMap), UnionMap)
+        assert isinstance(default_aggregator(Text), ConcatText)
+
+
+def _events_records():
+    """Per-user dated purchase events."""
+    return [
+        {"user": "u1", "t": 100, "amount": 10.0, "label": 0.0},
+        {"user": "u1", "t": 200, "amount": 5.0, "label": 0.0},
+        {"user": "u1", "t": 300, "amount": 2.0, "label": 1.0},  # after cut
+        {"user": "u2", "t": 150, "amount": 7.0, "label": 0.0},
+        {"user": "u2", "t": 400, "amount": 1.0, "label": 1.0},  # after cut
+    ]
+
+
+def _feat(name, ftype, response=False, aggregator=None):
+    b = FeatureBuilder.of(name, ftype).extract(lambda r, n=name: r.get(n))
+    if aggregator is not None:
+        b = b.aggregate(aggregator)
+    return b.as_response() if response else b.as_predictor()
+
+
+class TestAggregateReader:
+    def test_cutoff_separates_predictors_and_responses(self):
+        amount = _feat("amount", Real)  # default Sum
+        label = _feat("label", RealNN, response=True,
+                      aggregator=MaxNumeric())
+        reader = AggregateDataReader(
+            records=_events_records(), key_fn=lambda r: r["user"],
+            timestamp_fn=lambda r: r["t"],
+            cutoff_time=CutOffTime.unix_ms(250))
+        ds = reader.generate_dataset([amount, label])
+        assert ds.keys == ["u1", "u2"]
+        # u1 predictors: 10+5 (t<=250); u2: 7
+        np.testing.assert_allclose(ds["amount"].data, [15.0, 7.0])
+        # responses only after cutoff
+        np.testing.assert_allclose(ds["label"].data, [1.0, 1.0])
+
+    def test_window_limits_history(self):
+        amount = FeatureBuilder.of("amount", Real).extract(
+            lambda r: r.get("amount")).window(100).as_predictor()
+        reader = AggregateDataReader(
+            records=_events_records(), key_fn=lambda r: r["user"],
+            timestamp_fn=lambda r: r["t"],
+            cutoff_time=CutOffTime.unix_ms(250))
+        ds = reader.generate_dataset([amount])
+        # u1: only t=200 within (150, 250]; u2: none in window
+        assert ds["amount"].data[0] == 5.0
+        assert np.isnan(ds["amount"].data[1])
+
+    def test_in_workflow(self):
+        from transmogrifai_tpu.models import LogisticRegression
+        from transmogrifai_tpu.ops import transmogrify
+        rng = np.random.default_rng(0)
+        records = []
+        for u in range(60):
+            spend = float(rng.uniform(1, 20))
+            records.append({"user": f"u{u}", "t": 10, "amount": spend,
+                            "label": 0.0})
+            records.append({"user": f"u{u}", "t": 500,
+                            "amount": float(rng.uniform(0, 2)),
+                            "label": float(spend > 10)})
+        amount = _feat("amount", Real)
+        label = _feat("label", RealNN, response=True,
+                      aggregator=MaxNumeric())
+        reader = DataReaders.Aggregate.custom(
+            records, key_fn=lambda r: r["user"],
+            timestamp_fn=lambda r: r["t"],
+            cutoff_time=CutOffTime.unix_ms(250))
+        vec = transmogrify([amount])
+        pred = LogisticRegression().set_input(label, vec).get_output()
+        model = (Workflow().set_result_features(pred)
+                 .set_reader(reader).train())
+        scored = model.score(reader)
+        by_user = {r["user"]: float(r["amount"] > 10)
+                   for r in records if r["t"] == 10}
+        expected = np.asarray([by_user[k] for k in
+                               sorted(by_user)])  # readers sort keys
+        acc = np.mean(scored[pred.name].data == expected)
+        assert acc > 0.95
+
+
+class TestConditionalReader:
+    def test_per_key_cutoff(self):
+        records = [
+            {"u": "a", "t": 10, "v": 1.0, "target": False},
+            {"u": "a", "t": 20, "v": 2.0, "target": True},   # cutoff = 20
+            {"u": "a", "t": 30, "v": 4.0, "target": False},
+            {"u": "b", "t": 5, "v": 7.0, "target": True},    # cutoff = 5
+            {"u": "b", "t": 50, "v": 9.0, "target": False},
+            {"u": "c", "t": 99, "v": 5.0, "target": False},  # no target
+        ]
+        v = _feat("v", Real)
+        resp = (FeatureBuilder.of("resp", RealNN)
+                .extract(lambda r: r.get("v"))
+                .aggregate(FirstAggregator()).as_response())
+        reader = ConditionalDataReader(
+            records=records, key_fn=lambda r: r["u"],
+            timestamp_fn=lambda r: r["t"],
+            target_condition=lambda r: r["target"])
+        ds = reader.generate_dataset([v, resp])
+        assert ds.keys == ["a", "b"]  # c dropped (no target event)
+        # predictors strictly before the target event
+        np.testing.assert_allclose(ds["v"].data, [1.0, np.nan])
+        # responses at/after the target event (first value)
+        resp_col = ds[resp.name]
+        np.testing.assert_allclose(resp_col.data, [2.0, 7.0])
+
+
+class TestCSVReaders:
+    @pytest.fixture()
+    def csv_file(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("id,age,name,score\n"
+                     "1,30,alice,0.5\n"
+                     "2,,bob,1.5\n"
+                     "3,41,,2.5\n")
+        return str(p)
+
+    def test_product_reader_strings(self, csv_file):
+        rows = CSVProductReader(csv_file).read_records()
+        assert rows[0] == {"id": "1", "age": "30", "name": "alice",
+                           "score": "0.5"}
+        assert rows[1]["age"] is None
+        assert rows[2]["name"] is None
+
+    def test_auto_reader_types(self, csv_file):
+        rows = CSVAutoReader(csv_file).read_records()
+        assert rows[0]["age"] == 30 and isinstance(rows[0]["age"], int)
+        assert rows[0]["score"] == 0.5
+        assert rows[1]["age"] is None
+        assert rows[0]["name"] == "alice"
+
+    def test_workflow_with_csv_reader(self, csv_file):
+        age = _feat("age", Real)
+        ds = DataReaders.Simple.csv_auto(csv_file).generate_dataset([age])
+        np.testing.assert_allclose(ds["age"].data, [30.0, np.nan, 41.0])
+
+
+class TestParquetReader:
+    def test_round_trip(self, tmp_path):
+        import pandas as pd
+        df = pd.DataFrame({"x": [1.0, np.nan, 3.0], "s": ["a", "b", None]})
+        p = str(tmp_path / "d.parquet")
+        try:
+            df.to_parquet(p)
+        except ImportError:
+            pytest.skip("no parquet engine in image")
+        rows = DataReaders.Simple.parquet(p).read_records()
+        assert rows[0]["x"] == 1.0 and rows[1]["x"] is None

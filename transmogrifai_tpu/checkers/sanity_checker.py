@@ -1,0 +1,278 @@
+"""SanityChecker: automated feature validation and pruning.
+
+TPU-native port of the reference SanityChecker
+(core/src/main/scala/com/salesforce/op/stages/impl/preparators/
+SanityChecker.scala:236, fitFn:535, params :61-206, metadata
+SanityCheckerMetadata.scala): a BinaryEstimator over (RealNN label,
+OPVector features) that computes per-column statistics, label
+correlations and categorical association stats, prunes problematic
+columns, and emits the full summary. The heavy math runs as XLA kernels
+(utils/stats.py): one fused pass for moments + label correlation, and
+per-group contingency tables for Cramér's V / chi² / mutual info /
+association-rule confidence.
+
+Pruning rules (same thresholds as the reference defaults):
+- variance < ``min_variance``                       -> drop column
+- |corr(label)| > ``max_correlation``               -> drop (leakage)
+- |corr(label)| < ``min_correlation``               -> drop (noise)
+- group Cramér's V > ``max_cramers_v``              -> drop whole group
+- association rule confidence >= ``max_rule_confidence`` with support
+  >= ``min_required_rule_support``                  -> drop whole group
+
+Categorical groups come from the vector metadata's indicator groups —
+the one-hot columns of a parent feature form one group and are kept or
+removed together (reference group-aware removal).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..features.columns import FeatureColumn
+from ..stages.base import AllowLabelAsInput, BinaryEstimator, BinaryModel
+from ..types import OPVector, RealNN
+from ..utils.stats import col_stats, contingency_stats, correlation_with_label
+from ..utils.vector_meta import VectorMetadata
+
+__all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary",
+           "ColumnStatistics"]
+
+#: labels with more distinct values than this are treated as continuous and
+#: categorical association stats are skipped (reference categoricalLabel
+#: heuristic in SanityChecker.fitFn)
+MAX_LABEL_CARDINALITY = 100
+
+
+@dataclass
+class ColumnStatistics:
+    """Per-column record in the summary (reference SanityCheckerMetadata)."""
+    name: str
+    column_index: int
+    variance: float
+    mean: float
+    min: float
+    max: float
+    corr_label: float
+    cramers_v: Optional[float] = None
+    max_rule_confidence: Optional[float] = None
+    support: Optional[float] = None
+    is_dropped: bool = False
+    reasons: List[str] = field(default_factory=list)
+    #: provenance from the vector metadata (stable across index
+    #: renumbering after pruning; used by ModelInsights matching)
+    parent_feature_name: Optional[str] = None
+    grouping: Optional[str] = None
+    indicator_value: Optional[str] = None
+    descriptor_value: Optional[str] = None
+
+    def provenance_key(self) -> tuple:
+        return (self.parent_feature_name, self.grouping,
+                self.indicator_value, self.descriptor_value)
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "columnIndex": self.column_index,
+                "variance": self.variance, "mean": self.mean,
+                "min": self.min, "max": self.max,
+                "corrLabel": self.corr_label, "cramersV": self.cramers_v,
+                "maxRuleConfidence": self.max_rule_confidence,
+                "support": self.support, "isDropped": self.is_dropped,
+                "reasons": list(self.reasons),
+                "parentFeatureName": self.parent_feature_name,
+                "grouping": self.grouping,
+                "indicatorValue": self.indicator_value,
+                "descriptorValue": self.descriptor_value}
+
+
+@dataclass
+class SanityCheckerSummary:
+    """(reference SanityCheckerSummary metadata)"""
+    column_stats: List[ColumnStatistics] = field(default_factory=list)
+    dropped: List[str] = field(default_factory=list)
+    kept_indices: List[int] = field(default_factory=list)
+    sample_size: int = 0
+
+    def to_json(self) -> dict:
+        return {"columnStats": [c.to_json() for c in self.column_stats],
+                "dropped": list(self.dropped),
+                "keptIndices": list(self.kept_indices),
+                "sampleSize": self.sample_size}
+
+
+class SanityChecker(AllowLabelAsInput, BinaryEstimator):
+    """(reference SanityChecker.scala:236)"""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+
+    def __init__(self, check_sample: float = 1.0, sample_seed: int = 42,
+                 sample_limit: int = 100_000, max_correlation: float = 0.95,
+                 min_correlation: float = 0.0, min_variance: float = 1e-5,
+                 max_cramers_v: float = 0.95,
+                 min_required_rule_support: float = 0.001,
+                 max_rule_confidence: float = 1.0,
+                 remove_bad_features: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="sanityChecker", uid=uid)
+        self.check_sample = check_sample
+        self.sample_seed = sample_seed
+        self.sample_limit = sample_limit
+        self.max_correlation = max_correlation
+        self.min_correlation = min_correlation
+        self.min_variance = min_variance
+        self.max_cramers_v = max_cramers_v
+        self.min_required_rule_support = min_required_rule_support
+        self.max_rule_confidence = max_rule_confidence
+        self.remove_bad_features = remove_bad_features
+
+    def check_input_constraints(self, features) -> None:
+        label, vec = features
+        if not label.is_response:
+            raise ValueError("SanityChecker input 1 must be the response")
+        if vec.is_response:
+            raise ValueError("SanityChecker input 2 must not be a response")
+
+    # -- fitting -----------------------------------------------------------
+    def fit_columns(self, cols: List[FeatureColumn]) -> "SanityCheckerModel":
+        y = np.asarray(cols[0].data, dtype=np.float64)
+        X = np.asarray(cols[1].data, dtype=np.float64)
+        meta = cols[1].metadata or VectorMetadata(name="features")
+        n, d = X.shape
+
+        # sampling (reference checkSample/sampleLimit, fitFn:535)
+        idx = np.arange(n)
+        target = min(int(np.ceil(n * self.check_sample)), self.sample_limit)
+        if target < n:
+            rng = np.random.default_rng(self.sample_seed)
+            idx = np.sort(rng.choice(n, target, replace=False))
+        Xs, ys = X[idx], y[idx]
+
+        stats = col_stats(Xs)
+        corr = correlation_with_label(Xs, ys)
+
+        names = meta.column_names() if meta.size == d else \
+            [f"f{i}" for i in range(d)]
+        col_recs = []
+        for j in range(d):
+            rec = ColumnStatistics(
+                name=names[j], column_index=j,
+                variance=float(stats.variance[j]), mean=float(stats.mean[j]),
+                min=float(stats.min[j]), max=float(stats.max[j]),
+                corr_label=float(corr[j]))
+            if meta.size == d:
+                mc = meta.columns[j]
+                rec.parent_feature_name = mc.parent_feature_name
+                rec.grouping = mc.grouping
+                rec.indicator_value = mc.indicator_value
+                rec.descriptor_value = mc.descriptor_value
+            col_recs.append(rec)
+
+        def drop(j: int, reason: str):
+            col_recs[j].is_dropped = True
+            col_recs[j].reasons.append(reason)
+
+        # per-column rules
+        for j in range(d):
+            if col_recs[j].variance < self.min_variance:
+                drop(j, f"variance {col_recs[j].variance:.3g} below "
+                        f"minVariance {self.min_variance}")
+            c = col_recs[j].corr_label
+            if np.isfinite(c):
+                if abs(c) > self.max_correlation:
+                    drop(j, f"label correlation {c:.3f} above "
+                            f"maxCorrelation {self.max_correlation}")
+                elif abs(c) < self.min_correlation:
+                    drop(j, f"label correlation {c:.3f} below "
+                            f"minCorrelation {self.min_correlation}")
+
+        # categorical association rules per indicator group
+        labels = np.unique(ys)
+        if meta.size == d and 2 <= len(labels) <= MAX_LABEL_CARDINALITY:
+            onehot_label = ys[:, None] == labels[None, :]
+            for group_key, indices in meta.indicator_groups().items():
+                # contingency: level rows x label cols
+                table = np.stack(
+                    [(Xs[:, j][:, None] * onehot_label).sum(axis=0)
+                     for j in indices])
+                cs = contingency_stats(table)
+                for k, j in enumerate(indices):
+                    col_recs[j].cramers_v = cs.cramers_v
+                    col_recs[j].max_rule_confidence = \
+                        float(cs.max_rule_confidences[k]) \
+                        if k < len(cs.max_rule_confidences) else None
+                    col_recs[j].support = float(cs.supports[k]) \
+                        if k < len(cs.supports) else None
+                group_bad = []
+                if np.isfinite(cs.cramers_v) and \
+                        cs.cramers_v > self.max_cramers_v:
+                    group_bad.append(
+                        f"group Cramér's V {cs.cramers_v:.3f} above "
+                        f"maxCramersV {self.max_cramers_v}")
+                strong_rule = (
+                    (cs.max_rule_confidences >= self.max_rule_confidence)
+                    & (cs.supports >= self.min_required_rule_support))
+                if strong_rule.any():
+                    group_bad.append(
+                        "association rule confidence above "
+                        f"maxRuleConfidence {self.max_rule_confidence}")
+                for reason in group_bad:
+                    for j in indices:
+                        drop(j, reason)
+
+        kept = [j for j in range(d) if not col_recs[j].is_dropped] \
+            if self.remove_bad_features else list(range(d))
+        if not kept:
+            raise ValueError(
+                "SanityChecker dropped every feature column — relax the "
+                "thresholds (minVariance/maxCorrelation/maxCramersV)")
+        summary = SanityCheckerSummary(
+            column_stats=col_recs,
+            dropped=[col_recs[j].name for j in range(d)
+                     if col_recs[j].is_dropped],
+            kept_indices=kept, sample_size=len(idx))
+        model = SanityCheckerModel(
+            kept_indices=kept,
+            output_metadata=(meta.select(kept) if meta.size == d else None))
+        model.summary = summary
+        return model
+
+
+class SanityCheckerModel(AllowLabelAsInput, BinaryModel):
+    """Vector slice by kept indices (reference: the fitted SanityChecker
+    model behaves like DropIndicesByTransformer)."""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+    summary: Optional[SanityCheckerSummary] = None
+
+    def __init__(self, kept_indices: Sequence[int],
+                 output_metadata: Optional[VectorMetadata] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="sanityChecker", uid=uid)
+        self.kept_indices = [int(i) for i in kept_indices]
+        self.output_metadata = output_metadata
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        vec = cols[-1]
+        data = np.asarray(vec.data, dtype=np.float64)[:, self.kept_indices]
+        meta = self.output_metadata
+        if meta is None:
+            src = vec.metadata
+            meta = (src.select(self.kept_indices) if src is not None
+                    and src.size == np.asarray(vec.data).shape[1] else None)
+        if meta is None:
+            from ..utils.vector_meta import VectorColumnMetadata
+            meta = VectorMetadata(
+                name=self.get_output().name if self.input_features else "v",
+                columns=tuple(VectorColumnMetadata(
+                    parent_feature_name="features",
+                    parent_feature_type="OPVector")
+                    for _ in self.kept_indices))
+        return FeatureColumn.vector(data, meta)
+
+    def transform_value(self, *values):
+        vec = values[-1]
+        arr = np.asarray(vec.value if hasattr(vec, "value") else vec,
+                         dtype=np.float64).reshape(1, -1)
+        return OPVector(arr[0, self.kept_indices])

@@ -137,6 +137,14 @@ class Feature:
                          is_response=f.is_response, origin_stage=stage,
                          parents=new_parents, uid=f.uid,
                          distributions=f.distributions)
+            swapped = (f.origin_stage is not None
+                       and f.origin_stage.uid in stage_map)
+            if swapped and new_parents:
+                # wire the swapped-in fitted model to the rebuilt DAG so
+                # execution derives the same column names; stages shared
+                # with the source graph are left untouched
+                stage.input_features = new_parents
+                stage._output_feature = nf
             cache[f.uid] = nf
             return nf
 
@@ -199,6 +207,12 @@ class Feature:
         from ..ops.categorical import OneHotVectorizer
         return OneHotVectorizer(top_k=top_k, min_support=min_support
                                 ).set_input(self).get_output()
+
+    def sanity_check(self, label: "Feature", **params) -> "Feature":
+        """Prune this feature vector against the label
+        (reference RichNumericFeature.sanityCheck:479)."""
+        from ..checkers import SanityChecker
+        return SanityChecker(**params).set_input(label, self).get_output()
 
     def alias(self, name: str) -> "Feature":
         """Rename via an identity stage (reference RichFeature.alias /

@@ -1,0 +1,10 @@
+"""Explainability (SURVEY §2.11; core/.../ModelInsights.scala:72,
+core/.../insights/RecordInsightsLOCO.scala:54)."""
+from .loco import RecordInsightsLOCO
+from .model_insights import (DerivedFeatureInsight, FeatureInsights,
+                             LabelSummary, ModelInsights,
+                             extract_model_insights)
+
+__all__ = ["RecordInsightsLOCO", "ModelInsights", "LabelSummary",
+           "FeatureInsights", "DerivedFeatureInsight",
+           "extract_model_insights"]

@@ -1,0 +1,9 @@
+"""Typed data ingestion (SURVEY §2.12; readers/src/main/scala/com/
+salesforce/op/readers/)."""
+from .data_readers import (AggregateDataReader, ConditionalDataReader,
+                           CSVAutoReader, CSVProductReader, DataReader,
+                           DataReaders, ParquetProductReader)
+
+__all__ = ["DataReader", "AggregateDataReader", "ConditionalDataReader",
+           "CSVProductReader", "CSVAutoReader", "ParquetProductReader",
+           "DataReaders"]

@@ -1,0 +1,357 @@
+"""Typed data readers: simple, aggregate, and conditional ingestion.
+
+TPU-native port of the reference readers module
+(readers/src/main/scala/com/salesforce/op/readers/{Reader.scala:96,168,
+DataReader.scala:57,173,206,252,288,351, DataReaders.scala:44}):
+a reader loads raw records (CSV/Parquet/in-memory), optionally groups
+them by key and monoid-aggregates each feature's dated events around a
+cutoff time, and materializes the raw-feature Dataset the workflow
+trains on. Where the reference runs extract fns in a Spark RDD map,
+here extraction is a host-side columnar pass feeding device arrays.
+
+- :class:`DataReader` — one record = one row (simple readers).
+- :class:`AggregateDataReader` — groupBy(key); predictors aggregate
+  events at/before the cutoff, responses after it (leakage-safe
+  feature/label windows, DataReader.scala:206-330).
+- :class:`ConditionalDataReader` — per-key cutoff from a target
+  condition (e.g. "first purchase"); predictors aggregate before the
+  key's own event, responses within a window after
+  (ConditionalParams:351).
+"""
+from __future__ import annotations
+
+import csv as _csv
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..features.aggregators import CutOffTime, Event, default_aggregator
+from ..features.columns import Dataset, FeatureColumn
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..types import OPNumeric
+from ..types.base import NonNullable
+
+
+def _box_aggregated(ftype, values: List[Any]) -> List[Any]:
+    """Box aggregated values; non-nullable numeric types get the monoid
+    zero for keys with no surviving events (reference: RealNN monoid zero
+    is 0.0, MonoidAggregatorDefaults.scala)."""
+    if issubclass(ftype, NonNullable) and issubclass(ftype, OPNumeric):
+        values = [0.0 if v is None else v for v in values]
+    return [ftype.from_any(v) for v in values]
+
+__all__ = ["DataReader", "AggregateDataReader", "ConditionalDataReader",
+           "CSVProductReader", "CSVAutoReader", "ParquetProductReader",
+           "DataReaders"]
+
+
+class DataReader:
+    """Batch reader over in-memory records or a file
+    (reference DataReader.scala:57; key fn per ReaderKey.scala:74-94)."""
+
+    def __init__(self, records: Optional[Iterable[Any]] = None,
+                 key_fn: Optional[Callable[[Any], str]] = None,
+                 source: Optional["DataReader"] = None):
+        self._records = list(records) if records is not None else None
+        self._source = source
+        self.key_fn = key_fn
+
+    # -- loading -----------------------------------------------------------
+    def read_records(self) -> List[Any]:
+        if self._records is not None:
+            return self._records
+        if self._source is not None:
+            return self._source.read_records()  # lazy file I/O
+        raise ValueError(f"{type(self).__name__} has no data source")
+
+    # -- materialization (reference generateDataFrame:173) -----------------
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        records = self.read_records()
+        cols: Dict[str, FeatureColumn] = {}
+        for f in raw_features:
+            gen = self._generator(f)
+            cols[f.name] = gen.extract_column(records)
+        return Dataset(cols)
+
+    @staticmethod
+    def _generator(f: Feature) -> FeatureGeneratorStage:
+        gen = f.origin_stage
+        if not isinstance(gen, FeatureGeneratorStage):
+            raise TypeError(f"Feature {f.name!r} has no generator stage")
+        return gen
+
+
+class AggregateDataReader(DataReader):
+    """GroupBy-key + monoid aggregation with a cutoff
+    (reference AggregateDataReader, DataReader.scala:252).
+
+    ``timestamp_fn`` extracts each record's event time (ms). Predictor
+    features aggregate events with ``time <= cutoff`` (within
+    ``predictor_window_ms`` when set on the feature builder); response
+    features aggregate events with ``time > cutoff`` (within
+    ``response_window_ms``) — the reference's leakage-safe windows.
+    """
+
+    def __init__(self, records: Optional[Iterable[Any]] = None,
+                 key_fn: Optional[Callable[[Any], str]] = None,
+                 timestamp_fn: Optional[Callable[[Any], int]] = None,
+                 cutoff_time: Optional[CutOffTime] = None,
+                 response_window_ms: Optional[int] = None,
+                 source: Optional[DataReader] = None):
+        super().__init__(records, key_fn, source=source)
+        if key_fn is None:
+            raise ValueError("AggregateDataReader requires key_fn")
+        self.timestamp_fn = timestamp_fn or (lambda r: 0)
+        self.cutoff_time = cutoff_time or CutOffTime.no_cutoff()
+        self.response_window_ms = response_window_ms
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        records = self.read_records()
+        groups: Dict[str, List[Any]] = {}
+        for r in records:
+            groups.setdefault(str(self.key_fn(r)), []).append(r)
+        keys = sorted(groups)
+        cutoff = self.cutoff_time.time_ms
+
+        cols: Dict[str, FeatureColumn] = {}
+        for f in raw_features:
+            gen = self._generator(f)
+            agg = gen.aggregator or default_aggregator(f.ftype)
+            window = gen.aggregate_window_ms
+            values: List[Any] = []
+            for k in keys:
+                events = [Event(int(self.timestamp_fn(r)),
+                                gen.extract_fn(r), f.is_response)
+                          for r in groups[k]]
+                events = self._filter(events, f.is_response, cutoff, window)
+                if hasattr(agg, "reduce_events"):
+                    values.append(agg.reduce_events(events))
+                else:
+                    values.append(agg.reduce([e.value for e in events]))
+            values = [v.value if hasattr(v, "value") else v for v in values]
+            cols[f.name] = FeatureColumn.from_values(
+                f.ftype, _box_aggregated(f.ftype, values))
+        ds = Dataset(cols)
+        ds.keys = keys  # row identity (reference KeyFieldName column)
+        return ds
+
+    def _filter(self, events: List[Event], is_response: bool,
+                cutoff: Optional[int], window: Optional[int]
+                ) -> List[Event]:
+        if cutoff is None:
+            return events
+        if is_response:
+            kept = [e for e in events if e.date_ms > cutoff]
+            if self.response_window_ms is not None:
+                kept = [e for e in kept
+                        if e.date_ms <= cutoff + self.response_window_ms]
+        else:
+            kept = [e for e in events if e.date_ms <= cutoff]
+            if window is not None:
+                kept = [e for e in kept if e.date_ms > cutoff - window]
+        return kept
+
+
+class ConditionalDataReader(AggregateDataReader):
+    """Per-key cutoff from a target condition
+    (reference ConditionalDataReader, DataReader.scala:288 +
+    ConditionalParams:351): each key's cutoff is the time of its first
+    record matching ``target_condition``; keys with no match are dropped
+    (``drop_if_no_target=True``) or, when kept, contribute all events to
+    predictors and none to responses (no label without a target event —
+    leakage-safe)."""
+
+    def __init__(self, records: Optional[Iterable[Any]] = None,
+                 key_fn: Optional[Callable[[Any], str]] = None,
+                 timestamp_fn: Optional[Callable[[Any], int]] = None,
+                 target_condition: Optional[Callable[[Any], bool]] = None,
+                 response_window_ms: Optional[int] = None,
+                 predictor_window_ms: Optional[int] = None,
+                 drop_if_no_target: bool = True,
+                 source: Optional[DataReader] = None):
+        super().__init__(records, key_fn, timestamp_fn,
+                         CutOffTime.no_cutoff(), response_window_ms,
+                         source=source)
+        if target_condition is None:
+            raise ValueError("ConditionalDataReader requires "
+                             "target_condition")
+        self.target_condition = target_condition
+        self.predictor_window_ms = predictor_window_ms
+        self.drop_if_no_target = drop_if_no_target
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        records = self.read_records()
+        groups: Dict[str, List[Any]] = {}
+        for r in records:
+            groups.setdefault(str(self.key_fn(r)), []).append(r)
+
+        cutoffs: Dict[str, int] = {}
+        for k, rs in groups.items():
+            times = [int(self.timestamp_fn(r)) for r in rs
+                     if self.target_condition(r)]
+            if times:
+                cutoffs[k] = min(times)
+        keys = sorted(cutoffs if self.drop_if_no_target else groups)
+
+        cols: Dict[str, FeatureColumn] = {}
+        for f in raw_features:
+            gen = self._generator(f)
+            agg = gen.aggregator or default_aggregator(f.ftype)
+            window = gen.aggregate_window_ms or self.predictor_window_ms
+            values: List[Any] = []
+            for k in keys:
+                cutoff = cutoffs.get(k)
+                events = [Event(int(self.timestamp_fn(r)),
+                                gen.extract_fn(r), f.is_response)
+                          for r in groups[k]]
+                if cutoff is not None:
+                    events = self._filter_conditional(
+                        events, f.is_response, cutoff, window)
+                elif f.is_response:
+                    events = []  # no target event -> no response value
+                if hasattr(agg, "reduce_events"):
+                    values.append(agg.reduce_events(events))
+                else:
+                    values.append(agg.reduce([e.value for e in events]))
+            values = [v.value if hasattr(v, "value") else v for v in values]
+            cols[f.name] = FeatureColumn.from_values(
+                f.ftype, _box_aggregated(f.ftype, values))
+        ds = Dataset(cols)
+        ds.keys = keys
+        return ds
+
+    def _filter_conditional(self, events, is_response, cutoff, window):
+        """Predictors strictly before the target event; responses at/after
+        it (the target row itself carries the response)."""
+        if is_response:
+            kept = [e for e in events if e.date_ms >= cutoff]
+            if self.response_window_ms is not None:
+                kept = [e for e in kept
+                        if e.date_ms < cutoff + self.response_window_ms]
+        else:
+            kept = [e for e in events if e.date_ms < cutoff]
+            if window is not None:
+                kept = [e for e in kept if e.date_ms >= cutoff - window]
+        return kept
+
+
+# ---------------------------------------------------------------------------
+# file-format readers (reference CSVReaders.scala / CSVAutoReaders.scala /
+# ParquetProductReader.scala)
+# ---------------------------------------------------------------------------
+
+def _parse_cell(v: str):
+    if v is None or v == "":
+        return None
+    return v
+
+
+class CSVProductReader(DataReader):
+    """Header CSV -> dict records, raw strings (reference csvCase readers;
+    typed conversion happens in feature extract fns)."""
+
+    def __init__(self, path: str,
+                 key_fn: Optional[Callable[[Any], str]] = None):
+        super().__init__(records=None, key_fn=key_fn)
+        self.path = path
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        with open(self.path, newline="") as fh:
+            return [{k: _parse_cell(v) for k, v in row.items()}
+                    for row in _csv.DictReader(fh)]
+
+
+class CSVAutoReader(CSVProductReader):
+    """CSV with schema inference: numeric-looking cells become floats/ints
+    (reference CSVAutoReaders.scala + spark-csv inference)."""
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        rows = super().read_records()
+        if not rows:
+            return rows
+        cols = rows[0].keys()
+        casts: Dict[str, Callable] = {}
+        for c in cols:
+            vals = [r[c] for r in rows if r[c] is not None]
+            if vals and all(_is_number(v) for v in vals):
+                casts[c] = float if any("." in v or "e" in v.lower()
+                                        for v in vals) else int
+        for r in rows:
+            for c, cast in casts.items():
+                if r[c] is not None:
+                    r[c] = cast(float(r[c]))
+        return rows
+
+
+def _is_number(v: str) -> bool:
+    try:
+        float(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+class ParquetProductReader(DataReader):
+    """Parquet via pandas/pyarrow (reference ParquetProductReader.scala)."""
+
+    def __init__(self, path: str,
+                 key_fn: Optional[Callable[[Any], str]] = None):
+        super().__init__(records=None, key_fn=key_fn)
+        self.path = path
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        import pandas as pd
+        df = pd.read_parquet(self.path)
+        recs = df.to_dict(orient="records")
+        for r in recs:
+            for k, v in r.items():
+                if isinstance(v, float) and np.isnan(v):
+                    r[k] = None
+        return recs
+
+
+class DataReaders:
+    """Factory namespace (reference DataReaders.scala:44)."""
+
+    class Simple:
+        @staticmethod
+        def csv(path: str, key_fn=None) -> CSVProductReader:
+            return CSVProductReader(path, key_fn)
+
+        @staticmethod
+        def csv_auto(path: str, key_fn=None) -> CSVAutoReader:
+            return CSVAutoReader(path, key_fn)
+
+        @staticmethod
+        def parquet(path: str, key_fn=None) -> ParquetProductReader:
+            return ParquetProductReader(path, key_fn)
+
+        @staticmethod
+        def custom(records, key_fn=None) -> DataReader:
+            return DataReader(records, key_fn)
+
+    class Aggregate:
+        @staticmethod
+        def csv(path: str, key_fn, timestamp_fn, cutoff_time=None,
+                response_window_ms=None) -> AggregateDataReader:
+            return AggregateDataReader(
+                source=CSVProductReader(path),
+                key_fn=key_fn, timestamp_fn=timestamp_fn,
+                cutoff_time=cutoff_time,
+                response_window_ms=response_window_ms)
+
+        @staticmethod
+        def custom(records, key_fn, timestamp_fn, cutoff_time=None,
+                   response_window_ms=None) -> AggregateDataReader:
+            return AggregateDataReader(records, key_fn, timestamp_fn,
+                                       cutoff_time, response_window_ms)
+
+    class Conditional:
+        @staticmethod
+        def custom(records, key_fn, timestamp_fn, target_condition,
+                   response_window_ms=None, predictor_window_ms=None,
+                   drop_if_no_target=True) -> ConditionalDataReader:
+            return ConditionalDataReader(
+                records, key_fn, timestamp_fn, target_condition,
+                response_window_ms, predictor_window_ms, drop_if_no_target)

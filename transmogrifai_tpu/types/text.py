@@ -38,6 +38,23 @@ class Text(FeatureType):
         return self._value is None
 
 
+class _CoerceNumeric:
+    """Mixin for categorical text types: numeric category codes (e.g. CSV
+    "pclass" 1/2/3) stringify, as the reference's .toPickList enrichment
+    does. Semantic types (Email, URL, ...) stay strict."""
+
+    @classmethod
+    def _convert(cls, value: Any) -> Optional[str]:
+        if isinstance(value, bool):
+            raise FeatureTypeError(
+                f"Cannot convert {value!r} to {cls.__name__}")
+        if isinstance(value, (int, float)):
+            if isinstance(value, float) and value.is_integer():
+                return str(int(value))
+            return str(value)
+        return Text._convert.__func__(cls, value)
+
+
 @register_feature_type
 class Email(Text):
     """Email address (Text.scala:65); exposes prefix/domain accessors."""
@@ -91,7 +108,7 @@ class Phone(Text):
 
 
 @register_feature_type
-class ID(Text):
+class ID(_CoerceNumeric, Text):
     """Entity id (Text.scala:153)."""
     __slots__ = ()
 
@@ -136,13 +153,13 @@ class TextArea(Text):
 
 
 @register_feature_type
-class PickList(Categorical, SingleResponse, Text):
+class PickList(_CoerceNumeric, Categorical, SingleResponse, Text):
     """Single-select categorical (Text.scala:215)."""
     __slots__ = ()
 
 
 @register_feature_type
-class ComboBox(Categorical, Text):
+class ComboBox(_CoerceNumeric, Categorical, Text):
     """Categorical with free-form entry allowed (Text.scala:228)."""
     __slots__ = ()
 

@@ -47,6 +47,28 @@ def _generate_raw_data(raw_features: Sequence[Feature], data: Any,
     become all-NaN columns so non-nullable label types don't block
     label-free scoring.
     """
+    from ..readers.data_readers import DataReader
+    if isinstance(data, DataReader):
+        # (reference reader.generateDataFrame, Reader.scala:168)
+        if require_responses:
+            data = data.generate_dataset(raw_features)
+        else:
+            # label-free scoring: a response column the data can't
+            # produce becomes all-NaN instead of failing extraction
+            predictors = [f for f in raw_features if not f.is_response]
+            ds0 = data.generate_dataset(predictors)
+            cols0 = {f.name: ds0[f.name] for f in predictors}
+            n0 = ds0.n_rows
+            for f in raw_features:
+                if not f.is_response:
+                    continue
+                try:
+                    cols0[f.name] = data.generate_dataset([f])[f.name]
+                except Exception:
+                    cols0[f.name] = FeatureColumn(
+                        ftype=f.ftype,
+                        data=np.full(n0, np.nan, dtype=np.float64))
+            data = Dataset(cols0)
     if isinstance(data, Dataset):
         n = data.n_rows
         cols: Dict[str, FeatureColumn] = {}
@@ -122,6 +144,14 @@ class Workflow:
     def __init__(self):
         self.result_features: Tuple[Feature, ...] = ()
         self._input_data: Any = None
+        self._raw_feature_filter = None
+        self._rff_score_data: Any = None
+        #: raw features removed by the RawFeatureFilter (reference
+        #: blacklistedFeatures on OpWorkflow)
+        self.blacklisted_features: Tuple[Feature, ...] = ()
+        #: RawFeatureFilterResults after train() (reference
+        #: getRawFeatureFilterResults)
+        self.raw_feature_filter_results = None
 
     # -- configuration -----------------------------------------------------
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -143,6 +173,22 @@ class Workflow:
         self._input_data = list(records)
         return self
 
+    def set_reader(self, reader) -> "Workflow":
+        """A DataReader supplies (and possibly aggregates) the raw data
+        (reference setReader, OpWorkflowCore.scala:121)."""
+        self._input_data = reader
+        return self
+
+    def with_raw_feature_filter(self, rff,
+                                score_data: Any = None) -> "Workflow":
+        """Enable pre-DAG raw-feature exclusion during ``train()``
+        (reference withRawFeatureFilter on OpWorkflow). ``score_data``
+        optionally supplies scoring-time data for distribution-shift
+        checks."""
+        self._raw_feature_filter = rff
+        self._rff_score_data = score_data
+        return self
+
     # -- introspection -----------------------------------------------------
     def raw_features(self) -> List[Feature]:
         return _unique_raw_features(self.result_features)
@@ -159,13 +205,36 @@ class Workflow:
             raise ValueError("No result features set")
         if self._input_data is None:
             raise ValueError("No input data set")
+        result_features = self.result_features
+        self.blacklisted_features = ()
+        self.raw_feature_filter_results = None
         raw = self.raw_features()
         ds = _generate_raw_data(raw, self._input_data,
                                 require_responses=True)
-        layers = topo_layers(self.result_features)
+        if self._raw_feature_filter is not None:
+            # (reference generateRawData -> RawFeatureFilter
+            #  .generateFilteredRaw, OpWorkflow.scala:222)
+            from ..checkers import rewire_without
+            score_ds = None
+            if self._rff_score_data is not None:
+                score_ds = _generate_raw_data(
+                    raw, self._rff_score_data, require_responses=False)
+            responses = [f for f in raw if f.is_response]
+            label = None
+            if len(responses) == 1 and responses[0].name in ds:
+                label = np.asarray(ds[responses[0].name].data,
+                                   dtype=np.float64)
+            results = self._raw_feature_filter.compute_exclusions(
+                raw, ds, score_ds, label=label)
+            self.raw_feature_filter_results = results
+            if results.excluded_names:
+                result_features, removed = rewire_without(
+                    result_features, results.excluded_names)
+                self.blacklisted_features = tuple(removed)
+        layers = topo_layers(result_features)
         train_ds, fitted = _fit_and_transform_layers(layers, ds, fit=True)
         result = tuple(f.copy_with_new_stages(fitted)
-                       for f in self.result_features)
+                       for f in result_features)
         return WorkflowModel(result_features=result,
                              train_dataset=train_ds)
 
@@ -251,6 +320,32 @@ class WorkflowModel:
         layers = topo_layers([feature])
         out, _ = _fit_and_transform_layers(layers, ds, fit=False)
         return out
+
+    # -- explainability ----------------------------------------------------
+    def model_insights(self):
+        """Post-hoc explainability report
+        (reference OpWorkflowModel.modelInsights:162)."""
+        from ..insights import extract_model_insights
+        return extract_model_insights(self)
+
+    def summary(self) -> str:
+        """JSON summary of all stage metadata (reference summary:182)."""
+        import json
+        return json.dumps(self.model_insights().to_json(), indent=1,
+                          default=str)
+
+    def summary_pretty(self) -> str:
+        """(reference summaryPretty:204)"""
+        insights = self.model_insights()
+        parts = [insights.pretty()]
+        sel = insights.selected_model
+        if sel:
+            from ..selector.selector import SelectedModel
+            for s in self.stages():
+                if isinstance(s, SelectedModel) and s.summary:
+                    parts.append(s.summary.pretty())
+                    break
+        return "\n\n".join(parts)
 
     # -- persistence -------------------------------------------------------
     def save(self, path: str) -> None:
