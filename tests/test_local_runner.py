@@ -1,0 +1,166 @@
+"""Local scoring + runner tests (reference OpWorkflowModelLocalTest,
+OpWorkflowRunnerTest, OpParamsTest)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.local import (ScoreFunction, load_score_function,
+                                     score_function_for)
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.testkit import RandomData, RandomReal, RandomText
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import (OpParams, RunType, Workflow,
+                                        WorkflowRunner)
+
+
+def _make_workflow_and_records(n=200, seed=0):
+    records = (RandomData(seed=seed)
+               .with_column("x", RandomReal.normal(0, 1, seed=1))
+               .with_column("cat", RandomText.picklists(
+                   ["a", "b", "c"], seed=2))).records(n)
+    rng = np.random.default_rng(3)
+    for r in records:
+        r["label"] = float((r["x"] or 0) + 0.2 * rng.normal() > 0)
+    x = FeatureBuilder.of("x", Real).extract(
+        lambda r: r.get("x")).as_predictor()
+    cat = FeatureBuilder.of("cat", PickList).extract(
+        lambda r: r.get("cat")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    vec = transmogrify([x, cat])
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        label, vec).get_output()
+    wf = Workflow().set_result_features(pred).set_input_records(records)
+    return wf, records, pred
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    wf, records, pred = _make_workflow_and_records()
+    model = wf.train()
+    path = str(tmp_path_factory.mktemp("runner") / "model")
+    model.save(path)
+    return model, records, pred, path
+
+
+class TestLocalScoring:
+    def test_matches_batch_path(self, trained):
+        model, records, pred, path = trained
+        fn = score_function_for(model)
+        batch = model.score(records[:20])
+        for i, r in enumerate(records[:20]):
+            out = fn(r)
+            assert out[pred.name]["prediction"] == \
+                batch[pred.name].data[i]
+            np.testing.assert_allclose(
+                [out[pred.name]["probability_0"],
+                 out[pred.name]["probability_1"]],
+                batch[pred.name].probability[i], atol=1e-9)
+
+    def test_label_free_record(self, trained):
+        model, records, pred, path = trained
+        fn = score_function_for(model)
+        rec = {k: v for k, v in records[0].items() if k != "label"}
+        out = fn(rec)
+        assert out[pred.name]["prediction"] in (0.0, 1.0)
+
+    def test_load_from_disk(self, trained):
+        model, records, pred, path = trained
+        fn = load_score_function(path)
+        assert isinstance(fn, ScoreFunction)
+        out = fn(records[0])
+        assert set(out) == {pred.name}
+
+    def test_score_batch(self, trained):
+        model, records, pred, path = trained
+        fn = score_function_for(model)
+        outs = fn.score_batch(records[:5])
+        assert len(outs) == 5
+
+
+class TestOpParams:
+    def test_json_round_trip(self, tmp_path):
+        p = OpParams(stage_params={"LogisticRegression":
+                                   {"reg_param": 0.5}},
+                     model_location="/tmp/m", batch_size=10)
+        f = tmp_path / "params.json"
+        f.write_text(json.dumps(p.to_json()))
+        loaded = OpParams.load(str(f))
+        assert loaded.stage_params == p.stage_params
+        assert loaded.model_location == "/tmp/m"
+        assert loaded.batch_size == 10
+
+    def test_yaml_load(self, tmp_path):
+        f = tmp_path / "params.yaml"
+        f.write_text("modelLocation: /tmp/m2\nbatchSize: 7\n")
+        loaded = OpParams.load(str(f))
+        assert loaded.model_location == "/tmp/m2"
+        assert loaded.batch_size == 7
+
+
+class TestWorkflowRunner:
+    def test_train_run(self, tmp_path):
+        wf, records, pred = _make_workflow_and_records(seed=5)
+        runner = WorkflowRunner(workflow=wf)
+        loc = str(tmp_path / "model")
+        res = runner.run(RunType.TRAIN, OpParams(model_location=loc))
+        assert res.run_type == "train"
+        assert os.path.exists(os.path.join(loc, "op-model.json"))
+        assert os.path.exists(os.path.join(loc, "summary.txt"))
+        assert "Label" in res.summary
+
+    def test_stage_param_override(self):
+        wf, records, pred = _make_workflow_and_records(seed=6)
+        runner = WorkflowRunner(workflow=wf)
+        runner.run(RunType.TRAIN, OpParams(
+            stage_params={"LogisticRegression": {"reg_param": 0.3}}))
+        lr = [s for s in wf.stages()
+              if type(s).__name__ == "LogisticRegression"][0]
+        assert lr.reg_param == 0.3
+
+    def test_score_run(self, tmp_path, trained):
+        model, records, pred, path = trained
+        runner = WorkflowRunner(
+            score_reader=DataReaders.Simple.custom(records[:30]))
+        out_loc = str(tmp_path / "scores")
+        res = runner.run(RunType.SCORE, OpParams(
+            model_location=path, write_location=out_loc))
+        assert res.n_rows == 30
+        rows = json.loads(open(res.write_location).read())
+        assert len(rows) == 30 and "prediction" in rows[0][pred.name]
+
+    def test_evaluate_run(self, trained):
+        model, records, pred, path = trained
+        runner = WorkflowRunner(
+            score_reader=DataReaders.Simple.custom(records),
+            evaluator=BinaryClassificationEvaluator())
+        res = runner.run(RunType.EVALUATE, OpParams(model_location=path))
+        assert res.metrics["AuROC"] > 0.8
+
+    def test_streaming_score(self, trained):
+        model, records, pred, path = trained
+        runner = WorkflowRunner()
+        batches = [records[:10], records[10:25]]
+        outs = list(runner.streaming_score(
+            batches, OpParams(model_location=path)))
+        assert [len(b) for b in outs] == [10, 15]
+        assert "prediction" in outs[0][0][pred.name]
+
+    def test_metrics_written(self, tmp_path, trained):
+        model, records, pred, path = trained
+        mloc = str(tmp_path / "metrics")
+        runner = WorkflowRunner(
+            score_reader=DataReaders.Simple.custom(records[:10]))
+        runner.run(RunType.SCORE, OpParams(
+            model_location=path, metrics_location=mloc))
+        assert os.path.exists(os.path.join(mloc, "score_metrics.json"))
+
+    def test_unknown_run_type(self):
+        with pytest.raises(ValueError, match="Unknown run type"):
+            WorkflowRunner().run("bogus")

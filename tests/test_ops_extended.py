@@ -1,0 +1,340 @@
+"""Extended op library tests: maps, geo, date lists, bucketizers,
+indexing, derived transformers (reference OPMapVectorizerTest,
+GeolocationVectorizerTest, DateListVectorizerTest,
+NumericBucketizerTest, DecisionTreeNumericBucketizerTest,
+OpStringIndexerTest, PhoneNumberParserTest et al.)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+from transmogrifai_tpu.ops import (BinaryMapVectorizer,
+                                   DateListPivot, DateListVectorizer,
+                                   DecisionTreeNumericBucketizer,
+                                   DescalerTransformer,
+                                   DropIndicesByTransformer,
+                                   EmailToPickList, GeolocationVectorizer,
+                                   IndexToString, JaccardSimilarity,
+                                   LangDetector, MimeTypeDetector,
+                                   NGramSimilarity, NumericBucketizer,
+                                   PercentileCalibrator, PhoneNumberParser,
+                                   RealMapVectorizer, ScalerTransformer,
+                                   StringIndexer, TextLenTransformer,
+                                   TextListHashVectorizer,
+                                   TextMapPivotVectorizer,
+                                   ToOccurTransformer, transmogrify)
+from transmogrifai_tpu.testkit import StageSpecBase
+from transmogrifai_tpu.types import (Base64, Binary, BinaryMap, DateList,
+                                     Email, Geolocation, MultiPickList,
+                                     OPVector, Phone, PickList, Real,
+                                     RealMap, RealNN, Text, TextList,
+                                     TextMap)
+
+DAY = 86_400_000
+
+
+def _feat(name, ftype, response=False):
+    b = FeatureBuilder.of(name, ftype).extract(lambda r, n=name: r.get(n))
+    return b.as_response() if response else b.as_predictor()
+
+
+class TestRealMapVectorizer(StageSpecBase):
+    def build(self):
+        ds = Dataset({"m": FeatureColumn.from_values(RealMap, [
+            {"a": 1.0, "b": 2.0}, {"a": 3.0}, None, {"b": 5.0, "c": 0.5}])})
+        return RealMapVectorizer().set_input(_feat("m", RealMap)), ds
+
+    def test_per_key_columns(self):
+        stage, ds = self.build()
+        model = stage.fit(ds)
+        out = model.transform_columns([ds["m"]])
+        assert model.keys == [["a", "b", "c"]]
+        # a: mean(1,3)=2 imputed rows 2,3; groupings recorded per key
+        groups = {c.grouping for c in out.metadata.columns}
+        assert groups == {"a", "b", "c"}
+        a_col = out.data[:, 0]
+        np.testing.assert_allclose(a_col, [1.0, 3.0, 2.0, 2.0])
+
+
+class TestBinaryMapVectorizer(StageSpecBase):
+    def build(self):
+        ds = Dataset({"m": FeatureColumn.from_values(BinaryMap, [
+            {"x": True}, {"x": False, "y": True}, None])})
+        return BinaryMapVectorizer().set_input(_feat("m", BinaryMap)), ds
+
+
+class TestTextMapPivot(StageSpecBase):
+    def build(self):
+        ds = Dataset({"m": FeatureColumn.from_values(TextMap, [
+            {"k": "red"}, {"k": "blue"}, {"k": "red", "j": "x"}, None])})
+        return TextMapPivotVectorizer(top_k=3, min_support=1).set_input(
+            _feat("m", TextMap)), ds
+
+    def test_pivot_values(self):
+        stage, ds = self.build()
+        out = stage.fit(ds).transform_columns([ds["m"]])
+        cols = {c.column_name(out.metadata.name): i
+                for i, c in enumerate(out.metadata.columns)}
+        red = [i for n, i in cols.items() if "red" in n][0]
+        np.testing.assert_allclose(out.data[:, red], [1, 0, 1, 0])
+
+
+class TestGeolocationVectorizer(StageSpecBase):
+    def build(self):
+        ds = Dataset({"g": FeatureColumn.from_values(Geolocation, [
+            [37.77, -122.42, 1.0], None, [40.71, -74.0, 2.0]])})
+        return GeolocationVectorizer().set_input(
+            _feat("g", Geolocation)), ds
+
+    def test_midpoint_fill(self):
+        stage, ds = self.build()
+        out = stage.fit(ds).transform_columns([ds["g"]])
+        # row 1 filled with midpoint of the two cities; null flag set
+        assert 37.0 < out.data[1, 0] < 45.0  # great-circle midpoint arcs north
+        assert out.data[1, 3] == 1.0
+
+
+class TestDateListVectorizer:
+    def test_since_first(self):
+        f = _feat("d", DateList)
+        ref = 10 * DAY
+        ds = Dataset({"d": FeatureColumn.from_values(DateList, [
+            [2 * DAY, 5 * DAY], None])})
+        out = DateListVectorizer(
+            pivot=DateListPivot.SINCE_FIRST, reference_date_ms=ref
+        ).set_input(f).transform_columns([ds["d"]])
+        assert out.data[0, 0] == 8.0  # (10-2) days
+        assert out.data[1, 1] == 1.0  # null indicator
+
+    def test_mode_day(self):
+        f = _feat("d", DateList)
+        # 1970-01-01 was a Thursday; epoch day 0 and 7 are Thursdays
+        ds = Dataset({"d": FeatureColumn.from_values(DateList, [
+            [0, 7 * DAY, 1 * DAY]])})
+        out = DateListVectorizer(pivot=DateListPivot.MODE_DAY
+                                 ).set_input(f).transform_columns([ds["d"]])
+        labels = [c.indicator_value for c in out.metadata.columns]
+        assert out.data[0, labels.index("Thu")] == 1.0
+
+
+class TestNumericBucketizer(StageSpecBase):
+    def build(self):
+        ds = Dataset({"x": FeatureColumn.from_values(
+            Real, [1.0, 5.0, 9.0, None])})
+        return NumericBucketizer(split_points=[0.0, 3.0, 6.0, 10.0]
+                                 ).set_input(_feat("x", Real)), ds
+
+    def test_bucket_assignment(self):
+        stage, ds = self.build()
+        out = stage.transform_columns([ds["x"]])
+        np.testing.assert_allclose(out.data[:, :3], [
+            [1, 0, 0], [0, 1, 0], [0, 0, 1], [0, 0, 0]])
+        assert out.data[3, 3] == 1.0  # null tracked
+
+
+class TestDecisionTreeBucketizer:
+    def test_finds_signal_split(self):
+        rng = np.random.default_rng(0)
+        n = 300
+        x = rng.uniform(0, 10, n)
+        y = (x > 4.2).astype(float)
+        label = _feat("y", RealNN, response=True)
+        feat = _feat("x", Real)
+        ds = Dataset({"y": FeatureColumn(ftype=RealNN, data=y),
+                      "x": FeatureColumn(ftype=Real, data=x)})
+        model = DecisionTreeNumericBucketizer(max_depth=1).set_input(
+            label, feat).fit(ds)
+        assert model.should_split
+        inner = [s for s in model.split_points if np.isfinite(s)]
+        assert len(inner) >= 1 and abs(inner[0] - 4.2) < 0.5
+        out = model.transform_columns([ds["y"], ds["x"]])
+        assert out.data.shape[1] >= 2
+
+    def test_no_signal_no_split(self):
+        rng = np.random.default_rng(1)
+        n = 200
+        x = rng.uniform(0, 1, n)
+        y = (rng.uniform(size=n) > 0.5).astype(float)
+        label = _feat("y", RealNN, response=True)
+        feat = _feat("x", Real)
+        ds = Dataset({"y": FeatureColumn(ftype=RealNN, data=y),
+                      "x": FeatureColumn(ftype=Real, data=x)})
+        model = DecisionTreeNumericBucketizer(
+            max_depth=1, min_info_gain=0.05).set_input(label, feat).fit(ds)
+        assert not model.should_split
+
+
+class TestPercentileCalibrator(StageSpecBase):
+    def build(self):
+        vals = list(np.linspace(0, 100, 50))
+        ds = Dataset({"x": FeatureColumn.from_values(Real, vals)})
+        return PercentileCalibrator(buckets=10).set_input(
+            _feat("x", Real)), ds
+
+    def test_monotone_buckets(self):
+        stage, ds = self.build()
+        out = stage.fit(ds).transform_columns([ds["x"]])
+        assert out.data.min() == 0.0 and out.data.max() == 9.0
+        assert (np.diff(out.data) >= 0).all()
+
+
+class TestScalerDescaler:
+    def test_round_trip_linear(self):
+        x = _feat("x", Real)
+        scaler = ScalerTransformer(scaling_type="linear", slope=2.0,
+                                   intercept=3.0)
+        scaled = scaler.set_input(x).get_output()
+        descaled = DescalerTransformer().set_input(scaled, scaled)
+        ds = Dataset({"x": FeatureColumn.from_values(Real, [1.0, 4.0])})
+        s = scaler.transform_columns([ds["x"]])
+        np.testing.assert_allclose(s.data, [5.0, 11.0])
+        d = descaled.transform_columns([s, s])
+        np.testing.assert_allclose(d.data, [1.0, 4.0])
+
+    def test_log_scaling(self):
+        x = _feat("x", Real)
+        scaler = ScalerTransformer(scaling_type="logarithmic")
+        ds = Dataset({"x": FeatureColumn.from_values(Real, [np.e, 1.0])})
+        out = scaler.set_input(x).transform_columns([ds["x"]])
+        np.testing.assert_allclose(out.data, [1.0, 0.0], atol=1e-12)
+
+
+class TestStringIndexer(StageSpecBase):
+    def build(self):
+        ds = Dataset({"t": FeatureColumn.from_values(
+            Text, ["b", "a", "b", "c", "b", "a", None])})
+        return StringIndexer().set_input(_feat("t", Text)), ds
+
+    def test_frequency_order_and_unseen(self):
+        stage, ds = self.build()
+        model = stage.fit(ds)
+        assert model.labels == ["b", "a", "c"]
+        out = model.transform_columns([ds["t"]])
+        # None is unseen -> index len(labels)
+        assert out.data[-1] == 3.0
+        assert out.data[0] == 0.0 and out.data[1] == 1.0
+
+    def test_index_to_string_round_trip(self):
+        stage, ds = self.build()
+        model = stage.fit(ds)
+        idx_f = _feat("i", RealNN)
+        back = IndexToString(labels=model.labels).set_input(idx_f)
+        idx_col = model.transform_columns([ds["t"]])
+        out = back.transform_columns([idx_col])
+        assert list(out.data[:3]) == ["b", "a", "b"]
+        assert out.data[-1] == "UnseenLabel"
+
+
+class TestDerivedTransformers:
+    def test_phone_parser(self):
+        f = _feat("p", Phone)
+        ds = Dataset({"p": FeatureColumn.from_values(Phone, [
+            "415-555-1234", "12", None])})
+        out = PhoneNumberParser().set_input(f).transform_columns([ds["p"]])
+        assert out.data[0] == 1.0 and out.data[1] == 0.0
+        assert np.isnan(out.data[2])
+
+    def test_email_domain(self):
+        f = _feat("e", Email)
+        ds = Dataset({"e": FeatureColumn.from_values(Email, [
+            "a@x.com", "bad", None])})
+        out = EmailToPickList().set_input(f).transform_columns([ds["e"]])
+        assert out.data[0] == "x.com" and out.data[1] is None
+
+    def test_mime_detector(self):
+        import base64
+        f = _feat("b", Base64)
+        pdf = base64.b64encode(b"%PDF-1.4 xyz").decode()
+        png = base64.b64encode(b"\x89PNG\r\n").decode()
+        txt = base64.b64encode(b"hello world").decode()
+        ds = Dataset({"b": FeatureColumn.from_values(
+            Base64, [pdf, png, txt])})
+        out = MimeTypeDetector().set_input(f).transform_columns([ds["b"]])
+        assert list(out.data) == ["application/pdf", "image/png",
+                                  "text/plain"]
+
+    def test_lang_detector(self):
+        f = _feat("t", Text)
+        ds = Dataset({"t": FeatureColumn.from_values(Text, [
+            "the cat is in the house and it is warm",
+            "el gato es un animal que vive en la casa",
+            "le chat est dans la maison pour la nuit"])})
+        out = LangDetector().set_input(f).transform_columns([ds["t"]])
+        assert list(out.data) == ["en", "es", "fr"]
+
+    def test_text_len(self):
+        f = _feat("t", Text)
+        ds = Dataset({"t": FeatureColumn.from_values(Text, ["abc", None])})
+        out = TextLenTransformer().set_input(f).transform_columns([ds["t"]])
+        np.testing.assert_allclose(out.data, [3, 0])
+
+    def test_ngram_similarity(self):
+        a, b = _feat("a", Text), _feat("b", Text)
+        ds = Dataset({"a": FeatureColumn.from_values(
+            Text, ["hello world", "abc"]),
+            "b": FeatureColumn.from_values(Text, ["hello world", "xyz"])})
+        out = NGramSimilarity().set_input(a, b).transform_columns(
+            [ds["a"], ds["b"]])
+        assert out.data[0] == 1.0 and out.data[1] == 0.0
+
+    def test_jaccard(self):
+        a, b = _feat("a", MultiPickList), _feat("b", MultiPickList)
+        ds = Dataset({
+            "a": FeatureColumn.from_values(MultiPickList,
+                                           [{"x", "y"}, set()]),
+            "b": FeatureColumn.from_values(MultiPickList,
+                                           [{"y", "z"}, set()])})
+        out = JaccardSimilarity().set_input(a, b).transform_columns(
+            [ds["a"], ds["b"]])
+        assert out.data[0] == pytest.approx(1 / 3)
+        assert out.data[1] == 1.0  # both empty -> 1.0
+
+    def test_to_occur(self):
+        f = _feat("t", Text)
+        ds = Dataset({"t": FeatureColumn.from_values(Text, ["x", None])})
+        out = ToOccurTransformer().set_input(f).transform_columns([ds["t"]])
+        np.testing.assert_allclose(out.data, [1.0, 0.0])
+
+    def test_drop_indices_by(self):
+        from transmogrifai_tpu.utils.vector_meta import (VectorColumnMetadata,
+                                                         VectorMetadata)
+        f = _feat("v", OPVector)
+        meta = VectorMetadata(name="v", columns=(
+            VectorColumnMetadata("a", "Real"),
+            VectorColumnMetadata("b", "Real",
+                                 indicator_value="NullIndicatorValue")))
+        col = FeatureColumn.vector(np.asarray([[1.0, 2.0]]), meta)
+        out = DropIndicesByTransformer(
+            match_fn=lambda c: c.is_null_indicator
+        ).set_input(f).transform_columns([col])
+        assert out.data.shape == (1, 1) and out.data[0, 0] == 1.0
+
+
+class TestTransmogrifyDispatch:
+    def test_mixed_types_including_maps(self):
+        feats = [_feat("r", Real), _feat("m", RealMap),
+                 _feat("tm", TextMap), _feat("g", Geolocation),
+                 _feat("tl", TextList)]
+        vec = transmogrify(feats)
+        from transmogrifai_tpu.workflow import Workflow
+        ds = Dataset({
+            "r": FeatureColumn.from_values(Real, [1.0, 2.0]),
+            "m": FeatureColumn.from_values(RealMap,
+                                           [{"k": 1.0}, {"k": 2.0}]),
+            "tm": FeatureColumn.from_values(TextMap,
+                                            [{"c": "x"}, {"c": "y"}]),
+            "g": FeatureColumn.from_values(
+                Geolocation, [[1.0, 2.0, 0.0], [3.0, 4.0, 0.0]]),
+            "tl": FeatureColumn.from_values(TextList,
+                                            [["a", "b"], ["c"]])})
+        # run the full DAG: fit all vectorizer estimators then transform
+        from transmogrifai_tpu.features.feature import topo_layers
+        from transmogrifai_tpu.workflow.workflow import \
+            _fit_and_transform_layers
+        out_ds, _ = _fit_and_transform_layers(topo_layers([vec]), ds,
+                                              fit=True)
+        out = out_ds[vec.name]
+        assert out.data.shape[0] == 2
+        assert out.metadata.size == out.data.shape[1]
+        parents = {c.parent_feature_name for c in out.metadata.columns}
+        assert parents == {"r", "m", "tm", "g", "tl"}

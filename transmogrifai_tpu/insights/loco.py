@@ -71,10 +71,12 @@ class RecordInsightsLOCO(UnaryTransformer):
         base = self._score(X, base_cls)
         groups = self._groups(meta, d)
         diffs = np.zeros((n, len(groups)))
+        Xz = X.copy()  # one buffer; zero + restore each group's slice
         for g, (name, idxs) in enumerate(groups):
-            Xz = X.copy()
+            saved = Xz[:, idxs].copy()
             Xz[:, idxs] = 0.0
             diffs[:, g] = base - self._score(Xz, base_cls)
+            Xz[:, idxs] = saved
         k = min(self.top_k, len(groups))
         # top-K by |diff| per row
         order = np.argsort(-np.abs(diffs), axis=1)[:, :k]

@@ -203,7 +203,6 @@ def extract_model_insights(wf_model) -> ModelInsights:
                 d.variance = cs.variance
                 d.corr_label = cs.corr_label
                 d.cramers_v = cs.cramers_v
-            by_parent[col.parent_feature_name] = fi
             fi.derived.append(d)
     # columns the checker dropped never reach the model matrix — record them
     for cs in checker_cols:

@@ -1,0 +1,5 @@
+"""Engine-free local scoring (SURVEY §2.13; local/src/main/scala/com/
+salesforce/op/local/OpWorkflowModelLocal.scala:52)."""
+from .scoring import ScoreFunction, load_score_function, score_function_for
+
+__all__ = ["ScoreFunction", "load_score_function", "score_function_for"]

@@ -1,0 +1,94 @@
+"""Local (engine-free) scoring: model -> plain ``dict -> dict`` function.
+
+TPU-native port of the reference local module
+(local/src/main/scala/com/salesforce/op/local/
+{OpWorkflowModelLocal.scala:52,88-120, OpWorkflowRunnerLocal.scala:41}):
+a saved workflow model becomes a pure-Python scoring closure that folds
+one record's values through every stage's row-level ``transform_value``
+path in DAG order — no Spark/MLeap (reference) and no batch engine
+here; models already predict from plain arrays so nothing needs
+conversion.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..features.feature import Feature, topo_layers
+from ..features.generator import FeatureGeneratorStage
+from ..types import FeatureType, Prediction
+
+__all__ = ["ScoreFunction", "load_score_function", "score_function_for"]
+
+
+def _unbox(value: Any) -> Any:
+    if isinstance(value, Prediction):
+        return dict(value.value)
+    if isinstance(value, FeatureType):
+        v = value.value
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, (set, frozenset)):
+            return sorted(v)
+        return v
+    return value
+
+
+class ScoreFunction:
+    """``fn(record: dict) -> dict`` over the fitted DAG
+    (reference model.scoreFunction, OpWorkflowModelLocal.scala:88)."""
+
+    def __init__(self, model, result_features: Optional[Sequence[Feature]]
+                 = None):
+        self.model = model
+        self.result_features = list(result_features
+                                    or model.result_features)
+        self.raw_features = model.raw_features()
+        self._plan = [s for layer in topo_layers(self.result_features)
+                      for s in layer
+                      if not isinstance(s, FeatureGeneratorStage)]
+
+    def __call__(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        values: Dict[str, FeatureType] = {}
+        for f in self.raw_features:
+            gen = f.origin_stage
+            if isinstance(gen, FeatureGeneratorStage):
+                try:
+                    raw = gen.extract_fn(record)
+                except Exception:
+                    raw = None
+            else:
+                raw = record.get(f.name)
+            if raw is None and f.is_response:
+                # label-free scoring: prediction stages ignore the label
+                # value, so any placeholder works (NaN for non-nullables)
+                try:
+                    values[f.name] = f.ftype.from_any(None)
+                except Exception:
+                    values[f.name] = f.ftype(0.0)  # ignored by predictors
+                continue
+            values[f.name] = raw if isinstance(raw, FeatureType) \
+                else f.ftype.from_any(raw)
+        for stage in self._plan:
+            ins = [values[f.name] for f in stage.input_features]
+            out = stage.get_output()
+            values[out.name] = stage.transform_value(*ins)
+        return {f.name: _unbox(values[f.name])
+                for f in self.result_features}
+
+    def score_batch(self, records: Sequence[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+        return [self(r) for r in records]
+
+
+def score_function_for(model) -> ScoreFunction:
+    """Build a local scoring closure from an in-memory fitted model."""
+    return ScoreFunction(model)
+
+
+def load_score_function(path: str) -> ScoreFunction:
+    """Load a saved model directory into a scoring closure
+    (reference OpWorkflowRunnerLocal:41)."""
+    from ..workflow.persistence import load_model
+    return ScoreFunction(load_model(path))

@@ -1,16 +1,34 @@
 """Automated feature engineering: vectorizers + Transmogrifier (SURVEY §2.5;
 core/.../stages/impl/feature/)."""
+from .bucketizers import (DecisionTreeNumericBucketizer,
+                          DecisionTreeNumericBucketizerModel,
+                          DescalerTransformer, NumericBucketizer,
+                          PercentileCalibrator, PercentileCalibratorModel,
+                          ScalerTransformer, ScalingType)
 from .categorical import (MultiPickListVectorizer, MultiPickListVectorizerModel,
                           OneHotVectorizer, OneHotVectorizerModel)
 from .combiner import VectorsCombiner
-from .date import DateToUnitCircleVectorizer
+from .date import (DateListPivot, DateListVectorizer,
+                   DateToUnitCircleVectorizer)
+from .derived import (DropIndicesByTransformer, EmailToPickList,
+                      JaccardSimilarity, LangDetector, MimeTypeDetector,
+                      NGramSimilarity, PhoneNumberParser, TextLenTransformer,
+                      ToOccurTransformer, UrlToPickList)
 from .dsl import (AliasTransformer, FillMissingWithMean,
                   NumericBinaryTransformer, NumericScalarTransformer,
                   StandardScaler)
+from .geo import GeolocationVectorizer, GeolocationVectorizerModel
+from .index import (IndexToString, PredictionDeIndexer, StringIndexer,
+                    StringIndexerModel)
+from .maps import (BinaryMapVectorizer, GeolocationMapVectorizer,
+                   GeolocationMapVectorizerModel, MultiPickListMapVectorizer,
+                   RealMapVectorizer, RealMapVectorizerModel,
+                   TextMapPivotVectorizer, TextMapPivotVectorizerModel)
 from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
                       RealVectorizerModel)
 from .text import (SmartTextVectorizer, SmartTextVectorizerModel,
-                   TextHashVectorizer, TextTokenizer, tokenize)
+                   TextHashVectorizer, TextListHashVectorizer, TextTokenizer,
+                   tokenize)
 from .transmogrify import TransmogrifierDefaults, transmogrify
 
 __all__ = [
@@ -19,9 +37,25 @@ __all__ = [
     "OneHotVectorizer", "OneHotVectorizerModel",
     "MultiPickListVectorizer", "MultiPickListVectorizerModel",
     "SmartTextVectorizer", "SmartTextVectorizerModel", "TextHashVectorizer",
-    "TextTokenizer", "tokenize",
-    "DateToUnitCircleVectorizer", "VectorsCombiner",
+    "TextListHashVectorizer", "TextTokenizer", "tokenize",
+    "DateToUnitCircleVectorizer", "DateListVectorizer", "DateListPivot",
+    "VectorsCombiner",
     "TransmogrifierDefaults", "transmogrify",
     "AliasTransformer", "FillMissingWithMean", "NumericBinaryTransformer",
     "NumericScalarTransformer", "StandardScaler",
+    "RealMapVectorizer", "RealMapVectorizerModel", "BinaryMapVectorizer",
+    "TextMapPivotVectorizer", "TextMapPivotVectorizerModel",
+    "MultiPickListMapVectorizer", "GeolocationMapVectorizer",
+    "GeolocationMapVectorizerModel",
+    "GeolocationVectorizer", "GeolocationVectorizerModel",
+    "NumericBucketizer", "DecisionTreeNumericBucketizer",
+    "DecisionTreeNumericBucketizerModel", "PercentileCalibrator",
+    "PercentileCalibratorModel", "ScalerTransformer", "DescalerTransformer",
+    "ScalingType",
+    "StringIndexer", "StringIndexerModel", "IndexToString",
+    "PredictionDeIndexer",
+    "PhoneNumberParser", "EmailToPickList", "UrlToPickList",
+    "MimeTypeDetector", "LangDetector", "TextLenTransformer",
+    "NGramSimilarity", "JaccardSimilarity", "ToOccurTransformer",
+    "DropIndicesByTransformer",
 ]

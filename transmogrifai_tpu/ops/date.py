@@ -18,7 +18,8 @@ from ..stages.base import SequenceTransformer
 from ..types import Date, OPVector
 from .vector_utils import VectorColumnMetadata, vector_output
 
-__all__ = ["DateToUnitCircleVectorizer", "TIME_PERIODS"]
+__all__ = ["DateToUnitCircleVectorizer", "DateListVectorizer",
+           "TIME_PERIODS", "DateListPivot"]
 
 _MS_PER_HOUR = 3600 * 1000
 _MS_PER_DAY = 24 * _MS_PER_HOUR
@@ -55,6 +56,119 @@ def _day_of_month_phase(ms: np.ndarray) -> np.ndarray:
 def _month_phase(ms: np.ndarray) -> np.ndarray:
     m, _ = _civil_from_ms(ms)
     return (m - 1) / 12.0
+
+
+class DateListPivot:
+    """(reference DateListPivot enum in DateListVectorizer.scala)"""
+    SINCE_FIRST = "SinceFirst"
+    SINCE_LAST = "SinceLast"
+    MODE_DAY = "ModeDay"
+    MODE_MONTH = "ModeMonth"
+    MODE_HOUR = "ModeHour"
+    ALL = (SINCE_FIRST, SINCE_LAST, MODE_DAY, MODE_MONTH, MODE_HOUR)
+
+
+_DAYS = ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+_MONTHS = ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug", "Sep",
+           "Oct", "Nov", "Dec"]
+
+
+class DateListVectorizer(SequenceTransformer):
+    """DateList -> pivoted columns (reference DateListVectorizer.scala):
+    SinceFirst/SinceLast = days between the earliest/latest date and
+    ``reference_date_ms``; ModeDay/ModeMonth/ModeHour = one-hot of the
+    most frequent day-of-week / month / hour across the list."""
+
+    from ..types import DateList as _DateList
+    input_types = (_DateList,)
+    output_type = OPVector
+
+    def __init__(self, pivot: str = DateListPivot.SINCE_FIRST,
+                 reference_date_ms: int = 1_500_000_000_000,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="dateListPivot", uid=uid)
+        if pivot not in DateListPivot.ALL:
+            raise ValueError(f"Unknown pivot {pivot!r}")
+        self.pivot = pivot
+        self.reference_date_ms = reference_date_ms
+        self.track_nulls = track_nulls
+
+    def _one_hot(self, picks, n_levels, labels, f):
+        n = len(picks)
+        block = np.zeros((n, n_levels))
+        isnull = np.zeros(n)
+        for i, p in enumerate(picks):
+            if p is None:
+                isnull[i] = 1.0
+            else:
+                block[i, p] = 1.0
+        blocks = [block]
+        metas = [VectorColumnMetadata(
+            parent_feature_name=f.name,
+            parent_feature_type=f.ftype.__name__, grouping=f.name,
+            indicator_value=lab) for lab in labels]
+        if self.track_nulls:
+            blocks.append(isnull)
+            from .vector_utils import NULL_INDICATOR
+            metas.append(VectorColumnMetadata(
+                parent_feature_name=f.name,
+                parent_feature_type=f.ftype.__name__, grouping=f.name,
+                indicator_value=NULL_INDICATOR))
+        return blocks, metas
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        blocks, metas = [], []
+        for f, col in zip(self.input_features, cols):
+            lists = [sorted(v) if v else None for v in col.data]
+            if self.pivot in (DateListPivot.SINCE_FIRST,
+                              DateListPivot.SINCE_LAST):
+                pick = 0 if self.pivot == DateListPivot.SINCE_FIRST else -1
+                days = np.zeros(len(lists))
+                isnull = np.zeros(len(lists))
+                for i, v in enumerate(lists):
+                    if v is None:
+                        isnull[i] = 1.0
+                    else:
+                        days[i] = (self.reference_date_ms - v[pick]) \
+                            / _MS_PER_DAY
+                blocks.append(days)
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__,
+                    descriptor_value=self.pivot))
+                if self.track_nulls:
+                    from .vector_utils import NULL_INDICATOR
+                    blocks.append(isnull)
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        indicator_value=NULL_INDICATOR))
+                continue
+            picks = []
+            for v in lists:
+                if v is None:
+                    picks.append(None)
+                    continue
+                ms = np.asarray(v, dtype=np.int64)
+                if self.pivot == DateListPivot.MODE_DAY:
+                    units = ((ms // _MS_PER_DAY) + 3) % 7
+                elif self.pivot == DateListPivot.MODE_MONTH:
+                    units, _ = _civil_from_ms(ms)
+                    units = units - 1
+                else:  # MODE_HOUR
+                    units = (ms % _MS_PER_DAY) // _MS_PER_HOUR
+                vals, counts = np.unique(units, return_counts=True)
+                picks.append(int(vals[np.argmax(counts)]))
+            if self.pivot == DateListPivot.MODE_DAY:
+                b, m = self._one_hot(picks, 7, _DAYS, f)
+            elif self.pivot == DateListPivot.MODE_MONTH:
+                b, m = self._one_hot(picks, 12, _MONTHS, f)
+            else:
+                b, m = self._one_hot(picks, 24,
+                                     [f"{h:02d}h" for h in range(24)], f)
+            blocks.extend(b)
+            metas.extend(m)
+        return vector_output(self.get_output().name, blocks, metas)
 
 
 class DateToUnitCircleVectorizer(SequenceTransformer):

@@ -1,0 +1,79 @@
+"""Geolocation vectorizer: (lat, lon, accuracy) triples -> OPVector.
+
+TPU-native port of the reference GeolocationVectorizer
+(core/src/main/scala/com/salesforce/op/stages/impl/feature/
+GeolocationVectorizer.scala): missing locations fill with the training
+data's geographic midpoint (unit-vector average, the reference's Lucene
+spatial3d computation — features/aggregators.py here), plus optional
+null tracking.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..features.columns import FeatureColumn
+from ..stages.base import SequenceEstimator, SequenceModel
+from ..types import Geolocation, OPVector
+from .vector_utils import NULL_INDICATOR, VectorColumnMetadata, vector_output
+
+__all__ = ["GeolocationVectorizer", "GeolocationVectorizerModel"]
+
+
+class GeolocationVectorizerModel(SequenceModel):
+    input_types = (Geolocation,)
+    output_type = OPVector
+
+    def __init__(self, fill_values: List[List[float]],
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeo", uid=uid)
+        self.fill_values = [[float(x) for x in f] for f in fill_values]
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        blocks, metas = [], []
+        for f, col, fill in zip(self.input_features, cols,
+                                self.fill_values):
+            n = col.n_rows
+            block = np.tile(np.asarray(fill), (n, 1))
+            isnull = np.ones(n)
+            for i, v in enumerate(col.data):
+                if v is not None and len(v):
+                    block[i, :] = [v[0], v[1], v[2] if len(v) > 2 else 0.0]
+                    isnull[i] = 0.0
+            blocks.append(block)
+            for p in ("lat", "lon", "acc"):
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__,
+                    descriptor_value=p))
+            if self.track_nulls:
+                blocks.append(isnull)
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__,
+                    indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class GeolocationVectorizer(SequenceEstimator):
+    """(reference GeolocationVectorizer.scala)"""
+
+    input_types = (Geolocation,)
+    output_type = OPVector
+
+    def __init__(self, track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeo", uid=uid)
+        self.track_nulls = track_nulls
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> GeolocationVectorizerModel:
+        from ..features.aggregators import GeolocationMidpoint
+        fills = []
+        for col in cols:
+            pts = [v for v in col.data if v is not None and len(v)]
+            mid = GeolocationMidpoint().reduce(pts) if pts else None
+            fills.append([float(x) for x in (mid or [0.0, 0.0, 0.0])])
+        return GeolocationVectorizerModel(fill_values=fills,
+                                          track_nulls=self.track_nulls)

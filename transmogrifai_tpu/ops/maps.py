@@ -1,0 +1,359 @@
+"""Map vectorizers: typed ``str -> value`` maps -> OPVector.
+
+TPU-native ports of the reference map vectorizer family
+(core/src/main/scala/com/salesforce/op/stages/impl/feature/
+{OPMapVectorizer.scala, TextMapPivotVectorizer.scala,
+MultiPickListMapVectorizer.scala, GeolocationMapVectorizer.scala,
+SmartTextMapVectorizer.scala}): fit learns the key universe per input
+map feature (the reference's ``allowedKeys``/whitelist pass), then each
+(feature, key) pair becomes a fixed slot of the output vector with the
+same impute/track-null semantics as the scalar vectorizers, and
+``grouping`` metadata set to the key so SanityChecker prunes per-key
+groups.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..features.columns import FeatureColumn
+from ..stages.base import SequenceEstimator, SequenceModel
+from ..types import (BinaryMap, GeolocationMap, MultiPickListMap,
+                     NumericMap, OPMap, OPVector, TextMap)
+from .vector_utils import (NULL_INDICATOR, OTHER_INDICATOR,
+                           VectorColumnMetadata, vector_output)
+
+__all__ = ["RealMapVectorizer", "RealMapVectorizerModel",
+           "BinaryMapVectorizer", "TextMapPivotVectorizer",
+           "TextMapPivotVectorizerModel", "MultiPickListMapVectorizer",
+           "GeolocationMapVectorizer", "GeolocationMapVectorizerModel"]
+
+
+def _sorted_keys(cols: List[FeatureColumn],
+                 allow_keys: Optional[Sequence[str]] = None
+                 ) -> List[List[str]]:
+    out = []
+    for col in cols:
+        keys = set()
+        for m in col.data:
+            if m:
+                keys.update(m.keys())
+        if allow_keys is not None:
+            keys &= set(allow_keys)
+        out.append(sorted(keys))
+    return out
+
+
+class RealMapVectorizerModel(SequenceModel):
+    input_types = (OPMap,)  # NumericMap | IntegralMap | DateMap
+    output_type = OPVector
+
+    def __init__(self, keys: List[List[str]],
+                 fill_values: List[List[float]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecRealMap", uid=uid)
+        self.keys = [list(k) for k in keys]
+        self.fill_values = [[float(v) for v in f] for f in fill_values]
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        blocks, metas = [], []
+        for f, col, keys, fills in zip(self.input_features, cols,
+                                       self.keys, self.fill_values):
+            n = col.n_rows
+            for k, fill in zip(keys, fills):
+                vals = np.full(n, np.nan)
+                for i, m in enumerate(col.data):
+                    if m and k in m and m[k] is not None:
+                        vals[i] = float(m[k])
+                isnan = np.isnan(vals)
+                blocks.append(np.where(isnan, fill, vals))
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__, grouping=k))
+                if self.track_nulls:
+                    blocks.append(isnan.astype(np.float64))
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__, grouping=k,
+                        indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class RealMapVectorizer(SequenceEstimator):
+    """Numeric maps -> per-key columns, mean-imputed
+    (reference OPMapVectorizer.scala RealMapVectorizer)."""
+
+    input_types = (OPMap,)  # NumericMap | IntegralMap | DateMap
+    output_type = OPVector
+
+    def __init__(self, fill_with_mean: bool = True, fill_value: float = 0.0,
+                 track_nulls: bool = True,
+                 allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecRealMap", uid=uid)
+        self.fill_with_mean = fill_with_mean
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> RealMapVectorizerModel:
+        keys = _sorted_keys(cols, self.allow_keys)
+        fills = []
+        for col, ks in zip(cols, keys):
+            per_key = []
+            for k in ks:
+                vals = [float(m[k]) for m in col.data
+                        if m and k in m and m[k] is not None]
+                if self.fill_with_mean and vals:
+                    per_key.append(float(np.mean(vals)))
+                else:
+                    per_key.append(float(self.fill_value))
+            fills.append(per_key)
+        return RealMapVectorizerModel(keys=keys, fill_values=fills,
+                                      track_nulls=self.track_nulls)
+
+
+class BinaryMapVectorizer(RealMapVectorizer):
+    """Boolean maps -> per-key 0/1 columns, false-filled
+    (reference BinaryMapVectorizer in OPMapVectorizer.scala)."""
+
+    input_types = (BinaryMap,)
+
+    def __init__(self, track_nulls: bool = True,
+                 allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(fill_with_mean=False, fill_value=0.0,
+                         track_nulls=track_nulls, allow_keys=allow_keys,
+                         uid=uid)
+        self.operation_name = "vecBinaryMap"
+
+
+class TextMapPivotVectorizerModel(SequenceModel):
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, keys: List[List[str]],
+                 categories: List[Dict[str, List[str]]],
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="pivotTextMap", uid=uid)
+        self.keys = [list(k) for k in keys]
+        self.categories = [dict(c) for c in categories]
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        blocks, metas = [], []
+        for f, col, keys, cats in zip(self.input_features, cols,
+                                      self.keys, self.categories):
+            n = col.n_rows
+            for k in keys:
+                levels = cats.get(k, [])
+                width = len(levels) + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, width))
+                index = {c: i for i, c in enumerate(levels)}
+                for i, m in enumerate(col.data):
+                    v = m.get(k) if m else None
+                    if v is None:
+                        if self.track_nulls:
+                            block[i, len(levels) + 1] = 1.0
+                    else:
+                        j = index.get(str(v))
+                        block[i, j if j is not None else len(levels)] = 1.0
+                blocks.append(block)
+                group = f"{f.name}_{k}"
+                for c in levels:
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, indicator_value=c))
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__,
+                    grouping=k, indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class TextMapPivotVectorizer(SequenceEstimator):
+    """Text maps -> per-key top-K one-hot pivot
+    (reference TextMapPivotVectorizer.scala)."""
+
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True,
+                 allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="pivotTextMap", uid=uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> TextMapPivotVectorizerModel:
+        from .categorical import _top_categories
+        keys = _sorted_keys(cols, self.allow_keys)
+        categories = []
+        for col, ks in zip(cols, keys):
+            per_key: Dict[str, List[str]] = {}
+            for k in ks:
+                counts: Dict[str, int] = {}
+                for m in col.data:
+                    v = m.get(k) if m else None
+                    if v is not None:
+                        counts[str(v)] = counts.get(str(v), 0) + 1
+                per_key[k] = _top_categories(counts, self.top_k,
+                                             self.min_support)
+            categories.append(per_key)
+        return TextMapPivotVectorizerModel(
+            keys=keys, categories=categories, track_nulls=self.track_nulls)
+
+
+class MultiPickListMapVectorizer(TextMapPivotVectorizer):
+    """Set-valued maps -> per-key multi-hot pivot
+    (reference MultiPickListMapVectorizer.scala)."""
+
+    input_types = (MultiPickListMap,)
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> TextMapPivotVectorizerModel:
+        from .categorical import _top_categories
+        keys = _sorted_keys(cols, self.allow_keys)
+        categories = []
+        for col, ks in zip(cols, keys):
+            per_key: Dict[str, List[str]] = {}
+            for k in ks:
+                counts: Dict[str, int] = {}
+                for m in col.data:
+                    vals = m.get(k) if m else None
+                    if vals:
+                        for v in vals:
+                            counts[str(v)] = counts.get(str(v), 0) + 1
+                per_key[k] = _top_categories(counts, self.top_k,
+                                             self.min_support)
+            categories.append(per_key)
+        model = _MultiPickListMapModel(
+            keys=keys, categories=categories, track_nulls=self.track_nulls)
+        return model
+
+
+class _MultiPickListMapModel(TextMapPivotVectorizerModel):
+    input_types = (MultiPickListMap,)
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        blocks, metas = [], []
+        for f, col, keys, cats in zip(self.input_features, cols,
+                                      self.keys, self.categories):
+            n = col.n_rows
+            for k in keys:
+                levels = cats.get(k, [])
+                width = len(levels) + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, width))
+                index = {c: i for i, c in enumerate(levels)}
+                for i, m in enumerate(col.data):
+                    vals = m.get(k) if m else None
+                    if not vals:
+                        if self.track_nulls:
+                            block[i, len(levels) + 1] = 1.0
+                        continue
+                    for v in vals:
+                        j = index.get(str(v))
+                        block[i, j if j is not None else len(levels)] = 1.0
+                blocks.append(block)
+                for c in levels:
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, indicator_value=c))
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__,
+                    grouping=k, indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class GeolocationMapVectorizerModel(SequenceModel):
+    input_types = (GeolocationMap,)
+    output_type = OPVector
+
+    def __init__(self, keys: List[List[str]],
+                 fill_values: List[Dict[str, List[float]]],
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeoMap", uid=uid)
+        self.keys = [list(k) for k in keys]
+        self.fill_values = [dict(f) for f in fill_values]
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        parts = ["lat", "lon", "acc"]
+        blocks, metas = [], []
+        for f, col, keys, fills in zip(self.input_features, cols,
+                                       self.keys, self.fill_values):
+            n = col.n_rows
+            for k in keys:
+                fill = fills.get(k, [0.0, 0.0, 0.0])
+                block = np.tile(np.asarray(fill), (n, 1))
+                isnull = np.ones(n)
+                for i, m in enumerate(col.data):
+                    v = m.get(k) if m else None
+                    if v:
+                        block[i, :] = [v[0], v[1],
+                                       v[2] if len(v) > 2 else 0.0]
+                        isnull[i] = 0.0
+                blocks.append(block)
+                for p in parts:
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, descriptor_value=p))
+                if self.track_nulls:
+                    blocks.append(isnull)
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class GeolocationMapVectorizer(SequenceEstimator):
+    """Geolocation maps -> per-key (lat, lon, acc), midpoint-imputed
+    (reference GeolocationMapVectorizer.scala)."""
+
+    input_types = (GeolocationMap,)
+    output_type = OPVector
+
+    def __init__(self, track_nulls: bool = True,
+                 allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="vecGeoMap", uid=uid)
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> GeolocationMapVectorizerModel:
+        from ..features.aggregators import GeolocationMidpoint
+        keys = _sorted_keys(cols, self.allow_keys)
+        fills = []
+        for col, ks in zip(cols, keys):
+            per_key: Dict[str, List[float]] = {}
+            for k in ks:
+                pts = [m[k] for m in col.data
+                       if m and k in m and m[k] is not None and len(m[k])]
+                mid = GeolocationMidpoint().reduce(pts) if pts else None
+                per_key[k] = [float(x) for x in (mid or [0.0, 0.0, 0.0])]
+            fills.append(per_key)
+        return GeolocationMapVectorizerModel(
+            keys=keys, fill_values=fills, track_nulls=self.track_nulls)

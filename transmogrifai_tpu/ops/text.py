@@ -166,6 +166,43 @@ class SmartTextVectorizer(SequenceEstimator):
                                         track_nulls=self.track_nulls)
 
 
+class TextListHashVectorizer(SequenceModel):
+    """Hashing-trick vectorizer over pre-tokenized text lists
+    (reference OPCollectionHashingVectorizer.scala list path)."""
+
+    from ..types import TextList as _TextList
+    input_types = (_TextList,)
+    output_type = OPVector
+
+    def __init__(self, num_hashes: int = 512, binary_freq: bool = False,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="hashTextList", uid=uid)
+        self.num_hashes = num_hashes
+        self.binary_freq = binary_freq
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        blocks, metas = [], []
+        for f, col in zip(self.input_features, cols):
+            n = col.n_rows
+            width = self.num_hashes + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width), dtype=np.float64)
+            for i, toks in enumerate(col.data):
+                if not toks:
+                    if self.track_nulls:
+                        block[i, self.num_hashes] = 1.0
+                    continue
+                for t in toks:
+                    j = stable_hash(str(t), self.num_hashes)
+                    if self.binary_freq:
+                        block[i, j] = 1.0
+                    else:
+                        block[i, j] += 1.0
+            blocks.append(block)
+            metas.extend(_hash_metas(f, self.num_hashes, self.track_nulls))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
 class TextHashVectorizer(SequenceModel):
     """Pure hashing-trick vectorizer (reference
     OPCollectionHashingVectorizer.scala); stateless."""

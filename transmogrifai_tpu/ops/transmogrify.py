@@ -13,13 +13,19 @@ from dataclasses import dataclass
 from typing import Dict, List, Sequence, Type
 
 from ..features.feature import Feature
-from ..types import (Binary, Date, DateTime, FeatureType, Integral,
-                     MultiPickList, OPSet, OPVector, Real, Text)
+from ..types import (Binary, BinaryMap, Date, DateList, DateTime,
+                     FeatureType, Geolocation, GeolocationMap, Integral,
+                     MultiPickList, MultiPickListMap, OPMap, OPSet,
+                     OPVector, Real, Text, TextList, TextMap)
 from .categorical import MultiPickListVectorizer, OneHotVectorizer
 from .combiner import VectorsCombiner
-from .date import DateToUnitCircleVectorizer
+from .date import DateListVectorizer, DateToUnitCircleVectorizer
+from .geo import GeolocationVectorizer
+from .maps import (BinaryMapVectorizer, GeolocationMapVectorizer,
+                   MultiPickListMapVectorizer, RealMapVectorizer,
+                   TextMapPivotVectorizer)
 from .numeric import BinaryVectorizer, IntegralVectorizer, RealVectorizer
-from .text import SmartTextVectorizer
+from .text import SmartTextVectorizer, TextHashVectorizer
 
 __all__ = ["TransmogrifierDefaults", "transmogrify"]
 
@@ -67,6 +73,28 @@ def _dispatch_group(ftype: Type[FeatureType],
         return MultiPickListVectorizer(top_k=defaults.top_k,
                                        min_support=defaults.min_support,
                                        track_nulls=defaults.track_nulls)
+    if issubclass(ftype, Geolocation):
+        return GeolocationVectorizer(track_nulls=defaults.track_nulls)
+    if issubclass(ftype, DateList):
+        return DateListVectorizer(track_nulls=defaults.track_nulls)
+    if issubclass(ftype, TextList):
+        from .text import TextListHashVectorizer
+        return TextListHashVectorizer(num_hashes=defaults.num_hashes,
+                                      track_nulls=defaults.track_nulls)
+    if issubclass(ftype, GeolocationMap):
+        return GeolocationMapVectorizer(track_nulls=defaults.track_nulls)
+    if issubclass(ftype, MultiPickListMap):
+        return MultiPickListMapVectorizer(
+            top_k=defaults.top_k, min_support=defaults.min_support,
+            track_nulls=defaults.track_nulls)
+    if issubclass(ftype, BinaryMap):
+        return BinaryMapVectorizer(track_nulls=defaults.track_nulls)
+    if issubclass(ftype, TextMap):
+        return TextMapPivotVectorizer(
+            top_k=defaults.top_k, min_support=defaults.min_support,
+            track_nulls=defaults.track_nulls)
+    if issubclass(ftype, OPMap):  # numeric/integral/date maps
+        return RealMapVectorizer(track_nulls=defaults.track_nulls)
     raise TypeError(
         f"transmogrify: no default vectorizer for {ftype.__name__}")
 

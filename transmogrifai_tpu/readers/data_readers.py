@@ -280,16 +280,19 @@ class CSVAutoReader(CSVProductReader):
         for r in rows:
             for c, cast in casts.items():
                 if r[c] is not None:
-                    r[c] = cast(float(r[c]))
+                    # int columns cast directly (no float round-trip, so
+                    # ids > 2^53 stay exact)
+                    r[c] = cast(r[c])
         return rows
 
 
 def _is_number(v: str) -> bool:
     try:
-        float(v)
-        return True
+        f = float(v)
     except (TypeError, ValueError):
         return False
+    # 'nan'/'inf' cells are not numeric data (common export artifacts)
+    return np.isfinite(f)
 
 
 class ParquetProductReader(DataReader):

@@ -260,6 +260,13 @@ class Estimator(PipelineStage):
     def fit(self, ds: Dataset) -> "Model":
         cols = [ds[f.name] for f in self.input_features]
         model = self.fit_columns(cols)
+        #: back-pointer so downstream stages executing mid-training can
+        #: resolve the fitted model before the DAG swap (e.g.
+        #: PredictionDeIndexer reading StringIndexer labels). Only valid
+        #: during the train() that set it — after training, the swapped
+        #: DAG points at the fitted model directly, so consumers must
+        #: prefer the origin stage itself over this pointer.
+        self.fitted_model = model
         return self._wire_model(model)
 
     def _wire_model(self, model: "Model") -> "Model":

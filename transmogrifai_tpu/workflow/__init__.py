@@ -1,5 +1,7 @@
 """Workflow engine (SURVEY §2.4; core/.../OpWorkflow.scala:332)."""
 from .persistence import load_model, save_model
+from .runner import OpParams, RunResult, RunType, WorkflowRunner
 from .workflow import Workflow, WorkflowModel
 
-__all__ = ["Workflow", "WorkflowModel", "save_model", "load_model"]
+__all__ = ["Workflow", "WorkflowModel", "save_model", "load_model",
+           "OpParams", "WorkflowRunner", "RunType", "RunResult"]

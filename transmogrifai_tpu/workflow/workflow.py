@@ -16,9 +16,12 @@ Spark design:
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from ..evaluators.base import EvaluationMetrics, Evaluator
 from ..features.columns import Dataset, FeatureColumn
@@ -64,7 +67,10 @@ def _generate_raw_data(raw_features: Sequence[Feature], data: Any,
                     continue
                 try:
                     cols0[f.name] = data.generate_dataset([f])[f.name]
-                except Exception:
+                except Exception as e:
+                    _log.warning(
+                        "response %r not extractable from score data "
+                        "(%s); substituting an all-NaN column", f.name, e)
                     cols0[f.name] = FeatureColumn(
                         ftype=f.ftype,
                         data=np.full(n0, np.nan, dtype=np.float64))
@@ -221,7 +227,10 @@ class Workflow:
                     raw, self._rff_score_data, require_responses=False)
             responses = [f for f in raw if f.is_response]
             label = None
-            if len(responses) == 1 and responses[0].name in ds:
+            if len(responses) == 1 and responses[0].name in ds \
+                    and ds[responses[0].name].kind == "numeric":
+                # non-numeric labels (e.g. string classes indexed
+                # in-DAG) skip the null-label correlation check
                 label = np.asarray(ds[responses[0].name].data,
                                    dtype=np.float64)
             results = self._raw_feature_filter.compute_exclusions(
