@@ -15,10 +15,21 @@ BASELINE_AUPR = 0.8225
 
 def main() -> None:
     try:
+        from transmogrifai_tpu.utils.jax_setup import enable_compilation_cache
+        enable_compilation_cache()
         from examples.titanic import run
         t0 = time.perf_counter()
-        metrics, fit_seconds, _ = run(verbose=False)
+        metrics, fit_seconds, model = run(verbose=False)
         total = time.perf_counter() - t0
+        # models x folds throughput (reference north-star metric,
+        # BASELINE.md): grid points x folds over the selector search
+        from transmogrifai_tpu.selector import SelectedModel
+        n_candidates = 0
+        for s in model.stages():
+            if isinstance(s, SelectedModel) and s.summary is not None:
+                n_candidates = sum(
+                    len(r.metric_values)
+                    for r in s.summary.validation_results)
         out = {
             "metric": "titanic_holdout_aupr",
             "value": round(float(metrics.AuPR), 4),
@@ -27,6 +38,9 @@ def main() -> None:
             "auroc": round(float(metrics.AuROC), 4),
             "f1": round(float(metrics.F1), 4),
             "error": round(float(metrics.Error), 4),
+            "models_x_folds": n_candidates,
+            "models_x_folds_per_sec": round(n_candidates
+                                            / max(fit_seconds, 1e-9), 3),
             "train_eval_seconds": round(fit_seconds, 2),
             "total_seconds": round(total, 2),
         }

@@ -1,0 +1,136 @@
+"""Aux subsystem tests: joined readers, listener/metrics, table, version
+(reference JoinedDataReaderTest, OpSparkListenerTest, TableTest,
+VersionInfoTest)."""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.readers import (DataReader, DataReaders,
+                                       JoinedDataReader)
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.utils import (Table, VersionInfo, WorkflowListener,
+                                     version_info)
+from transmogrifai_tpu.workflow import Workflow
+
+
+class TestJoinedReader:
+    def _readers(self):
+        users = DataReader([
+            {"uid": "u1", "plan": "gold"},
+            {"uid": "u2", "plan": "free"},
+            {"uid": "u3", "plan": "gold"}])
+        visits = DataReader([
+            {"user": "u1", "pages": 10.0},
+            {"user": "u2", "pages": 3.0}])
+        return users, visits
+
+    def test_left_outer(self):
+        users, visits = self._readers()
+        joined = JoinedDataReader.left_outer(
+            users, visits, lambda r: r["uid"], lambda r: r["user"])
+        recs = joined.read_records()
+        assert len(recs) == 3
+        by_uid = {r["uid"]: r for r in recs}
+        assert by_uid["u1"]["pages"] == 10.0
+        assert "pages" not in by_uid["u3"]  # unmatched left kept
+
+    def test_inner(self):
+        users, visits = self._readers()
+        joined = JoinedDataReader.inner(
+            users, visits, lambda r: r["uid"], lambda r: r["user"])
+        recs = joined.read_records()
+        assert sorted(r["uid"] for r in recs) == ["u1", "u2"]
+
+    def test_left_wins_on_collision(self):
+        left = DataReader([{"k": "a", "v": 1.0}])
+        right = DataReader([{"k": "a", "v": 99.0}])
+        joined = JoinedDataReader.inner(
+            left, right, lambda r: r["k"], lambda r: r["k"])
+        rec = joined.read_records()[0]
+        assert rec["v"] == 1.0
+        assert rec["right_v"] == 99.0
+
+    def test_joined_feeds_workflow(self):
+        users, visits = self._readers()
+        joined = JoinedDataReader.left_outer(
+            users, visits, lambda r: r["uid"], lambda r: r["user"])
+        plan = FeatureBuilder.of("plan", PickList).extract(
+            lambda r: r.get("plan")).as_predictor()
+        pages = FeatureBuilder.of("pages", Real).extract(
+            lambda r: r.get("pages")).as_predictor()
+        ds = joined.generate_dataset([plan, pages])
+        assert ds.n_rows == 3
+
+
+class TestWorkflowListener:
+    def test_collects_stage_metrics(self):
+        rng = np.random.default_rng(0)
+        records = [{"x": float(rng.normal())} for _ in range(50)]
+        for r in records:
+            r["label"] = float(r["x"] > 0)
+        x = FeatureBuilder.of("x", Real).extract(
+            lambda r: r.get("x")).as_predictor()
+        label = FeatureBuilder.of("label", RealNN).extract(
+            lambda r: r.get("label")).as_response()
+        pred = LogisticRegression().set_input(
+            label, transmogrify([x])).get_output()
+        listener = WorkflowListener()
+        ended = []
+        listener.add_application_end_handler(
+            lambda m: ended.append(m.app_duration))
+        (Workflow().set_result_features(pred)
+         .set_input_records(records).with_listener(listener).train())
+        phases = {(m.stage_name.split("_")[0], m.phase)
+                  for m in listener.metrics.stage_metrics}
+        assert ("LogisticRegression", "fit") in phases
+        assert all(m.seconds >= 0 for m in listener.metrics.stage_metrics)
+        assert all(m.n_rows == 50 for m in listener.metrics.stage_metrics)
+        assert len(ended) == 1
+        json.dumps(listener.metrics.to_json())  # serializable
+
+
+class TestTable:
+    def test_pretty_alignment(self):
+        t = Table(columns=["model", "metric"],
+                  rows=[["LR", 0.91234], ["RandomForest", 0.8]],
+                  name="results")
+        s = t.pretty()
+        lines = s.splitlines()
+        assert "results" in lines[1]
+        assert "| LR           | 0.9123 |" in s
+        assert len({len(l) for l in lines[2:]}) == 1  # uniform width
+
+    def test_row_width_mismatch(self):
+        with pytest.raises(ValueError, match="cells"):
+            Table(columns=["a"], rows=[["x", "y"]])
+
+
+class TestVersionInfo:
+    def test_git_sha_present(self):
+        vi = version_info()
+        assert isinstance(vi, VersionInfo)
+        assert vi.version
+        assert vi.git_sha is None or len(vi.git_sha) == 40
+        json.dumps(vi.to_json())
+
+    def test_in_saved_model(self, tmp_path):
+        rng = np.random.default_rng(1)
+        records = [{"x": float(rng.normal())} for _ in range(30)]
+        for r in records:
+            r["label"] = float(r["x"] > 0)
+        x = FeatureBuilder.of("x", Real).extract(
+            lambda r: r.get("x")).as_predictor()
+        label = FeatureBuilder.of("label", RealNN).extract(
+            lambda r: r.get("label")).as_response()
+        pred = LogisticRegression().set_input(
+            label, transmogrify([x])).get_output()
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(records).train())
+        path = str(tmp_path / "m")
+        model.save(path)
+        doc = json.loads(open(f"{path}/op-model.json").read())
+        assert "versionInfo" in doc and doc["versionInfo"]["version"]

@@ -1,0 +1,113 @@
+"""Advanced text ops tests (reference OpCountVectorizerTest,
+OpWord2VecTest, OpLDATest, TF-IDF pipeline tests)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn
+from transmogrifai_tpu.ops import (LDA, CountVectorizer, TfIdfVectorizer,
+                                   Word2Vec)
+from transmogrifai_tpu.testkit import StageSpecBase
+from transmogrifai_tpu.types import TextList
+
+
+def _feat(name):
+    return FeatureBuilder.of(name, TextList).extract(
+        lambda r, n=name: r.get(n)).as_predictor()
+
+
+def _docs():
+    return [["cat", "dog", "cat"], ["dog", "fish"], None,
+            ["cat", "cat", "bird"], ["fish", "fish", "dog"]]
+
+
+class TestCountVectorizer(StageSpecBase):
+    def build(self):
+        ds = Dataset({"t": FeatureColumn.from_values(TextList, _docs())})
+        return CountVectorizer(min_df=1).set_input(_feat("t")), ds
+
+    def test_counts(self):
+        stage, ds = self.build()
+        model = stage.fit(ds)
+        out = model.transform_columns([ds["t"]])
+        vocab = model.vocabulary[0]
+        cat = vocab.index("cat")
+        np.testing.assert_allclose(out.data[:, cat], [2, 0, 0, 2, 0])
+
+    def test_min_df_prunes(self):
+        ds = Dataset({"t": FeatureColumn.from_values(TextList, _docs())})
+        # min_df is DOCUMENT frequency (MLlib semantics): only "dog"
+        # appears in >= 3 documents
+        model = CountVectorizer(min_df=3).set_input(_feat("t")).fit(ds)
+        assert model.vocabulary[0] == ["dog"]
+
+
+class TestTfIdf(StageSpecBase):
+    def build(self):
+        ds = Dataset({"t": FeatureColumn.from_values(TextList, _docs())})
+        return TfIdfVectorizer(min_df=1).set_input(_feat("t")), ds
+
+    def test_idf_downweights_common(self):
+        stage, ds = self.build()
+        model = stage.fit(ds)
+        vocab = model.vocabulary[0]
+        idf = dict(zip(vocab, model.idf[0]))
+        # "bird" appears in 1 doc, "dog" in 3 -> bird idf higher
+        assert idf["bird"] > idf["dog"]
+        out = model.transform_columns([ds["t"]])
+        assert out.data.shape == (5, len(vocab))
+
+
+class TestWord2Vec:
+    def test_similar_words_closer(self):
+        rng = np.random.default_rng(0)
+        # two topical clusters; words within a cluster co-occur
+        a_words = ["apple", "banana", "cherry"]
+        b_words = ["cpu", "gpu", "ram"]
+        docs = []
+        for _ in range(150):
+            pool = a_words if rng.uniform() < 0.5 else b_words
+            docs.append(list(rng.choice(pool, 4)))
+        ds = Dataset({"t": FeatureColumn.from_values(TextList, docs)})
+        model = Word2Vec(vector_size=16, min_count=1, epochs=60,
+                         step_size=0.2, seed=1).set_input(_feat("t")).fit(ds)
+        vecs = {w: model.vectors[model._index[w]]
+                for w in a_words + b_words}
+
+        def cos(u, v):
+            return float(u @ v / (np.linalg.norm(u) * np.linalg.norm(v)
+                                  + 1e-12))
+        within = cos(vecs["apple"], vecs["banana"])
+        across = cos(vecs["apple"], vecs["cpu"])
+        assert within > across
+
+    def test_transform_means_token_vectors(self):
+        docs = [["x", "y"], ["x"], None]
+        ds = Dataset({"t": FeatureColumn.from_values(TextList, docs)})
+        model = Word2Vec(vector_size=8, min_count=1, epochs=1
+                         ).set_input(_feat("t")).fit(ds)
+        out = model.transform_columns([ds["t"]])
+        assert out.data.shape == (3, 8)
+        np.testing.assert_allclose(out.data[2], np.zeros(8))
+
+
+class TestLDA:
+    def test_topic_separation(self):
+        rng = np.random.default_rng(1)
+        topic_a = ["ball", "goal", "team", "score"]
+        topic_b = ["stock", "market", "price", "trade"]
+        docs = []
+        labels = []
+        for _ in range(60):
+            pool = topic_a if rng.uniform() < 0.5 else topic_b
+            labels.append(pool is topic_a)
+            docs.append(list(rng.choice(pool, 6)))
+        ds = Dataset({"t": FeatureColumn.from_values(TextList, docs)})
+        model = LDA(k=2, max_iter=15, seed=2).set_input(_feat("t")).fit(ds)
+        out = model.transform_columns([ds["t"]])
+        assert out.data.shape == (60, 2)
+        np.testing.assert_allclose(out.data.sum(axis=1), 1.0, atol=1e-6)
+        # dominant topic should track the generating pool
+        dominant = out.data[:, 0] > 0.5
+        agreement = np.mean(dominant == np.asarray(labels))
+        assert agreement > 0.9 or agreement < 0.1  # topic ids may swap

@@ -1,0 +1,4 @@
+"""CLI codegen (SURVEY §2.14; cli/src/main/scala/com/salesforce/op/cli/)."""
+from .gen import generate_project, main
+
+__all__ = ["generate_project", "main"]

@@ -29,6 +29,9 @@ from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
 from .text import (SmartTextVectorizer, SmartTextVectorizerModel,
                    TextHashVectorizer, TextListHashVectorizer, TextTokenizer,
                    tokenize)
+from .text_advanced import (LDA, LDAModel, CountVectorizer,
+                            CountVectorizerModel, TfIdfVectorizer,
+                            TfIdfVectorizerModel, Word2Vec, Word2VecModel)
 from .transmogrify import TransmogrifierDefaults, transmogrify
 
 __all__ = [
@@ -58,4 +61,6 @@ __all__ = [
     "MimeTypeDetector", "LangDetector", "TextLenTransformer",
     "NGramSimilarity", "JaccardSimilarity", "ToOccurTransformer",
     "DropIndicesByTransformer",
+    "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
+    "TfIdfVectorizerModel", "Word2Vec", "Word2VecModel", "LDA", "LDAModel",
 ]

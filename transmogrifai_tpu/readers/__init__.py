@@ -3,7 +3,8 @@ salesforce/op/readers/)."""
 from .data_readers import (AggregateDataReader, ConditionalDataReader,
                            CSVAutoReader, CSVProductReader, DataReader,
                            DataReaders, ParquetProductReader)
+from .joined import JoinedDataReader, JoinKeys
 
 __all__ = ["DataReader", "AggregateDataReader", "ConditionalDataReader",
            "CSVProductReader", "CSVAutoReader", "ParquetProductReader",
-           "DataReaders"]
+           "DataReaders", "JoinedDataReader", "JoinKeys"]

@@ -1,0 +1,101 @@
+"""Joined readers: combine two readers on their keys.
+
+TPU-native port of the reference reader algebra
+(readers/src/main/scala/com/salesforce/op/readers/JoinedDataReader.scala:
+83,119,251): ``left.outer_join(right)`` / ``inner_join`` produce a
+reader whose records merge the two sides' fields per key; features
+extract from the merged record. A secondary aggregation can run after
+the join (JoinedAggregateDataReader:251) via ``with_aggregation``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..features.feature import Feature
+from .data_readers import AggregateDataReader, DataReader
+
+__all__ = ["JoinedDataReader", "JoinKeys"]
+
+
+class JoinKeys:
+    """(reference JoinKeys, JoinedDataReader.scala:83)"""
+
+    def __init__(self, left_key: Callable[[Any], str],
+                 right_key: Callable[[Any], str]):
+        self.left_key = left_key
+        self.right_key = right_key
+
+
+class JoinedDataReader(DataReader):
+    """Join two readers' records by key (reference JoinedReader:119).
+
+    ``join_type``: "leftOuter" keeps all left keys (right fields None
+    when absent); "inner" keeps only matched keys. Colliding field
+    names take the left side's value (right accessible via
+    ``right_prefix``).
+    """
+
+    def __init__(self, left: DataReader, right: DataReader,
+                 join_keys: JoinKeys, join_type: str = "leftOuter",
+                 right_prefix: str = "right_"):
+        super().__init__(records=None, key_fn=None)
+        if join_type not in ("leftOuter", "inner"):
+            raise ValueError("join_type must be 'leftOuter' or 'inner'")
+        self.left = left
+        self.right = right
+        self.join_keys = join_keys
+        self.join_type = join_type
+        self.right_prefix = right_prefix
+        self._aggregation: Optional[AggregateDataReader] = None
+
+    # -- reader algebra (reference innerJoin/leftOuterJoin) -----------------
+    @staticmethod
+    def left_outer(left: DataReader, right: DataReader,
+                   left_key, right_key) -> "JoinedDataReader":
+        return JoinedDataReader(left, right,
+                                JoinKeys(left_key, right_key), "leftOuter")
+
+    @staticmethod
+    def inner(left: DataReader, right: DataReader,
+              left_key, right_key) -> "JoinedDataReader":
+        return JoinedDataReader(left, right,
+                                JoinKeys(left_key, right_key), "inner")
+
+    def with_aggregation(self, key_fn, timestamp_fn, cutoff_time=None,
+                         response_window_ms=None) -> AggregateDataReader:
+        """Secondary aggregation after the join
+        (reference JoinedAggregateDataReader:251)."""
+        return AggregateDataReader(
+            source=self, key_fn=key_fn, timestamp_fn=timestamp_fn,
+            cutoff_time=cutoff_time,
+            response_window_ms=response_window_ms)
+
+    # -- materialization ----------------------------------------------------
+    def read_records(self) -> List[Dict[str, Any]]:
+        left_records = self.left.read_records()
+        right_records = self.right.read_records()
+        by_key: Dict[str, List[Any]] = {}
+        for r in right_records:
+            by_key.setdefault(str(self.join_keys.right_key(r)), []).append(r)
+
+        def fields(rec) -> Dict[str, Any]:
+            return dict(rec) if isinstance(rec, dict) else {
+                k: getattr(rec, k) for k in dir(rec)
+                if not k.startswith("_")}
+
+        out: List[Dict[str, Any]] = []
+        for l in left_records:
+            key = str(self.join_keys.left_key(l))
+            matches = by_key.get(key)
+            if not matches:
+                if self.join_type == "inner":
+                    continue
+                out.append(fields(l))
+                continue
+            for r in matches:
+                merged = fields(r)
+                merged.update({f"{self.right_prefix}{k}": v
+                               for k, v in merged.items()})
+                merged.update(fields(l))  # left wins on collision
+                out.append(merged)
+        return out

@@ -73,14 +73,25 @@ class _ValidatorBase:
         raise NotImplementedError
 
     def _assignments(self, y: np.ndarray, k: int) -> np.ndarray:
+        """Fold id per row; -1 = dropped. Folds are exactly equal-sized
+        (up to k-1 remainder rows are dropped): every fold's train set
+        then has the same static shape, so one XLA program per family
+        covers all folds instead of recompiling per fold — the
+        TPU-native replacement for MLUtils.kFold's uneven splits
+        (documented deviation; at most k-1 of n rows are unused)."""
         rng = np.random.default_rng(self.seed)
-        assign = np.empty(len(y), dtype=np.int64)
+        assign = np.full(len(y), -1, dtype=np.int64)
+
+        def round_robin(idx: np.ndarray):
+            m = (len(idx) // k) * k
+            perm = rng.permutation(idx)
+            assign[perm[:m]] = np.arange(m) % k
+
         if self.stratify:
             for cls in np.unique(y):
-                idx = np.nonzero(y == cls)[0]
-                assign[idx] = rng.permutation(len(idx)) % k
+                round_robin(np.nonzero(y == cls)[0])
         else:
-            assign[:] = rng.permutation(len(y)) % k
+            round_robin(np.arange(len(y)))
         return assign
 
     # -- main loop (reference getSummary, OpValidator.scala:270-310) -------
@@ -144,7 +155,8 @@ class CrossValidation(_ValidatorBase):
 
     def _splits(self, y):
         assign = self._assignments(y, self.num_folds)
-        return [(np.nonzero(assign != f)[0], np.nonzero(assign == f)[0])
+        return [(np.nonzero((assign != f) & (assign >= 0))[0],
+                 np.nonzero(assign == f)[0])
                 for f in range(self.num_folds)]
 
     def get_params(self):

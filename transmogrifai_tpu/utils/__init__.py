@@ -1,6 +1,11 @@
+from .listener import AppMetrics, StageMetric, WorkflowListener
+from .table import Table
 from .uid import uid, reset as reset_uids
 from .vector_meta import (NULL_INDICATOR, OTHER_INDICATOR,
                           VectorColumnMetadata, VectorMetadata)
+from .version import VersionInfo, version_info
 
 __all__ = ["uid", "reset_uids", "VectorColumnMetadata", "VectorMetadata",
-           "NULL_INDICATOR", "OTHER_INDICATOR"]
+           "NULL_INDICATOR", "OTHER_INDICATOR", "Table",
+           "WorkflowListener", "AppMetrics", "StageMetric",
+           "VersionInfo", "version_info"]

@@ -1,0 +1,32 @@
+"""Process-level JAX configuration helpers.
+
+The selector's hyperparameter grids span several static shapes (tree
+depth, forest size, fold sizes), each costing an XLA compile. The
+persistent compilation cache amortizes those compiles across processes
+— the same mechanism production JAX training jobs use. Call
+:func:`enable_compilation_cache` once at program start (bench.py and
+the examples do).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_compilation_cache"]
+
+_DEFAULT_CACHE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".jax_cache")
+
+
+def enable_compilation_cache(path: str = None) -> str:
+    """Turn on JAX's persistent compilation cache at ``path`` (defaults
+    to ``<repo>/.jax_cache``). Safe to call multiple times."""
+    import jax
+    path = path or os.environ.get("TX_JAX_CACHE_DIR", _DEFAULT_CACHE)
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except AttributeError:  # older jax without the knob
+        pass
+    return path

@@ -1,0 +1,94 @@
+"""Workflow execution metrics: per-stage timing/row collection.
+
+TPU-native port of the reference OpSparkListener
+(utils/src/main/scala/com/salesforce/op/utils/spark/
+OpSparkListener.scala:56,136,164): where the reference hooks Spark's
+stage-completed events to collect executor runtime / IO bytes, here
+the workflow executor reports each stage's fit/transform wall time and
+row count to an attached listener; ``AppMetrics`` aggregates per run
+and serializes next to outputs (OpWorkflowRunner:145 behavior).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["StageMetric", "AppMetrics", "WorkflowListener"]
+
+
+@dataclass
+class StageMetric:
+    """(reference StageMetrics, OpSparkListener.scala:164)"""
+    stage_name: str
+    stage_uid: str
+    phase: str             # "fit" | "transform"
+    seconds: float
+    n_rows: int
+
+    def to_json(self) -> dict:
+        return {"stageName": self.stage_name, "stageUid": self.stage_uid,
+                "phase": self.phase, "seconds": round(self.seconds, 6),
+                "nRows": self.n_rows}
+
+
+@dataclass
+class AppMetrics:
+    """(reference AppMetrics, OpSparkListener.scala:136)"""
+    app_name: str = "transmogrifai_tpu"
+    custom_tag_name: Optional[str] = None
+    custom_tag_value: Optional[str] = None
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    stage_metrics: List[StageMetric] = field(default_factory=list)
+
+    @property
+    def app_duration(self) -> float:
+        end = self.end_time if self.end_time is not None else time.time()
+        return end - self.start_time
+
+    def to_json(self) -> dict:
+        return {"appName": self.app_name,
+                "customTagName": self.custom_tag_name,
+                "customTagValue": self.custom_tag_value,
+                "appDurationSeconds": round(self.app_duration, 3),
+                "stageMetrics": [m.to_json() for m in self.stage_metrics]}
+
+
+class WorkflowListener:
+    """Attach via ``Workflow.with_listener`` to collect per-stage metrics
+    (reference collectStageMetrics / logStageMetrics, OpParams.scala:94)."""
+
+    def __init__(self, log_stage_metrics: bool = False,
+                 collect_stage_metrics: bool = True,
+                 app_name: str = "transmogrifai_tpu"):
+        self.log_stage_metrics = log_stage_metrics
+        self.collect_stage_metrics = collect_stage_metrics
+        self.metrics = AppMetrics(app_name=app_name)
+        self._end_handlers: List[Callable[[AppMetrics], None]] = []
+
+    def on_stage_completed(self, stage, phase: str, seconds: float,
+                           n_rows: int) -> None:
+        m = StageMetric(stage_name=stage.stage_name(), stage_uid=stage.uid,
+                        phase=phase, seconds=seconds, n_rows=n_rows)
+        if self.collect_stage_metrics:
+            self.metrics.stage_metrics.append(m)
+        if self.log_stage_metrics:
+            _log.info("stage %s %s: %.3fs over %d rows",
+                      m.stage_name, phase, seconds, n_rows)
+
+    def add_application_end_handler(
+            self, fn: Callable[[AppMetrics], None]) -> None:
+        """(reference OpWorkflowRunner.addApplicationEndHandler:145)"""
+        self._end_handlers.append(fn)
+
+    def on_application_end(self) -> None:
+        self.metrics.end_time = time.time()
+        for fn in self._end_handlers:
+            try:
+                fn(self.metrics)
+            except Exception:  # handlers must not break the run
+                _log.exception("application-end handler failed")

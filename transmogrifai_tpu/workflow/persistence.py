@@ -210,8 +210,10 @@ def save_model(model, path: str) -> None:
         if s is not None and s.uid not in staged:
             staged.add(s.uid)
             stages.append(stage_to_json(s, arrays))
+    from ..utils.version import version_info
     doc = {
         "formatVersion": 1,
+        "versionInfo": version_info().to_json(),
         "resultFeatureUids": [f.uid for f in model.result_features],
         "features": [_feature_to_json(f) for f in feats],
         "stages": stages,

@@ -114,13 +114,23 @@ def _generate_raw_data(raw_features: Sequence[Feature], data: Any,
 
 
 def _fit_and_transform_layers(
-        layers: List[List[PipelineStage]], ds: Dataset, fit: bool
-        ) -> Tuple[Dataset, Dict[str, PipelineStage]]:
+        layers: List[List[PipelineStage]], ds: Dataset, fit: bool,
+        listener=None) -> Tuple[Dataset, Dict[str, PipelineStage]]:
     """Layer-by-layer DAG execution (reference
     FitStagesUtil.fitAndTransformDAG:213 / fitAndTransformLayer:254):
     estimators in a layer are fitted then their models applied; plain
     transformers are applied directly."""
+    import time as _time
     fitted: Dict[str, PipelineStage] = {}
+
+    def timed(stage, phase, fn):
+        t0 = _time.perf_counter()
+        result = fn()
+        if listener is not None:
+            listener.on_stage_completed(
+                stage, phase, _time.perf_counter() - t0, ds.n_rows)
+        return result
+
     for layer in layers:
         for stage in layer:
             if isinstance(stage, FeatureGeneratorStage):
@@ -130,14 +140,17 @@ def _fit_and_transform_layers(
                     raise RuntimeError(
                         f"Unfitted estimator {stage!r} in scoring DAG — "
                         "train the workflow first")
-                model = stage.fit(ds)
+                model = timed(stage, "fit", lambda: stage.fit(ds))
                 fitted[stage.uid] = model
                 out = stage.get_output()
                 ds = ds.with_column(
-                    out.name, model.transform_columns(
-                        [ds[f.name] for f in model.input_features]))
+                    out.name, timed(
+                        stage, "transform",
+                        lambda: model.transform_columns(
+                            [ds[f.name] for f in model.input_features])))
             elif isinstance(stage, Transformer):
-                ds = stage.transform_dataset(ds)
+                ds = timed(stage, "transform",
+                           lambda: stage.transform_dataset(ds))
             else:
                 raise TypeError(f"Cannot execute stage {stage!r}")
     return ds, fitted
@@ -183,6 +196,12 @@ class Workflow:
         """A DataReader supplies (and possibly aggregates) the raw data
         (reference setReader, OpWorkflowCore.scala:121)."""
         self._input_data = reader
+        return self
+
+    def with_listener(self, listener) -> "Workflow":
+        """Attach a WorkflowListener collecting per-stage metrics
+        (reference OpSparkListener wiring, OpWorkflowRunner.scala:326)."""
+        self._listener = listener
         return self
 
     def with_raw_feature_filter(self, rff,
@@ -241,9 +260,13 @@ class Workflow:
                     result_features, results.excluded_names)
                 self.blacklisted_features = tuple(removed)
         layers = topo_layers(result_features)
-        train_ds, fitted = _fit_and_transform_layers(layers, ds, fit=True)
+        listener = getattr(self, "_listener", None)
+        train_ds, fitted = _fit_and_transform_layers(
+            layers, ds, fit=True, listener=listener)
         result = tuple(f.copy_with_new_stages(fitted)
                        for f in result_features)
+        if listener is not None:
+            listener.on_application_end()
         return WorkflowModel(result_features=result,
                              train_dataset=train_ds)
 
