@@ -338,3 +338,65 @@ class TestTransmogrifyDispatch:
         assert out.metadata.size == out.data.shape[1]
         parents = {c.parent_feature_name for c in out.metadata.columns}
         assert parents == {"r", "m", "tm", "g", "tl"}
+
+
+class TestSmartTextMapVectorizer(StageSpecBase):
+    def build(self):
+        from transmogrifai_tpu.ops import SmartTextMapVectorizer
+        rows = [{"color": f"c{i % 3}", "desc": f"unique words here {i}"}
+                for i in range(12)] + [None]
+        ds = Dataset({"m": FeatureColumn.from_values(TextMap, rows)})
+        return SmartTextMapVectorizer(
+            max_cardinality=5, top_k=5, min_support=1,
+            num_hashes=16).set_input(_feat("m", TextMap)), ds
+
+    def test_per_key_pivot_or_hash(self):
+        stage, ds = self.build()
+        model = stage.fit(ds)
+        # low-cardinality key pivots, free-text key hashes
+        assert model.strategies[0]["color"][0] == "pivot"
+        assert model.strategies[0]["desc"][0] == "hash"
+        out = model.transform_columns([ds["m"]])
+        groups = {c.grouping for c in out.metadata.columns}
+        assert groups == {"color", "desc"}
+        # pivot part: 3 levels + other + null; hash part: 16 + null
+        assert out.data.shape[1] == (3 + 2) + (16 + 1)
+
+
+class TestDateMapToUnitCircleVectorizer(StageSpecBase):
+    def build(self):
+        from transmogrifai_tpu.ops import DateMapToUnitCircleVectorizer
+        from transmogrifai_tpu.types import DateMap
+        noon = 12 * 3_600_000  # epoch ms at 12:00 UTC
+        rows = [{"opened": noon}, {"opened": 0, "closed": 6 * 3_600_000},
+                None]
+        ds = Dataset({"m": FeatureColumn.from_values(DateMap, rows)})
+        return DateMapToUnitCircleVectorizer(
+            time_period="HourOfDay").set_input(_feat("m", DateMap)), ds
+
+    def test_unit_circle_per_key(self):
+        from transmogrifai_tpu.types import DateMap
+        stage, ds = self.build()
+        out = stage.fit(ds).transform_columns([ds["m"]])
+        # keys sorted: closed (sin, cos), opened (sin, cos)
+        assert out.data.shape == (3, 4)
+        # opened at noon: phase pi -> sin ~ 0, cos ~ -1
+        np.testing.assert_allclose(out.data[0, 2:], [0.0, -1.0], atol=1e-9)
+        # missing map -> center of the circle
+        np.testing.assert_allclose(out.data[2], 0.0)
+
+
+class TestTransmogrifyMapRouting:
+    def test_date_and_text_maps_route(self):
+        from transmogrifai_tpu.ops.maps import (
+            DateMapToUnitCircleVectorizer, SmartTextMapVectorizer)
+        from transmogrifai_tpu.ops.transmogrify import (
+            TransmogrifierDefaults, _dispatch_group)
+        from transmogrifai_tpu.types import DateMap, PickListMap, TextMap
+        d = TransmogrifierDefaults()
+        assert isinstance(_dispatch_group(DateMap, d),
+                          DateMapToUnitCircleVectorizer)
+        assert isinstance(_dispatch_group(TextMap, d),
+                          SmartTextMapVectorizer)
+        assert isinstance(_dispatch_group(PickListMap, d),
+                          TextMapPivotVectorizer)

@@ -1,19 +1,26 @@
 """Multi-chip tests on the virtual 8-device CPU mesh ("cluster without a
-cluster", SURVEY §4)."""
+cluster", SURVEY §4): the fold x grid x data CV kernel of parallel/cv.py
+and its integration into the production validator."""
 import numpy as np
 import pytest
 
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.models import (LinearRegression, LinearSVC,
+                                      LogisticRegression)
 from transmogrifai_tpu.parallel import cv_mesh, make_mesh, n_devices
-from transmogrifai_tpu.parallel.cv import (eval_fold_grid,
-                                           fit_logistic_fold_grid, fold_masks)
+from transmogrifai_tpu.parallel.cv import (fit_linear_fold_grid, fold_masks,
+                                           models_mesh)
+from transmogrifai_tpu.selector.validator import CrossValidation
 
 
 def test_mesh_shapes():
     assert n_devices() == 8
-    m = make_mesh({"folds": 2, "data": 4})
-    assert m.shape == {"folds": 2, "data": 4}
+    m = make_mesh({"models": 2, "data": 4})
+    assert m.shape == {"models": 2, "data": 4}
     m2 = cv_mesh(n_folds=4)
     assert m2.shape["folds"] * m2.shape["data"] == 8
+    m3 = models_mesh(data_shards=2)
+    assert m3.shape == {"models": 4, "data": 2}
 
 
 def test_fold_masks_stratified():
@@ -29,42 +36,95 @@ def test_fold_masks_stratified():
         assert len(np.unique(y[held])) == 2
 
 
-def test_fold_grid_fit_on_mesh(rng):
-    n, d = 256, 4
+def _toy(rng, n=256, d=4):
     X = rng.normal(size=(n, d))
-    w_true = np.array([2.0, -1.0, 0.5, 0.0])
+    w_true = np.array([2.0, -1.0, 0.5, 0.0][:d])
     y = ((X @ w_true + rng.logistic(size=n) * 0.3) > 0).astype(float)
-    mesh = make_mesh({"folds": 2, "data": 4})
+    return X, y
+
+
+def test_fold_grid_fit_on_mesh(rng):
+    X, y = _toy(rng)
+    n, d = X.shape
+    mesh = models_mesh(data_shards=2)            # 4 model shards x 2 data
     masks = fold_masks(n, 2, y=y)
-    regs = np.array([0.001, 0.1, 10.0])
+    grid = np.array([[0.001, 0.0], [0.1, 0.0], [10.0, 0.0]])
 
-    params = fit_logistic_fold_grid(X, y, masks, regs, mesh, steps=300)
+    params = fit_linear_fold_grid("logistic", X, y, masks, grid, mesh=mesh)
     assert params.shape == (2, 3, d + 1)
-
-    # sanity: fitted low-reg models classify their held-out rows well
-    losses = eval_fold_grid(X, y, masks, params)
-    assert losses.shape == (2, 3)
-    # heavy regularization must be worse than light on this separable data
-    assert losses[:, 2].mean() > losses[:, 0].mean()
+    assert np.all(np.isfinite(params))
 
     # winner's accuracy on held-out rows beats chance comfortably
-    f, g = 0, int(np.argmin(losses.mean(axis=0)))
-    w, b = params[f, g, :d], params[f, g, d]
-    held = (1 - masks[f]).astype(bool)
+    w, b = params[0, 0, :d], params[0, 0, d]
+    held = (1 - masks[0]).astype(bool)
     acc = np.mean(((X[held] @ w + b) > 0) == (y[held] == 1))
     assert acc > 0.8
+    # heavy regularization shrinks coefficients
+    assert (np.abs(params[:, 2, :d]).sum()
+            < 0.5 * np.abs(params[:, 0, :d]).sum())
 
 
 def test_mesh_fit_matches_single_device(rng):
-    """Sharded fit == unsharded fit (collectives are exact)."""
-    n, d = 128, 3
-    X = rng.normal(size=(n, d))
-    y = (X[:, 0] > 0).astype(float)
-    masks = fold_masks(n, 2, y=y)
-    regs = np.array([0.01])
-    mesh_8 = make_mesh({"folds": 2, "data": 4})
-    mesh_1 = make_mesh({"folds": 1, "data": 1})
+    """Sharded fit == local vmapped fit (collectives are exact)."""
+    X, y = _toy(rng, n=128, d=3)
+    masks = fold_masks(128, 2, y=y)
+    grid = np.array([[0.01, 0.0], [0.1, 0.5]])
+    mesh = models_mesh(data_shards=2)
 
-    p8 = fit_logistic_fold_grid(X, y, masks, regs, mesh_8, steps=100)
-    p1 = fit_logistic_fold_grid(X, y, masks, regs, mesh_1, steps=100)
-    np.testing.assert_allclose(p8, p1, atol=1e-4)
+    p_mesh = fit_linear_fold_grid("logistic", X, y, masks, grid, mesh=mesh)
+    p_local = fit_linear_fold_grid("logistic", X, y, masks, grid)
+    np.testing.assert_allclose(p_mesh, p_local, atol=1e-4)
+
+
+def test_batched_kernel_matches_sequential_fit(rng):
+    """The fold x grid kernel must reproduce fit_arrays on the gathered
+    fold rows — same weighted core, same winner (VERDICT r2 item 2)."""
+    X, y = _toy(rng, n=200, d=4)
+    masks = fold_masks(200, 2, y=y)
+    for est, kind, grid in [
+        (LogisticRegression(reg_param=0.1, elastic_net_param=0.5),
+         "logistic", np.array([[0.1, 0.5]])),
+        (LinearSVC(reg_param=0.1), "svc", np.array([[0.1, 0.0]])),
+        (LinearRegression(reg_param=0.1), "squared",
+         np.array([[0.1, 0.0]])),
+    ]:
+        params = fit_linear_fold_grid(kind, X, y, masks, grid,
+                                      max_iter=est.max_iter)
+        for f in range(2):
+            rows = masks[f].astype(bool)
+            model = est.fit_arrays(X[rows], y[rows])
+            coef = np.asarray(model.coefficients, dtype=float).reshape(-1)
+            np.testing.assert_allclose(params[f, 0, :4], coef, atol=2e-3,
+                                       err_msg=f"{kind} fold {f}")
+
+
+class _SequentialLR(LogisticRegression):
+    """LogisticRegression with the batched kernel disabled — forces the
+    validator's per-candidate fallback path."""
+
+    def fit_fold_grid_arrays(self, *a, **k):
+        raise NotImplementedError
+
+
+def test_validator_mesh_selects_same_winner(rng):
+    """CrossValidation with a mesh picks the same winner (+- tolerance)
+    as the sequential per-candidate path (VERDICT r2 item 2 'Done')."""
+    X, y = _toy(rng, n=240, d=4)
+    grid = [{"reg_param": r, "elastic_net_param": a}
+            for r in (0.01, 0.1, 1.0) for a in (0.0, 0.5)]
+
+    def run(estimator, mesh):
+        return CrossValidation(
+            BinaryClassificationEvaluator(), num_folds=2, stratify=True,
+            mesh=mesh).validate([(estimator, grid)], X, y)
+
+    best_mesh = run(LogisticRegression(max_iter=50),
+                    models_mesh(data_shards=2))
+    best_seq = run(_SequentialLR(max_iter=50), None)
+
+    assert best_mesh.params == best_seq.params
+    assert abs(best_mesh.metric - best_seq.metric) < 1e-3
+    # and each candidate's per-fold metrics agree across the two paths
+    for rm, rb in zip(best_mesh.results, best_seq.results):
+        np.testing.assert_allclose(rm.metric_values, rb.metric_values,
+                                   atol=2e-3)

@@ -35,14 +35,32 @@ __all__ = ["LogisticRegression", "LogisticRegressionModel",
 
 
 # ---------------------------------------------------------------------------
-# shared standardization helpers
+# shared weighted-fit cores
+#
+# Every linear-family fit is expressed over ROW WEIGHTS ``w`` (1 for
+# training rows, 0 otherwise) with reductions routed through ``_psum``:
+# - single fit: w = ones — identical math to a plain fit;
+# - fold x grid CV: w = fold masks, the whole grid batched with vmap
+#   (parallel/cv.py uses exactly these cores, so the mesh path selects
+#   the same winner as the sequential path);
+# - multi-chip: ``axis_name`` set inside shard_map — row reductions
+#   cross the mesh data axis via psum over ICI.
 # ---------------------------------------------------------------------------
 
-def _standardize(X: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    mu = jnp.mean(X, axis=0)
-    sigma = jnp.std(X, axis=0)
+def _psum(x, axis_name: Optional[str]):
+    return jax.lax.psum(x, axis_name) if axis_name else x
+
+
+def _weighted_standardize(X, w, axis_name=None):
+    """Weighted mean/std standardization (subset stats when w is a 0/1
+    mask — matches fitting on the gathered rows exactly)."""
+    wsum = jnp.maximum(_psum(jnp.sum(w), axis_name), 1e-12)
+    mu = _psum(jnp.sum(X * w[:, None], axis=0), axis_name) / wsum
+    var = _psum(jnp.sum(w[:, None] * (X - mu) ** 2, axis=0),
+                axis_name) / wsum
+    sigma = jnp.sqrt(var)
     safe = jnp.where(sigma > 0, sigma, 1.0)
-    return (X - mu) / safe, mu, safe
+    return (X - mu) / safe, mu, safe, wsum
 
 
 def _unstandardize_coefs(w: jnp.ndarray, b: jnp.ndarray, mu: jnp.ndarray,
@@ -54,6 +72,131 @@ def _unstandardize_coefs(w: jnp.ndarray, b: jnp.ndarray, mu: jnp.ndarray,
     return w_orig, b_orig
 
 
+def _prep(X, w, standardize: bool, axis_name):
+    n, d = X.shape
+    if standardize:
+        return _weighted_standardize(X, w, axis_name)
+    wsum = jnp.maximum(_psum(jnp.sum(w), axis_name), 1e-12)
+    return X, jnp.zeros(d, X.dtype), jnp.ones(d, X.dtype), wsum
+
+
+def binary_logistic_core(X, y, w, reg, alpha, *, fit_intercept: bool,
+                         standardize: bool, max_iter: int, use_l1: bool,
+                         axis_name: Optional[str] = None,
+                         solver: str = "auto"):
+    """Weighted binomial logistic fit -> (coefficients, intercept).
+
+    solver="auto" uses L-BFGS for smooth penalties and FISTA when L1 is
+    active; under a mesh (``axis_name``) or solver="fista" everything
+    runs FISTA with a STATIC trip count — optax L-BFGS's data-dependent
+    linesearch loops de-sync collective rendezvous across shards.
+    """
+    d = X.shape[1]
+    Xs, mu, sigma, wsum = _prep(X, w, standardize, axis_name)
+    s = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
+    l2 = reg * (1.0 - alpha)
+    l1 = reg * alpha
+    # SHARD-LOCAL objective: the data term sums local rows only (global
+    # wsum), the reg term is divided across shards — so an explicit psum
+    # of the gradient reconstructs the exact global gradient. Autodiff
+    # therefore never transposes a collective (see fista_minimize).
+    nshards = _psum(jnp.asarray(1.0, Xs.dtype), axis_name)
+
+    def smooth(params):
+        wv, b = params[:d], params[d]
+        m = Xs @ wv + (b if fit_intercept else 0.0)
+        return (jnp.sum(w * jnp.logaddexp(0.0, -s * m)) / wsum
+                + 0.5 * l2 * jnp.sum(wv * wv) / nshards)
+
+    w0 = jnp.zeros(d + 1, Xs.dtype)
+    force_fista = solver == "fista" or axis_name is not None
+    if use_l1 or force_fista:
+        mask = jnp.concatenate([jnp.ones(d, Xs.dtype),
+                                jnp.zeros(1, Xs.dtype)])
+        lip = design_lipschitz(Xs, l2, curvature_bound=0.25, w=w,
+                               axis_name=axis_name) + 0.25
+        params = fista_minimize(smooth, l1, w0, lip, max_iter=max_iter * 5,
+                                tol=0.0 if force_fista else 1e-7,
+                                l1_mask=mask, grad_psum_axis=axis_name)
+    else:
+        params = lbfgs_minimize(smooth, w0, max_iter=max_iter)
+    wv, b = params[:d], jnp.where(fit_intercept, params[d], 0.0)
+    return _unstandardize_coefs(wv, b, mu, sigma)
+
+
+def linear_regression_core(X, y, w, reg, alpha, *, fit_intercept: bool,
+                           standardize: bool, max_iter: int, use_l1: bool,
+                           axis_name: Optional[str] = None,
+                           solver: str = "auto"):
+    """Weighted OLS/ridge/elastic-net fit -> (coefficients, intercept).
+    Non-L1 solves closed-form normal equations (loop-free, mesh-safe);
+    L1 runs FISTA with a static trip count under a mesh."""
+    d = X.shape[1]
+    Xs, mu, sigma, wsum = _prep(X, w, standardize, axis_name)
+    ybar = (_psum(jnp.sum(w * y), axis_name) / wsum if fit_intercept
+            else jnp.asarray(0.0, Xs.dtype))
+    yc = y - ybar
+    l2 = reg * (1.0 - alpha)
+    l1 = reg * alpha
+
+    if not use_l1:
+        # ridge normal equations on the MXU (reference: MLlib "normal"
+        # solver / breeze L-BFGS; one (d,d) psum-reduced solve here)
+        A = (_psum(Xs.T @ (w[:, None] * Xs), axis_name) / wsum
+             + l2 * jnp.eye(d, dtype=Xs.dtype))
+        wv = jnp.linalg.solve(A, _psum(Xs.T @ (w * yc), axis_name) / wsum)
+    else:
+        nshards = _psum(jnp.asarray(1.0, Xs.dtype), axis_name)
+
+        def smooth(wv):     # shard-local; solver psums the gradient
+            r = Xs @ wv - yc
+            return (jnp.sum(w * r * r) / (2.0 * wsum)
+                    + 0.5 * l2 * jnp.sum(wv * wv) / nshards)
+        lip = design_lipschitz(Xs, l2, curvature_bound=1.0, w=w,
+                               axis_name=axis_name) + 1e-3
+        wv = fista_minimize(smooth, l1, jnp.zeros(d, Xs.dtype), lip,
+                            max_iter=max_iter * 5,
+                            tol=0.0 if (solver == "fista"
+                                        or axis_name is not None) else 1e-7,
+                            grad_psum_axis=axis_name)
+    w_orig = wv / sigma
+    b = ybar - w_orig @ mu if fit_intercept else jnp.asarray(0.0, Xs.dtype)
+    return w_orig, b
+
+
+def linear_svc_core(X, y, w, reg, alpha, *, fit_intercept: bool,
+                    standardize: bool, max_iter: int, use_l1: bool = False,
+                    axis_name: Optional[str] = None, solver: str = "auto"):
+    """Weighted L2 squared-hinge SVM fit -> (coefficients, intercept).
+    The reference's LinearSVC uses hinge + OWL-QN; squared hinge is the
+    smooth TPU-friendly variant with near-identical decision boundaries
+    (documented deviation). ``alpha``/``use_l1`` accepted for kernel-
+    signature uniformity; L1 is not part of MLlib LinearSVC."""
+    d = X.shape[1]
+    Xs, mu, sigma, wsum = _prep(X, w, standardize, axis_name)
+    s = 2.0 * y - 1.0
+    nshards = _psum(jnp.asarray(1.0, Xs.dtype), axis_name)
+
+    def loss(params):       # shard-local; solver psums the gradient
+        wv, b = params[:d], params[d]
+        m = Xs @ wv + (b if fit_intercept else 0.0)
+        viol = jnp.maximum(0.0, 1.0 - s * m)
+        return (jnp.sum(w * viol * viol) / wsum
+                + 0.5 * reg * jnp.sum(wv * wv) / nshards)
+
+    w0 = jnp.zeros(d + 1, Xs.dtype)
+    if solver == "fista" or axis_name is not None:
+        # squared hinge has phi'' <= 2
+        lip = design_lipschitz(Xs, reg, curvature_bound=2.0, w=w,
+                               axis_name=axis_name) + 2.0
+        params = fista_minimize(loss, 0.0, w0, lip, max_iter=max_iter * 5,
+                                tol=0.0, grad_psum_axis=axis_name)
+    else:
+        params = lbfgs_minimize(loss, w0, max_iter=max_iter)
+    wv, b = params[:d], jnp.where(fit_intercept, params[d], 0.0)
+    return _unstandardize_coefs(wv, b, mu, sigma)
+
+
 # ---------------------------------------------------------------------------
 # logistic regression
 # ---------------------------------------------------------------------------
@@ -62,32 +205,10 @@ def _unstandardize_coefs(w: jnp.ndarray, b: jnp.ndarray, mu: jnp.ndarray,
                                              "max_iter", "use_l1"))
 def _fit_binary_logistic(X, y, reg, alpha, *, fit_intercept: bool,
                          standardize: bool, max_iter: int, use_l1: bool):
-    n, d = X.shape
-    if standardize:
-        Xs, mu, sigma = _standardize(X)
-    else:
-        Xs, mu, sigma = X, jnp.zeros(d, X.dtype), jnp.ones(d, X.dtype)
-    s = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
-    l2 = reg * (1.0 - alpha)
-    l1 = reg * alpha
-
-    def smooth(params):
-        w, b = params[:d], params[d]
-        m = Xs @ w + (b if fit_intercept else 0.0)
-        return (jnp.mean(jnp.logaddexp(0.0, -s * m))
-                + 0.5 * l2 * jnp.sum(w * w))
-
-    w0 = jnp.zeros(d + 1, Xs.dtype)
-    if use_l1:
-        mask = jnp.concatenate([jnp.ones(d, Xs.dtype),
-                                jnp.zeros(1, Xs.dtype)])
-        lip = design_lipschitz(Xs, l2, curvature_bound=0.25) + 0.25
-        params = fista_minimize(smooth, l1, w0, lip, max_iter=max_iter * 5,
-                                l1_mask=mask)
-    else:
-        params = lbfgs_minimize(smooth, w0, max_iter=max_iter)
-    w, b = params[:d], jnp.where(fit_intercept, params[d], 0.0)
-    return _unstandardize_coefs(w, b, mu, sigma)
+    return binary_logistic_core(
+        X, y, jnp.ones(X.shape[0], X.dtype), reg, alpha,
+        fit_intercept=fit_intercept, standardize=standardize,
+        max_iter=max_iter, use_l1=use_l1)
 
 
 @functools.partial(jax.jit, static_argnames=("fit_intercept", "standardize",
@@ -96,10 +217,7 @@ def _fit_multinomial_logistic(X, y, reg, alpha, *, k: int,
                               fit_intercept: bool, standardize: bool,
                               max_iter: int, use_l1: bool):
     n, d = X.shape
-    if standardize:
-        Xs, mu, sigma = _standardize(X)
-    else:
-        Xs, mu, sigma = X, jnp.zeros(d, X.dtype), jnp.ones(d, X.dtype)
+    Xs, mu, sigma, _ = _prep(X, jnp.ones(n, X.dtype), standardize, None)
     onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=Xs.dtype)
     l2 = reg * (1.0 - alpha)
     l1 = reg * alpha
@@ -123,6 +241,26 @@ def _fit_multinomial_logistic(X, y, reg, alpha, *, k: int,
     W = params[:, :d]
     b = params[:, d] if fit_intercept else jnp.zeros(k, Xs.dtype)
     return _unstandardize_coefs(W, b, mu, sigma)
+
+
+def _grid_to_reg_alpha(estimator, grid,
+                       allowed=("reg_param", "elastic_net_param")):
+    """(G, 2) [reg, alpha] array from grid dicts; params a dict omits
+    fall back to the ESTIMATOR's configured values — matching what the
+    sequential path's ``with_params`` produces. NotImplementedError for
+    params the batched kernel can't trace (validator falls back to the
+    sequential per-candidate path)."""
+    out = np.zeros((len(grid), 2))
+    for i, params in enumerate(grid):
+        extra = set(params) - set(allowed)
+        if extra:
+            raise NotImplementedError(
+                f"batched kernel cannot vary {sorted(extra)}")
+        out[i, 0] = params.get("reg_param", getattr(estimator, "reg_param",
+                                                    0.0))
+        out[i, 1] = params.get("elastic_net_param",
+                               getattr(estimator, "elastic_net_param", 0.0))
+    return out
 
 
 class LogisticRegression(Predictor):
@@ -162,6 +300,22 @@ class LogisticRegression(Predictor):
         return LogisticRegressionModel(coefficients=np.asarray(w),
                                        intercept=np.asarray(b))
 
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """All (fold, grid point) candidates in one batched XLA program
+        (optionally sharded over a ("models", "data") mesh) — reference
+        OpValidator.scala:270-310 task parallelism. Binary only."""
+        if len(y) and int(np.max(y)) + 1 > 2:
+            raise NotImplementedError("batched kernel is binary-only")
+        from ..parallel.cv import fit_linear_fold_grid
+        ga = _grid_to_reg_alpha(self, grid)
+        params = fit_linear_fold_grid(
+            "logistic", X, y, masks, ga, mesh=mesh,
+            fit_intercept=self.fit_intercept,
+            standardize=self.standardization, max_iter=self.max_iter)
+        d = X.shape[1]
+        return [[LogisticRegressionModel(p[:d], p[d]) for p in row]
+                for row in params]
+
 
 class LogisticRegressionModel(ClassifierModel):
     def __init__(self, coefficients, intercept, uid: Optional[str] = None):
@@ -184,31 +338,10 @@ class LogisticRegressionModel(ClassifierModel):
                                              "max_iter", "use_l1"))
 def _fit_linear_regression(X, y, reg, alpha, *, fit_intercept: bool,
                            standardize: bool, max_iter: int, use_l1: bool):
-    n, d = X.shape
-    if standardize:
-        Xs, mu, sigma = _standardize(X)
-    else:
-        Xs, mu, sigma = X, jnp.zeros(d, X.dtype), jnp.ones(d, X.dtype)
-    ybar = jnp.mean(y) if fit_intercept else 0.0
-    yc = y - ybar
-    l2 = reg * (1.0 - alpha)
-    l1 = reg * alpha
-
-    if not use_l1:
-        # ridge normal equations on the MXU (reference: MLlib "normal"
-        # solver / breeze L-BFGS; one (d,d) solve here)
-        A = Xs.T @ Xs / n + l2 * jnp.eye(d, dtype=Xs.dtype)
-        w = jnp.linalg.solve(A, Xs.T @ yc / n)
-    else:
-        def smooth(w):
-            r = Xs @ w - yc
-            return 0.5 * jnp.mean(r * r) + 0.5 * l2 * jnp.sum(w * w)
-        lip = design_lipschitz(Xs, l2, curvature_bound=1.0) + 1e-3
-        w = fista_minimize(smooth, l1, jnp.zeros(d, Xs.dtype), lip,
-                           max_iter=max_iter * 5)
-    w_orig = w / sigma
-    b = ybar - w_orig @ mu if fit_intercept else jnp.asarray(0.0, Xs.dtype)
-    return w_orig, b
+    return linear_regression_core(
+        X, y, jnp.ones(X.shape[0], X.dtype), reg, alpha,
+        fit_intercept=fit_intercept, standardize=standardize,
+        max_iter=max_iter, use_l1=use_l1)
 
 
 class LinearRegression(Predictor):
@@ -238,6 +371,19 @@ class LinearRegression(Predictor):
         return LinearRegressionModel(coefficients=np.asarray(w),
                                      intercept=float(b))
 
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """All (fold, grid point) candidates in one batched XLA program
+        (optionally mesh-sharded); same core as fit_arrays."""
+        from ..parallel.cv import fit_linear_fold_grid
+        ga = _grid_to_reg_alpha(self, grid)
+        params = fit_linear_fold_grid(
+            "squared", X, y, masks, ga, mesh=mesh,
+            fit_intercept=self.fit_intercept,
+            standardize=self.standardization, max_iter=self.max_iter)
+        d = X.shape[1]
+        return [[LinearRegressionModel(p[:d], float(p[d])) for p in row]
+                for row in params]
+
 
 class LinearRegressionModel(RegressionModel):
     def __init__(self, coefficients, intercept: float = 0.0,
@@ -258,26 +404,10 @@ class LinearRegressionModel(RegressionModel):
                                              "max_iter"))
 def _fit_linear_svc(X, y, reg, *, fit_intercept: bool, standardize: bool,
                     max_iter: int):
-    """L2-regularized squared-hinge SVM. The reference's LinearSVC uses
-    hinge + OWL-QN; squared hinge is the smooth TPU-friendly variant with
-    near-identical decision boundaries (documented deviation)."""
-    n, d = X.shape
-    if standardize:
-        Xs, mu, sigma = _standardize(X)
-    else:
-        Xs, mu, sigma = X, jnp.zeros(d, X.dtype), jnp.ones(d, X.dtype)
-    s = 2.0 * y - 1.0
-
-    def loss(params):
-        w, b = params[:d], params[d]
-        m = Xs @ w + (b if fit_intercept else 0.0)
-        viol = jnp.maximum(0.0, 1.0 - s * m)
-        return jnp.mean(viol * viol) + 0.5 * reg * jnp.sum(w * w)
-
-    params = lbfgs_minimize(loss, jnp.zeros(d + 1, Xs.dtype),
-                            max_iter=max_iter)
-    w, b = params[:d], jnp.where(fit_intercept, params[d], 0.0)
-    return _unstandardize_coefs(w, b, mu, sigma)
+    return linear_svc_core(
+        X, y, jnp.ones(X.shape[0], X.dtype), reg, 0.0,
+        fit_intercept=fit_intercept, standardize=standardize,
+        max_iter=max_iter)
 
 
 class LinearSVC(Predictor):
@@ -299,6 +429,19 @@ class LinearSVC(Predictor):
             fit_intercept=self.fit_intercept,
             standardize=self.standardization, max_iter=self.max_iter)
         return LinearSVCModel(coefficients=np.asarray(w), intercept=float(b))
+
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """All (fold, grid point) candidates in one batched XLA program
+        (optionally mesh-sharded); same core as fit_arrays."""
+        from ..parallel.cv import fit_linear_fold_grid
+        ga = _grid_to_reg_alpha(self, grid, allowed=("reg_param",))
+        params = fit_linear_fold_grid(
+            "svc", X, y, masks, ga, mesh=mesh,
+            fit_intercept=self.fit_intercept,
+            standardize=self.standardization, max_iter=self.max_iter)
+        d = X.shape[1]
+        return [[LinearSVCModel(p[:d], float(p[d])) for p in row]
+                for row in params]
 
 
 class LinearSVCModel(ClassifierModel):

@@ -1,11 +1,19 @@
 """Default model pools for the selector factories.
 
-Centralizes the per-problem-type candidate pools + hyperparameter grids
-(reference: the modelsAndParameters defaults in
-BinaryClassificationModelSelector.scala:68-128,
-MultiClassificationModelSelector.scala:138-183,
-RegressionModelSelector.scala:150-193, grid values from
-DefaultSelectorParams.scala:38-60).
+Centralizes the per-problem-type candidate pools + hyperparameter grids,
+mirroring the reference's defaults
+(BinaryClassificationModelSelector.scala:57-128 `defaultModelsToUse` =
+LR / RandomForest / GBT / LinearSVC — NaiveBayes, DecisionTree and
+XGBoost are declared but opt-in via `modelTypesToUse`;
+MultiClassificationModelSelector.scala:138-183;
+RegressionModelSelector.scala:150-193; grid values from
+DefaultSelectorParams.scala:36-59).
+
+Documented deviation: the reference's RF/DT grids sweep minInfoGain over
+(0.001, 0.01, 0.1); we pin minInfoGain=0.001 (the Spark-near-default
+end) and sweep depth x minInstancesPerNode, keeping the search's
+shape-distinct compile count low — the dominant quality factors for
+these families on tabular data are depth and leaf-size regularization.
 """
 from __future__ import annotations
 
@@ -13,56 +21,115 @@ from typing import Dict, List, Tuple
 
 from .base import Predictor
 
-__all__ = ["default_binary_extra_models", "default_multiclass_extra_models",
+__all__ = ["default_binary_models", "default_multiclass_models",
+           "default_regression_models", "default_binary_extra_models",
+           "default_multiclass_extra_models",
            "default_regression_extra_models"]
+
+#: DefaultSelectorParams.Regularization
+_REG = (0.001, 0.01, 0.1, 0.2)
+#: DefaultSelectorParams.ElasticNet
+_ELASTIC = (0.1, 0.5)
+#: DefaultSelectorParams.MaxDepth
+_DEPTH = (3, 6, 12)
+#: DefaultSelectorParams.MinInstancesPerNode
+_MIN_INST = (10, 100)
+#: DefaultSelectorParams.{MaxTrees, MaxIterTree, MaxIterLin}
+_NUM_TREES, _GBT_ROUNDS, _MAX_ITER_LIN = 50, 20, 50
+
+
+def default_binary_models() -> List[Tuple[Predictor, List[Dict]]]:
+    """Reference defaultModelsToUse: LR, RF, GBT, SVC
+    (BinaryClassificationModelSelector.scala:57-60)."""
+    from .linear import LinearSVC, LogisticRegression
+    from .trees import GBTClassifier, RandomForestClassifier
+    return [
+        (LogisticRegression(max_iter=_MAX_ITER_LIN),
+         [{"reg_param": r, "elastic_net_param": e}
+          for r in _REG for e in _ELASTIC]),
+        (RandomForestClassifier(num_trees=_NUM_TREES,
+                                min_info_gain=0.001),
+         [{"max_depth": d, "min_instances_per_node": m}
+          for d in _DEPTH for m in _MIN_INST]),
+        (GBTClassifier(num_rounds=_GBT_ROUNDS),
+         [{"max_depth": d, "min_child_weight": float(m)}
+          for d in _DEPTH for m in (1, 10)]),
+        (LinearSVC(max_iter=_MAX_ITER_LIN),
+         [{"reg_param": r} for r in _REG]),
+    ]
 
 
 def default_binary_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
+    """Opt-in families (reference modelsAndParams minus
+    defaultModelsToUse): NaiveBayes, DecisionTree, XGBoost."""
     from .bayes import NaiveBayes
-    from .trees import (DecisionTreeClassifier, GBTClassifier,
-                        RandomForestClassifier)
+    from .trees import DecisionTreeClassifier, XGBoostClassifier
     return [
-        (RandomForestClassifier(),
-         [{"max_depth": d, "num_trees": t, "min_instances_per_node": m}
-          for d in (3, 6, 12) for t in (10, 50) for m in (10, 100)]),
-        (GBTClassifier(),
-         [{"max_depth": d, "num_rounds": r}
-          for d in (3, 6) for r in (50, 100)]),
-        (DecisionTreeClassifier(),
-         [{"max_depth": d, "min_instances_per_node": m}
-          for d in (3, 6, 12) for m in (10, 100)]),
         (NaiveBayes(), [{"smoothing": 1.0}]),
+        (DecisionTreeClassifier(min_info_gain=0.001),
+         [{"max_depth": d, "min_instances_per_node": m}
+          for d in _DEPTH for m in _MIN_INST]),
+        (XGBoostClassifier(),
+         [{"max_depth": d, "eta": e}
+          for d in _DEPTH for e in (0.1, 0.3)]),
+    ]
+
+
+def default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
+    """Reference MultiClassificationModelSelector defaults: LR, RF, NB,
+    DT (MultiClassificationModelSelector.scala:138-183)."""
+    from .bayes import NaiveBayes
+    from .linear import LogisticRegression
+    from .trees import DecisionTreeClassifier, RandomForestClassifier
+    return [
+        (LogisticRegression(max_iter=_MAX_ITER_LIN),
+         [{"reg_param": r, "elastic_net_param": e}
+          for r in _REG for e in _ELASTIC]),
+        (RandomForestClassifier(num_trees=_NUM_TREES,
+                                min_info_gain=0.001),
+         [{"max_depth": d, "min_instances_per_node": m}
+          for d in _DEPTH for m in _MIN_INST]),
+        (NaiveBayes(), [{"smoothing": 1.0}]),
+        (DecisionTreeClassifier(min_info_gain=0.001),
+         [{"max_depth": d, "min_instances_per_node": m}
+          for d in _DEPTH for m in _MIN_INST]),
     ]
 
 
 def default_multiclass_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
-    from .bayes import NaiveBayes
-    from .trees import DecisionTreeClassifier, RandomForestClassifier
+    return []
+
+
+def default_regression_models() -> List[Tuple[Predictor, List[Dict]]]:
+    """Reference RegressionModelSelector defaults: LinReg, RF, GBT, GLM
+    + DT in modelsAndParams (RegressionModelSelector.scala:150-193,
+    DistFamily gaussian/poisson)."""
+    from .glm import GeneralizedLinearRegression
+    from .linear import LinearRegression
+    from .trees import (DecisionTreeRegressor, GBTRegressor,
+                        RandomForestRegressor)
     return [
-        (RandomForestClassifier(),
-         [{"max_depth": d, "num_trees": t}
-          for d in (3, 6, 12) for t in (10, 50)]),
-        (DecisionTreeClassifier(),
+        (LinearRegression(max_iter=_MAX_ITER_LIN),
+         [{"reg_param": r, "elastic_net_param": e}
+          for r in _REG for e in _ELASTIC]),
+        (RandomForestRegressor(num_trees=_NUM_TREES, min_info_gain=0.001),
          [{"max_depth": d, "min_instances_per_node": m}
-          for d in (3, 6, 12) for m in (10, 100)]),
-        (NaiveBayes(), [{"smoothing": 1.0}]),
+          for d in _DEPTH for m in _MIN_INST]),
+        (GBTRegressor(num_rounds=_GBT_ROUNDS),
+         [{"max_depth": d, "min_child_weight": float(m)}
+          for d in _DEPTH for m in (1, 10)]),
+        (GeneralizedLinearRegression(),
+         [{"family": f, "reg_param": r}
+          for f in ("gaussian", "poisson") for r in (0.001, 0.01, 0.1)]),
     ]
 
 
 def default_regression_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
-    from .glm import GeneralizedLinearRegression
-    from .trees import (DecisionTreeRegressor, GBTRegressor,
-                        RandomForestRegressor)
+    from .trees import DecisionTreeRegressor, XGBoostRegressor
     return [
-        (RandomForestRegressor(),
-         [{"max_depth": d, "num_trees": t}
-          for d in (3, 6, 12) for t in (10, 50)]),
-        (GBTRegressor(),
-         [{"max_depth": d, "num_rounds": r}
-          for d in (3, 6) for r in (50, 100)]),
-        (DecisionTreeRegressor(),
+        (DecisionTreeRegressor(min_info_gain=0.001),
          [{"max_depth": d, "min_instances_per_node": m}
-          for d in (3, 6, 12) for m in (10, 100)]),
-        (GeneralizedLinearRegression(),
-         [{"family": "gaussian", "reg_param": r} for r in (0.001, 0.01, 0.1)]),
+          for d in _DEPTH for m in _MIN_INST]),
+        (XGBoostRegressor(),
+         [{"max_depth": d, "eta": e} for d in _DEPTH for e in (0.1, 0.3)]),
     ]

@@ -56,34 +56,65 @@ def lbfgs_minimize(loss_fn: Callable, w0, max_iter: int = 100,
     return final_params
 
 
-def _power_iteration_sq_norm(X: jnp.ndarray, iters: int = 16) -> jnp.ndarray:
-    """Largest eigenvalue of X^T X / n (Lipschitz constant scale) via
-    power iteration — static iteration count for XLA."""
+def _power_iteration_sq_norm(X: jnp.ndarray, iters: int = 16,
+                             w: jnp.ndarray | None = None,
+                             axis_name: str | None = None) -> jnp.ndarray:
+    """Largest eigenvalue of X^T diag(w) X / sum(w) (Lipschitz constant
+    scale) via power iteration — static iteration count for XLA. With
+    ``axis_name`` set, X/w are row shards of a mesh data axis and the
+    matvec reductions cross it via psum."""
     n, d = X.shape
     v0 = jnp.ones((d,), X.dtype) / jnp.sqrt(d)
 
+    def psum(x):
+        return jax.lax.psum(x, axis_name) if axis_name else x
+
+    if w is None:
+        wsum = psum(jnp.asarray(float(n), X.dtype))
+
+        def matvec(v):
+            return psum(X.T @ (X @ v)) / wsum
+    else:
+        wsum = jnp.maximum(psum(jnp.sum(w)), 1e-12)
+
+        def matvec(v):
+            return psum(X.T @ (w * (X @ v))) / wsum
+
     def body(_, v):
-        u = X.T @ (X @ v) / n
+        u = matvec(v)       # u is replicated across the data axis
         return u / (jnp.linalg.norm(u) + 1e-12)
 
     v = jax.lax.fori_loop(0, iters, body, v0)
-    return jnp.vdot(v, X.T @ (X @ v) / n)
+    return jnp.vdot(v, matvec(v))
 
 
 def fista_minimize(smooth_loss: Callable, l1: float, w0: jnp.ndarray,
                    lipschitz: jnp.ndarray, max_iter: int = 500,
                    tol: float = 1e-7,
-                   l1_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+                   l1_mask: jnp.ndarray | None = None,
+                   grad_psum_axis: str | None = None) -> jnp.ndarray:
     """FISTA: minimize ``smooth_loss(w) + l1 * ||mask * w||_1``.
 
     ``lipschitz`` bounds the smooth gradient's Lipschitz constant (use
     :func:`_power_iteration_sq_norm` on the design matrix plus the L2
     penalty strength). ``l1_mask`` excludes entries (e.g. the intercept)
     from the penalty.
+
+    Mesh execution (shard_map data axis): pass a SHARD-LOCAL loss plus
+    ``grad_psum_axis`` — the gradient is psum'd explicitly across the
+    axis, so autodiff never has to transpose a collective (which is
+    silently wrong under check_vma=False). ``tol <= 0`` runs EXACTLY
+    ``max_iter`` iterations via ``fori_loop`` — required under a mesh so
+    every shard hits the same collectives in lockstep.
     """
     mask = jnp.ones_like(w0) if l1_mask is None else l1_mask
     step = 1.0 / jnp.maximum(lipschitz, 1e-12)
-    grad_fn = jax.grad(smooth_loss)
+    local_grad = jax.grad(smooth_loss)
+    if grad_psum_axis is None:
+        grad_fn = local_grad
+    else:
+        def grad_fn(w):
+            return jax.lax.psum(local_grad(w), grad_psum_axis)
 
     def prox(w):
         return jnp.where(
@@ -98,19 +129,27 @@ def fista_minimize(smooth_loss: Callable, l1: float, w0: jnp.ndarray,
         delta = jnp.linalg.norm(w_next - w)
         return w_next, z_next, t_next, delta, it + 1
 
+    init = (w0, w0, jnp.asarray(1.0, w0.dtype),
+            jnp.asarray(jnp.inf, w0.dtype), jnp.asarray(0))
+    if tol <= 0:
+        w, *_ = jax.lax.fori_loop(0, max_iter, lambda _, c: body(c), init)
+        return w
+
     def continuing(carry):
         _, _, _, delta, it = carry
         return (it == 0) | ((it < max_iter) & (delta >= tol))
 
-    w, *_ = jax.lax.while_loop(
-        continuing, body,
-        (w0, w0, jnp.asarray(1.0, w0.dtype), jnp.asarray(jnp.inf, w0.dtype),
-         jnp.asarray(0)))
+    w, *_ = jax.lax.while_loop(continuing, body, init)
     return w
 
 
 def design_lipschitz(X: jnp.ndarray, l2: float,
-                     curvature_bound: float = 0.25) -> jnp.ndarray:
-    """Lipschitz bound for losses of the form mean(phi(x.w)) + l2/2 ||w||^2
-    where phi'' <= curvature_bound (0.25 for logistic, 1.0 for squared)."""
-    return curvature_bound * _power_iteration_sq_norm(X) + l2
+                     curvature_bound: float = 0.25,
+                     w: jnp.ndarray | None = None,
+                     axis_name: str | None = None) -> jnp.ndarray:
+    """Lipschitz bound for losses of the form
+    sum(w*phi(x.b))/sum(w) + l2/2 ||b||^2 where phi'' <= curvature_bound
+    (0.25 for logistic, 1.0 for squared). ``w`` are optional row weights
+    (fold masks); ``axis_name`` enables mesh data-axis psum."""
+    return (curvature_bound
+            * _power_iteration_sq_norm(X, w=w, axis_name=axis_name) + l2)

@@ -16,14 +16,17 @@ Design (histogram GBDT, XLA-first — no CUDA/Rabit translation):
 
 - Features are quantile-binned once into <= ``max_bins`` integer bins
   (MLlib ``maxBins``/XGBoost ``tree_method=hist`` equivalent).
-- Trees grow **level-wise over a dense complete binary tree** of static
-  depth: every level computes per-(node, feature, bin) statistic
-  histograms via ``segment_sum`` (a ``lax.scan`` over features keeps
-  memory at O(n*S)), turns them into split gains with one cumulative
-  sum over bins, and advances every row one level. No data-dependent
-  shapes anywhere, so the whole builder jits into one XLA program;
-  a forest is a ``lax.scan`` of that program over bootstrap keys and
-  boosting is a ``lax.scan`` of it over rounds with margin updates.
+- Trees grow **level-wise** with ACTIVE-NODE SLOT COMPRESSION (deep
+  levels of a complete tree are mostly empty; histograms cover only
+  occupied nodes) over PACKED variable-width bins: every level computes
+  per-(slot, packed-bin) statistic histograms via fused ``segment_sum``
+  scatters (chunked over feature blocks to bound memory), turns them
+  into split gains with one segmented cumulative sum over the packed
+  axis, and advances every row one level. No data-dependent shapes
+  anywhere, so the whole builder jits into one XLA program; a forest is
+  a ``lax.scan`` of that program over bootstrap keys (with per-tree
+  feature pools bounding histogram width) and boosting is a
+  ``lax.scan`` of it over rounds with margin updates.
 - Nodes that fail the gain/min-weight checks emit a +inf threshold
   ("everything goes left"), which makes dead branches self-propagating
   without ragged control flow.
@@ -59,90 +62,220 @@ __all__ = [
 
 
 # ---------------------------------------------------------------------------
-# binning
+# binning — packed variable-width bins
 # ---------------------------------------------------------------------------
+#
+# Transmogrified feature matrices are dominated by one-hot columns with
+# only two distinct values; giving every feature a uniform ``max_bins``-
+# wide histogram wastes ~max_bins/2 x HBM traffic on them. Instead each
+# feature gets its own bin count (pow2-quantized so fold-to-fold
+# cardinality jitter doesn't change compiled shapes) and all features'
+# bins are PACKED into one flat axis of ``total_bins`` entries. Per-level
+# histograms are then (slots, total_bins, S) — one fused scatter-add —
+# and split gains come from a single segmented cumulative sum over the
+# packed axis.
 
-@functools.partial(jax.jit, static_argnames=("max_bins",))
-def _quantile_edges(X: jnp.ndarray, max_bins: int) -> jnp.ndarray:
-    """Per-feature quantile cut points, shape (d, B-1). Duplicated edges
-    (constant features) just leave some bins empty."""
-    qs = jnp.linspace(0.0, 1.0, max_bins + 1)[1:-1]
-    return jnp.quantile(X, qs, axis=0).T
 
+class _PackedDesign:
+    """Host-prepared binning of a feature matrix (one per fit).
 
-@jax.jit
-def _bin_matrix(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
-    """bin(x) = #{edges < x} so that bin(x) <= b  <=>  x <= edges[b]."""
-    def col(xc, ec):
-        return jnp.searchsorted(ec, xc, side="left")
-    return jax.vmap(col, in_axes=(1, 0), out_axes=1)(X, edges).astype(jnp.int32)
+    Attributes (n rows, d features, TB = sum of per-feature bin counts):
+      packed    (n, d) int32 — packed bin index of row i, feature f
+                (feature f's block spans [offset_f, offset_f + B_f))
+      feat_of   (TB,) int32 — original feature id per packed bin
+      block_start (TB,) int32 — packed index of the owning block's start
+      packed_thr (TB,) float — split threshold "x <= thr" when splitting
+                at this bin; +inf marks last/padded bins (not a split)
+    """
+
+    __slots__ = ("packed", "feat_of", "block_start", "packed_thr",
+                 "binned", "col_thr", "max_width", "n", "d", "total_bins")
+
+    def __init__(self, X: np.ndarray, max_bins: int):
+        X = np.asarray(X, dtype=np.float64)
+        n, d = X.shape
+        binned_cols, thr_parts, widths = [], [], []
+        for f in range(d):
+            col = X[:, f]
+            uniq = np.unique(col)
+            if uniq.size <= 2:
+                edges = uniq[:1]                     # one edge, two bins
+                width = 2
+            else:
+                width = int(min(max_bins,
+                                1 << int(np.ceil(np.log2(uniq.size)))))
+                width = max(width, 4)
+                qs = np.linspace(0.0, 1.0, width + 1)[1:-1]
+                edges = np.unique(np.quantile(col, qs))
+                if edges.size < width - 1:           # dedup left empty bins
+                    edges = np.concatenate(
+                        [edges, np.full(width - 1 - edges.size, np.inf)])
+            binned_cols.append(
+                np.searchsorted(edges, col, side="left").astype(np.int32))
+            thr_parts.append(np.concatenate([edges, [np.inf]]))
+            widths.append(width)
+        offsets = np.concatenate([[0], np.cumsum(widths)[:-1]]).astype(np.int32)
+        self.n, self.d = n, d
+        self.total_bins = int(np.sum(widths))
+        #: (n, d) per-feature bin ids (uniform addressing for feature-
+        #: pool gathers) and (d, max_width) per-feature thresholds
+        #: (+inf padded = not-a-split)
+        self.binned = np.stack(binned_cols, axis=1)
+        self.max_width = int(max(widths))
+        self.col_thr = np.full((d, self.max_width), np.inf)
+        for f in range(d):
+            t = thr_parts[f]
+            self.col_thr[f, :len(t)] = t
+        self.packed = self.binned + offsets[None, :]
+        self.feat_of = np.repeat(np.arange(d, dtype=np.int32), widths)
+        self.block_start = np.repeat(offsets, widths)
+        self.packed_thr = np.concatenate(thr_parts)
 
 
 # ---------------------------------------------------------------------------
 # generic level-wise tree builder
 # ---------------------------------------------------------------------------
 
-def _level_histograms(binned_T: jnp.ndarray, node: jnp.ndarray,
-                      stats: jnp.ndarray, num_nodes: int,
-                      max_bins: int) -> jnp.ndarray:
-    """(d, num_nodes, B, S) histograms; scan over features bounds memory."""
-    def per_feat(_, bcol):
-        seg = node * max_bins + bcol
-        h = jax.ops.segment_sum(stats, seg,
-                                num_segments=num_nodes * max_bins)
-        return None, h.reshape(num_nodes, max_bins, -1)
-    _, hists = jax.lax.scan(per_feat, None, binned_T)
-    return hists
+def _compress_nodes(node: jnp.ndarray, cap: int):
+    """Rank-compress true node ids (n,) into dense slots [0, cap).
+
+    Deep levels of a level-wise tree are mostly empty (at most ``n`` of
+    the ``2^level`` nodes can hold rows, and min-instances constraints
+    shrink that further), so histograms/gains are computed per *active
+    slot*, not per node. Sort-based ranking is O(n log n), all static
+    shapes. Returns (slot_per_row (n,), node_of_slot (cap,) int32 with
+    ``_SLOT_SENTINEL`` for unused slots, active_count scalar).
+    """
+    snode, order = jax.lax.sort_key_val(node, jnp.arange(node.shape[0],
+                                                         dtype=jnp.int32))
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (snode[1:] != snode[:-1]).astype(jnp.int32)])
+    rank = jnp.cumsum(is_new) - 1                       # slot of sorted rows
+    slot = jnp.zeros_like(node).at[order].set(rank.astype(node.dtype))
+    node_of_slot = jnp.full((cap,), _SLOT_SENTINEL, jnp.int32).at[
+        rank].set(snode.astype(jnp.int32), mode="drop")
+    return slot, node_of_slot, rank[-1] + 1
 
 
-def _grow_tree(binned: jnp.ndarray, stats: jnp.ndarray, edges: jnp.ndarray,
-               *, depth: int, max_bins: int, gain_fn, min_info_gain: float,
+_SLOT_SENTINEL = jnp.iinfo(jnp.int32).max
+
+#: default per-level active-node slot cap (see _grow_tree docstring)
+_DEFAULT_NODE_CAP = 256
+
+
+#: cap on the (rows x features x stats) scatter-input materialized per
+#: histogram call; larger designs chunk over feature blocks (the memory
+#: bound the pre-packed per-feature scan used to provide)
+_HIST_CHUNK_ELEMS = 32_000_000
+
+
+def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
+                      stats: jnp.ndarray, num_slots: int,
+                      total_bins: int) -> jnp.ndarray:
+    """(num_slots, total_bins, S) histograms via fused scatter-adds over
+    feature blocks (segment id = slot*TB + packed bin) — no serial
+    per-feature scan; on TPU each block is one large segment_sum that
+    XLA lowers to a vectorized scatter. Blocks bound the broadcasted
+    (n x d_block x S) scatter input to ~_HIST_CHUNK_ELEMS elements so
+    wide matrices don't materialize an O(n*d) stats tensor at once."""
+    n, d = packed.shape
+    s_dim = stats.shape[1]
+    n_chunks = max(1, -(- (n * d * s_dim) // _HIST_CHUNK_ELEMS))
+    step = -(-d // n_chunks)
+    segs = num_slots * total_bins
+    out = None
+    for lo in range(0, d, step):
+        blk = packed[:, lo:lo + step]
+        db = blk.shape[1]
+        seg = slot[:, None] * total_bins + blk
+        part = jax.ops.segment_sum(
+            jnp.broadcast_to(stats[:, None, :], (n, db, s_dim)
+                             ).reshape(n * db, s_dim),
+            seg.reshape(-1), num_segments=segs)
+        out = part if out is None else out + part
+    return out.reshape(num_slots, total_bins, s_dim)
+
+
+def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
+               block_start: jnp.ndarray, packed_thr: jnp.ndarray,
+               stats: jnp.ndarray, *, depth: int, gain_fn,
+               min_info_gain: float,
                feat_key: Optional[jnp.ndarray] = None,
-               max_features: Optional[int] = None):
-    """Grow one complete tree of static ``depth``.
+               max_features: Optional[int] = None,
+               node_cap: Optional[int] = None,
+               feat_map: Optional[jnp.ndarray] = None):
+    """Grow one complete tree of static ``depth`` over a packed binned
+    design (see :class:`_PackedDesign`).
 
     gain_fn(left, right, total) -> (..., ) gains with -inf where a split
-    is invalid; ``left/right/total`` are stat tensors with trailing dim S.
+    is invalid; ``left/right`` are (C, TB, S) and ``total`` (C, 1, S).
+
+    ``node_cap`` bounds the per-level active-node slot count (default
+    ``_DEFAULT_NODE_CAP``, further clamped by the row count — a node
+    with no rows is never split). If a level would overflow the cap,
+    the highest-numbered nodes are denied splits (budget mask below) so
+    the bound stays sound — the analogue of MLlib's maxMemoryInMB
+    node-batch limiting. With default min-instances grids (>= 10) the
+    cap never binds; it only limits very deep unregularized trees.
 
     Returns (feat_heap (2^depth - 1,), thr_heap (2^depth - 1,),
     leaf_stats (2^depth, S), final node assignment (n,)).
     """
-    n, d = binned.shape
-    binned_T = binned.T
+    n, d = packed.shape
+    TB = feat_of.shape[0]
+    cap = min(n, _DEFAULT_NODE_CAP if node_cap is None else node_cap)
     node = jnp.zeros((n,), jnp.int32)
-    feats_levels, thr_levels = [], []
+    heap_len = max(2 ** depth - 1, 1)
+    feat_heap = jnp.zeros((heap_len,), jnp.int32)[:2 ** depth - 1]
+    thr_heap = jnp.full((heap_len,), jnp.inf, stats.dtype)[:2 ** depth - 1]
+    not_a_split = ~jnp.isfinite(packed_thr)     # last + padded bins
     key = feat_key
     for level in range(depth):
-        num_nodes = 2 ** level
-        hist = _level_histograms(binned_T, node, stats, num_nodes, max_bins)
-        hist = jnp.moveaxis(hist, 0, 1)          # (nodes, d, B, S)
-        left = jnp.cumsum(hist, axis=2)           # split at b: bins<=b left
-        total = left[:, 0:1, -1:, :]              # (nodes,1,1,S)
+        C = min(2 ** level, cap)                   # static slots this level
+        slot, node_of_slot, active = _compress_nodes(node, C)
+        hist = _level_histograms(packed, slot, stats, C, TB)
+        cs = jnp.cumsum(hist, axis=1)              # packed-axis running sum
+        # per-feature segmented cumsum: subtract the running sum at the
+        # owning block's start; splitting at bin b sends bins<=b left
+        base = jnp.where((block_start > 0)[None, :, None],
+                         cs[:, jnp.maximum(block_start - 1, 0), :], 0.0)
+        left = cs - base
+        total = jax.ops.segment_sum(stats, slot, num_segments=C)[:, None, :]
         right = total - left
-        gain = gain_fn(left, right, total)        # (nodes, d, B)
-        # the last bin puts everything left — not a split
-        gain = gain.at[:, :, -1].set(-jnp.inf)
+        gain = gain_fn(left, right, total)         # (C, TB)
+        gain = jnp.where(not_a_split[None, :], -jnp.inf, gain)
         if max_features is not None and max_features < d:
             key, sub = jax.random.split(key)
-            u = jax.random.uniform(sub, (num_nodes, d))
+            u = jax.random.uniform(sub, (C, d))
             kth = jnp.sort(u, axis=1)[:, max_features - 1:max_features]
-            gain = jnp.where((u <= kth)[:, :, None], gain, -jnp.inf)
-        flat = gain.reshape(num_nodes, d * max_bins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bfeat = (best // max_bins).astype(jnp.int32)
-        bbin = (best % max_bins).astype(jnp.int32)
+            gain = jnp.where((u <= kth)[:, feat_of], gain, -jnp.inf)
+        best = jnp.argmax(gain, axis=1)            # (C,) packed bin index
+        best_gain = jnp.take_along_axis(gain, best[:, None], axis=1)[:, 0]
         split_ok = best_gain >= jnp.maximum(min_info_gain, 1e-12)
-        bfeat = jnp.where(split_ok, bfeat, 0)
-        bbin = jnp.where(split_ok, bbin, max_bins - 1)
-        thr = jnp.where(bbin >= max_bins - 1, jnp.inf, edges[bfeat, jnp.minimum(bbin, max_bins - 2)])
-        feats_levels.append(bfeat)
-        thr_levels.append(thr)
-        go_left = binned[jnp.arange(n), bfeat[node]] <= bbin[node]
+        if level + 1 < depth:
+            # budget mask: next level holds at most min(2^(level+1), cap)
+            # slots; each split adds one net node, so only the first
+            # (budget - active) slots may split. Binds only near capacity.
+            budget = min(2 ** (level + 1), cap)
+            split_ok &= jnp.arange(C) < (budget - active)
+        bfeat = jnp.where(split_ok, feat_of[best], 0)
+        thr = jnp.where(split_ok, packed_thr[best], jnp.inf)
+        heap_pos = jnp.where(node_of_slot == _SLOT_SENTINEL,
+                             _SLOT_SENTINEL, 2 ** level - 1 + node_of_slot)
+        # feat_map translates design-local feature ids (e.g. a per-tree
+        # feature pool) back to ORIGINAL column ids for the heap
+        heap_feat = (bfeat if feat_map is None
+                     else jnp.where(split_ok, feat_map[bfeat], 0))
+        feat_heap = feat_heap.at[heap_pos].set(heap_feat, mode="drop")
+        thr_heap = thr_heap.at[heap_pos].set(thr.astype(thr_heap.dtype),
+                                             mode="drop")
+        # route rows: packed[i, f*] <= best_packed  <=>  bin <= b; a
+        # denied split routes everything left via the TB sentinel
+        best_r = jnp.where(split_ok, best, TB)
+        go_left = packed[jnp.arange(n), bfeat[slot]] <= best_r[slot]
         node = 2 * node + (1 - go_left.astype(jnp.int32))  # within-level idx
     leaf_stats = jax.ops.segment_sum(stats, node, num_segments=2 ** depth)
-    feat_heap = jnp.concatenate(feats_levels) if depth else jnp.zeros((0,), jnp.int32)
-    thr_heap = jnp.concatenate(thr_levels) if depth else jnp.zeros((0,))
     return feat_heap, thr_heap, leaf_stats, node
 
 
@@ -228,32 +361,61 @@ def _variance_gain(min_instances: float):
 # jitted fit programs
 # ---------------------------------------------------------------------------
 
+def _tree_pool(pkey, binned, col_thr, pool_size: int):
+    """Per-tree feature pool: gather ``pool_size`` random columns into a
+    uniform-width packed sub-design. Histogram/scatter work then scales
+    with the pool, not the full feature count — per-node max_features
+    sampling applies WITHIN the pool (documented deviation from MLlib's
+    per-node-over-all-features sampling; across a 50-tree forest the
+    pools cover the full feature set many times over)."""
+    d = binned.shape[1]
+    maxB = col_thr.shape[1]
+    pool = jax.random.choice(pkey, d, (pool_size,), replace=False)
+    offs = jnp.arange(pool_size, dtype=jnp.int32) * maxB
+    packed_sub = jnp.take(binned, pool, axis=1) + offs[None, :]
+    thr_sub = col_thr[pool].reshape(pool_size * maxB)
+    feat_of_sub = jnp.repeat(jnp.arange(pool_size, dtype=jnp.int32), maxB)
+    block_start_sub = jnp.repeat(offs, maxB)
+    return pool, packed_sub, feat_of_sub, block_start_sub, thr_sub
+
+
 @functools.partial(
-    jax.jit, static_argnames=("depth", "max_bins", "num_classes", "num_trees",
-                              "max_features", "impurity", "bootstrap"))
-def _fit_forest_classifier(X, y, key, *, depth: int, max_bins: int,
-                           num_classes: int, num_trees: int,
-                           max_features: Optional[int], impurity: str,
+    jax.jit, static_argnames=("depth", "num_classes", "num_trees",
+                              "max_features", "pool_size", "impurity",
+                              "bootstrap"))
+def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
+                           binned, col_thr, y, key,
+                           *, depth: int, num_classes: int, num_trees: int,
+                           max_features: Optional[int],
+                           pool_size: Optional[int], impurity: str,
                            min_instances: float, min_info_gain: float,
                            subsample: float, bootstrap: bool):
-    n, d = X.shape
-    edges = _quantile_edges(X, max_bins)
-    binned = _bin_matrix(X, edges)
-    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=X.dtype)
+    n, d = packed.shape
+    dtype = packed_thr.dtype
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=dtype)
     gain_fn = (_gini_gain(min_instances) if impurity == "gini"
                else _entropy_gain(min_instances))
 
     def one_tree(carry, tkey):
-        wkey, fkey = jax.random.split(tkey)
+        pkey, wkey, fkey = jax.random.split(tkey, 3)
         if bootstrap:
-            w = jax.random.poisson(wkey, subsample, (n,)).astype(X.dtype)
+            w = jax.random.poisson(wkey, subsample, (n,)).astype(dtype)
         else:
-            w = jnp.ones((n,), X.dtype)
-        feat, thr, leaf_stats, _ = _grow_tree(
-            binned, onehot * w[:, None], edges, depth=depth,
-            max_bins=max_bins, gain_fn=gain_fn,
-            min_info_gain=min_info_gain, feat_key=fkey,
-            max_features=max_features)
+            w = jnp.ones((n,), dtype)
+        if pool_size is not None and pool_size < d:
+            pool, p_sub, fo_sub, bs_sub, thr_sub = _tree_pool(
+                pkey, binned, col_thr, pool_size)
+            feat, thr, leaf_stats, _ = _grow_tree(
+                p_sub, fo_sub, bs_sub, thr_sub,
+                onehot * w[:, None], depth=depth, gain_fn=gain_fn,
+                min_info_gain=min_info_gain, feat_key=fkey,
+                max_features=max_features, feat_map=pool)
+        else:
+            feat, thr, leaf_stats, _ = _grow_tree(
+                packed, feat_of, block_start, packed_thr,
+                onehot * w[:, None], depth=depth, gain_fn=gain_fn,
+                min_info_gain=min_info_gain, feat_key=fkey,
+                max_features=max_features)
         lw = jnp.sum(leaf_stats, axis=-1, keepdims=True)
         probs = jnp.where(lw > 0, leaf_stats / jnp.maximum(lw, 1e-12),
                           1.0 / num_classes)
@@ -264,28 +426,38 @@ def _fit_forest_classifier(X, y, key, *, depth: int, max_bins: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("depth", "max_bins", "num_trees",
-                              "max_features", "bootstrap"))
-def _fit_forest_regressor(X, y, key, *, depth: int, max_bins: int,
-                          num_trees: int, max_features: Optional[int],
+    jax.jit, static_argnames=("depth", "num_trees", "max_features",
+                              "pool_size", "bootstrap"))
+def _fit_forest_regressor(packed, feat_of, block_start, packed_thr,
+                          binned, col_thr, y, key,
+                          *, depth: int, num_trees: int,
+                          max_features: Optional[int],
+                          pool_size: Optional[int],
                           min_instances: float, min_info_gain: float,
                           subsample: float, bootstrap: bool):
-    n, d = X.shape
-    edges = _quantile_edges(X, max_bins)
-    binned = _bin_matrix(X, edges)
+    n, d = packed.shape
+    dtype = packed_thr.dtype
     gain_fn = _variance_gain(min_instances)
 
     def one_tree(carry, tkey):
-        wkey, fkey = jax.random.split(tkey)
+        pkey, wkey, fkey = jax.random.split(tkey, 3)
         if bootstrap:
-            w = jax.random.poisson(wkey, subsample, (n,)).astype(X.dtype)
+            w = jax.random.poisson(wkey, subsample, (n,)).astype(dtype)
         else:
-            w = jnp.ones((n,), X.dtype)
+            w = jnp.ones((n,), dtype)
         stats = jnp.stack([w, w * y, w * y * y], axis=1)
-        feat, thr, leaf_stats, _ = _grow_tree(
-            binned, stats, edges, depth=depth, max_bins=max_bins,
-            gain_fn=gain_fn, min_info_gain=min_info_gain, feat_key=fkey,
-            max_features=max_features)
+        if pool_size is not None and pool_size < d:
+            pool, p_sub, fo_sub, bs_sub, thr_sub = _tree_pool(
+                pkey, binned, col_thr, pool_size)
+            feat, thr, leaf_stats, _ = _grow_tree(
+                p_sub, fo_sub, bs_sub, thr_sub, stats, depth=depth,
+                gain_fn=gain_fn, min_info_gain=min_info_gain, feat_key=fkey,
+                max_features=max_features, feat_map=pool)
+        else:
+            feat, thr, leaf_stats, _ = _grow_tree(
+                packed, feat_of, block_start, packed_thr, stats, depth=depth,
+                gain_fn=gain_fn, min_info_gain=min_info_gain, feat_key=fkey,
+                max_features=max_features)
         vals = leaf_stats[:, 1] / jnp.maximum(leaf_stats[:, 0], 1e-12)
         return carry, (feat, thr, vals)
     _, (feats, thrs, leaves) = jax.lax.scan(
@@ -294,21 +466,21 @@ def _fit_forest_regressor(X, y, key, *, depth: int, max_bins: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("depth", "max_bins", "num_rounds", "objective",
+    jax.jit, static_argnames=("depth", "num_rounds", "objective",
                               "subsample"))
-def _fit_gbt(X, y, key, *, depth: int, max_bins: int, num_rounds: int,
-             step_size: float, reg_lambda: float, gamma: float,
-             min_child_weight: float, subsample: float, objective: str):
-    n, d = X.shape
-    edges = _quantile_edges(X, max_bins)
-    binned = _bin_matrix(X, edges)
+def _fit_gbt(packed, feat_of, block_start, packed_thr, y, key, *, depth: int,
+             num_rounds: int, step_size: float, reg_lambda: float,
+             gamma: float, min_child_weight: float, subsample: float,
+             objective: str):
+    n, d = packed.shape
+    dtype = packed_thr.dtype
     gain_fn = _xgb_gain(reg_lambda, gamma, min_child_weight)
     if objective == "logistic":
         p0 = jnp.clip(jnp.mean(y), 1e-6, 1 - 1e-6)
         base = jnp.log(p0 / (1 - p0))
     else:
         base = jnp.mean(y)
-    margins0 = jnp.full((n,), base, X.dtype)
+    margins0 = jnp.full((n,), base, dtype)
 
     def one_round(carry, rkey):
         margins = carry
@@ -318,11 +490,12 @@ def _fit_gbt(X, y, key, *, depth: int, max_bins: int, num_rounds: int,
         else:
             g, h = margins - y, jnp.ones_like(y)
         if subsample < 1.0:
-            m = jax.random.bernoulli(rkey, subsample, (n,)).astype(X.dtype)
+            m = jax.random.bernoulli(rkey, subsample, (n,)).astype(dtype)
             g, h = g * m, h * m
         feat, thr, leaf_stats, node = _grow_tree(
-            binned, jnp.stack([g, h], axis=1), edges, depth=depth,
-            max_bins=max_bins, gain_fn=gain_fn, min_info_gain=0.0)
+            packed, feat_of, block_start, packed_thr,
+            jnp.stack([g, h], axis=1), depth=depth,
+            gain_fn=gain_fn, min_info_gain=0.0)
         vals = -step_size * leaf_stats[:, 0] / (leaf_stats[:, 1] + reg_lambda)
         vals = jnp.where(jnp.sum(jnp.abs(leaf_stats), axis=1) > 0, vals, 0.0)
         margins = margins + vals[node]
@@ -483,6 +656,23 @@ def _resolve_max_features(strategy: str, d: int, classification: bool
     return max(1, min(d, int(float(s) * d) if "." in s else int(s)))
 
 
+def _design_args(X: np.ndarray, max_bins: int):
+    """Host-bin X and return the device-ready design arrays:
+    (packed, feat_of, block_start, packed_thr, binned, col_thr)."""
+    design = _PackedDesign(X, max_bins)
+    return (jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+            jnp.asarray(design.block_start), jnp.asarray(design.packed_thr),
+            jnp.asarray(design.binned), jnp.asarray(design.col_thr))
+
+
+def _pool_size(d: int, mf: Optional[int]) -> Optional[int]:
+    """Per-tree feature-pool size: 4x the per-node sample (floored at 8)
+    keeps per-node choice diversity while bounding histogram work."""
+    if mf is None or mf >= d:
+        return None
+    return min(d, max(4 * mf, 8))
+
+
 class _ForestClassifierBase(Predictor):
     num_trees = 1
     bootstrap = False
@@ -494,11 +684,10 @@ class _ForestClassifierBase(Predictor):
         mf = _resolve_max_features(self.feature_subset_strategy, d, True) \
             if self.bootstrap else None
         feats, thrs, leaves = _fit_forest_classifier(
-            jnp.asarray(X), jnp.asarray(y),
+            *_design_args(X, self.max_bins), jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
-            max_bins=self.max_bins, num_classes=k,
-            num_trees=self.num_trees, max_features=mf,
-            impurity=self.impurity,
+            num_classes=k, num_trees=self.num_trees, max_features=mf,
+            pool_size=_pool_size(d, mf), impurity=self.impurity,
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap)
@@ -517,10 +706,10 @@ class _ForestRegressorBase(Predictor):
         mf = _resolve_max_features(self.feature_subset_strategy, d, False) \
             if self.bootstrap else None
         feats, thrs, leaves = _fit_forest_regressor(
-            jnp.asarray(X), jnp.asarray(y),
+            *_design_args(X, self.max_bins), jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
-            max_bins=self.max_bins, num_trees=self.num_trees,
-            max_features=mf,
+            num_trees=self.num_trees, max_features=mf,
+            pool_size=_pool_size(d, mf),
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap)
@@ -643,9 +832,9 @@ class GBTClassifier(Predictor):
                 f"{bad.tolist()} — use RandomForestClassifier or "
                 f"LogisticRegression for multiclass")
         feats, thrs, leaves, base = _fit_gbt(
-            jnp.asarray(X), jnp.asarray(y),
+            *_design_args(X, self.max_bins)[:4], jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
-            max_bins=self.max_bins, num_rounds=self.num_rounds,
+            num_rounds=self.num_rounds,
             step_size=self.step_size, reg_lambda=self.reg_lambda,
             gamma=self.gamma, min_child_weight=self.min_child_weight,
             subsample=self.subsample, objective="logistic")
@@ -675,9 +864,9 @@ class GBTRegressor(Predictor):
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTRegressorModel:
         feats, thrs, leaves, base = _fit_gbt(
-            jnp.asarray(X), jnp.asarray(y),
+            *_design_args(X, self.max_bins)[:4], jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
-            max_bins=self.max_bins, num_rounds=self.num_rounds,
+            num_rounds=self.num_rounds,
             step_size=self.step_size, reg_lambda=self.reg_lambda,
             gamma=self.gamma, min_child_weight=self.min_child_weight,
             subsample=self.subsample, objective="squared")

@@ -20,9 +20,12 @@ from .dsl import (AliasTransformer, FillMissingWithMean,
 from .geo import GeolocationVectorizer, GeolocationVectorizerModel
 from .index import (IndexToString, PredictionDeIndexer, StringIndexer,
                     StringIndexerModel)
-from .maps import (BinaryMapVectorizer, GeolocationMapVectorizer,
+from .maps import (BinaryMapVectorizer, DateMapToUnitCircleVectorizer,
+                   DateMapToUnitCircleVectorizerModel,
+                   GeolocationMapVectorizer,
                    GeolocationMapVectorizerModel, MultiPickListMapVectorizer,
                    RealMapVectorizer, RealMapVectorizerModel,
+                   SmartTextMapVectorizer, SmartTextMapVectorizerModel,
                    TextMapPivotVectorizer, TextMapPivotVectorizerModel)
 from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
                       RealVectorizerModel)

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..features.columns import FeatureColumn
 from ..stages.base import SequenceEstimator, SequenceModel
-from ..types import (BinaryMap, GeolocationMap, MultiPickListMap,
+from ..types import (BinaryMap, DateMap, GeolocationMap, MultiPickListMap,
                      NumericMap, OPMap, OPVector, TextMap)
 from .vector_utils import (NULL_INDICATOR, OTHER_INDICATOR,
                            VectorColumnMetadata, vector_output)
@@ -27,7 +27,10 @@ from .vector_utils import (NULL_INDICATOR, OTHER_INDICATOR,
 __all__ = ["RealMapVectorizer", "RealMapVectorizerModel",
            "BinaryMapVectorizer", "TextMapPivotVectorizer",
            "TextMapPivotVectorizerModel", "MultiPickListMapVectorizer",
-           "GeolocationMapVectorizer", "GeolocationMapVectorizerModel"]
+           "GeolocationMapVectorizer", "GeolocationMapVectorizerModel",
+           "SmartTextMapVectorizer", "SmartTextMapVectorizerModel",
+           "DateMapToUnitCircleVectorizer",
+           "DateMapToUnitCircleVectorizerModel"]
 
 
 def _sorted_keys(cols: List[FeatureColumn],
@@ -357,3 +360,184 @@ class GeolocationMapVectorizer(SequenceEstimator):
             fills.append(per_key)
         return GeolocationMapVectorizerModel(
             keys=keys, fill_values=fills, track_nulls=self.track_nulls)
+
+
+class SmartTextMapVectorizerModel(SequenceModel):
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, keys: List[List[str]],
+                 strategies: List[Dict[str, tuple]],
+                 num_hashes: int = 512, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="smartTxtMapVec", uid=uid)
+        self.keys = [list(k) for k in keys]
+        #: per feature: {key: ("pivot", [categories]) | ("hash", None)}
+        self.strategies = [{k: tuple(v) for k, v in s.items()}
+                           for s in strategies]
+        self.num_hashes = num_hashes
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        from .text import _hash_block
+        blocks, metas = [], []
+        for f, col, keys, strat in zip(self.input_features, cols,
+                                       self.keys, self.strategies):
+            n = col.n_rows
+            for k in keys:
+                kind, cats = strat.get(k, ("hash", None))
+                vals = [(m.get(k) if m else None) for m in col.data]
+                if kind == "pivot":
+                    levels = list(cats or [])
+                    width = len(levels) + 1 + (1 if self.track_nulls else 0)
+                    block = np.zeros((n, width))
+                    index = {c: i for i, c in enumerate(levels)}
+                    for i, v in enumerate(vals):
+                        if v is None:
+                            if self.track_nulls:
+                                block[i, len(levels) + 1] = 1.0
+                        else:
+                            j = index.get(str(v))
+                            block[i, j if j is not None else len(levels)] \
+                                = 1.0
+                    blocks.append(block)
+                    for c in levels:
+                        metas.append(VectorColumnMetadata(
+                            parent_feature_name=f.name,
+                            parent_feature_type=f.ftype.__name__,
+                            grouping=k, indicator_value=c))
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, indicator_value=OTHER_INDICATOR))
+                    if self.track_nulls:
+                        metas.append(VectorColumnMetadata(
+                            parent_feature_name=f.name,
+                            parent_feature_type=f.ftype.__name__,
+                            grouping=k, indicator_value=NULL_INDICATOR))
+                else:
+                    blocks.append(_hash_block(vals, self.num_hashes,
+                                              self.track_nulls))
+                    metas.extend(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__,
+                        grouping=k, descriptor_value=f"hash_{j}")
+                        for j in range(self.num_hashes))
+                    if self.track_nulls:
+                        metas.append(VectorColumnMetadata(
+                            parent_feature_name=f.name,
+                            parent_feature_type=f.ftype.__name__,
+                            grouping=k, indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class SmartTextMapVectorizer(SequenceEstimator):
+    """Per-KEY pivot-or-hash decision for text maps (reference
+    SmartTextMapVectorizer.scala): a key whose value cardinality stays
+    within ``max_cardinality`` pivots into top-K one-hot columns, a
+    free-text key falls back to the hashing trick — the map analogue of
+    SmartTextVectorizer's per-feature decision."""
+
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, max_cardinality: int = 30, top_k: int = 20,
+                 min_support: int = 10, num_hashes: int = 512,
+                 track_nulls: bool = True,
+                 allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="smartTxtMapVec", uid=uid)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_hashes = num_hashes
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> SmartTextMapVectorizerModel:
+        from .categorical import _top_categories
+        keys = _sorted_keys(cols, self.allow_keys)
+        strategies = []
+        for col, ks in zip(cols, keys):
+            per_key: Dict[str, tuple] = {}
+            for k in ks:
+                counts: Dict[str, int] = {}
+                for m in col.data:
+                    v = m.get(k) if m else None
+                    if v is not None:
+                        counts[str(v)] = counts.get(str(v), 0) + 1
+                if len(counts) <= self.max_cardinality:
+                    per_key[k] = ("pivot", _top_categories(
+                        counts, self.top_k, self.min_support))
+                else:
+                    per_key[k] = ("hash", None)
+            strategies.append(per_key)
+        return SmartTextMapVectorizerModel(
+            keys=keys, strategies=strategies, num_hashes=self.num_hashes,
+            track_nulls=self.track_nulls)
+
+
+class DateMapToUnitCircleVectorizerModel(SequenceModel):
+    input_types = (DateMap,)
+    output_type = OPVector
+
+    def __init__(self, keys: List[List[str]],
+                 time_period: str = "HourOfDay",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="dateMapToUnitCircle", uid=uid)
+        self.keys = [list(k) for k in keys]
+        self.time_period = time_period
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        from .date import TIME_PERIODS
+        phase_fn = TIME_PERIODS[self.time_period]
+        blocks, metas = [], []
+        for f, col, keys in zip(self.input_features, cols, self.keys):
+            n = col.n_rows
+            for k in keys:
+                vals = np.full(n, np.nan)
+                for i, m in enumerate(col.data):
+                    if m and k in m and m[k] is not None:
+                        vals[i] = float(m[k])
+                ok = ~np.isnan(vals)
+                ms = np.where(ok, vals, 0.0).astype(np.int64)
+                phase = 2.0 * np.pi * np.asarray(phase_fn(ms),
+                                                 dtype=np.float64)
+                block = np.zeros((n, 2))
+                block[:, 0] = np.where(ok, np.sin(phase), 0.0)
+                block[:, 1] = np.where(ok, np.cos(phase), 0.0)
+                blocks.append(block)
+                for trig in ("sin", "cos"):
+                    metas.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.ftype.__name__, grouping=k,
+                        descriptor_value=f"{trig}_{self.time_period}"))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class DateMapToUnitCircleVectorizer(SequenceEstimator):
+    """Date maps -> per-key [sin, cos] of the chosen time period
+    (reference DateMapToUnitCircleVectorizer.scala); missing -> (0, 0),
+    the circle's center — equidistant from every phase."""
+
+    input_types = (DateMap,)
+    output_type = OPVector
+
+    def __init__(self, time_period: str = "HourOfDay",
+                 allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="dateMapToUnitCircle", uid=uid)
+        from .date import TIME_PERIODS
+        if time_period not in TIME_PERIODS:
+            raise ValueError(
+                f"Unknown time period {time_period!r}; "
+                f"choose from {sorted(TIME_PERIODS)}")
+        self.time_period = time_period
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> DateMapToUnitCircleVectorizerModel:
+        return DateMapToUnitCircleVectorizerModel(
+            keys=_sorted_keys(cols, self.allow_keys),
+            time_period=self.time_period)

@@ -13,7 +13,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Sequence, Type
 
 from ..features.feature import Feature
-from ..types import (Binary, BinaryMap, Date, DateList, DateTime,
+from ..types import (Binary, BinaryMap, Date, DateList, DateMap, DateTime,
                      FeatureType, Geolocation, GeolocationMap, Integral,
                      MultiPickList, MultiPickListMap, OPMap, OPSet,
                      OPVector, Real, Text, TextList, TextMap)
@@ -21,8 +21,9 @@ from .categorical import MultiPickListVectorizer, OneHotVectorizer
 from .combiner import VectorsCombiner
 from .date import DateListVectorizer, DateToUnitCircleVectorizer
 from .geo import GeolocationVectorizer
-from .maps import (BinaryMapVectorizer, GeolocationMapVectorizer,
-                   MultiPickListMapVectorizer, RealMapVectorizer,
+from .maps import (BinaryMapVectorizer, DateMapToUnitCircleVectorizer,
+                   GeolocationMapVectorizer, MultiPickListMapVectorizer,
+                   RealMapVectorizer, SmartTextMapVectorizer,
                    TextMapPivotVectorizer)
 from .numeric import BinaryVectorizer, IntegralVectorizer, RealVectorizer
 from .text import SmartTextVectorizer, TextHashVectorizer
@@ -90,10 +91,21 @@ def _dispatch_group(ftype: Type[FeatureType],
     if issubclass(ftype, BinaryMap):
         return BinaryMapVectorizer(track_nulls=defaults.track_nulls)
     if issubclass(ftype, TextMap):
-        return TextMapPivotVectorizer(
+        # categorical map subtypes pivot directly; free-text maps get the
+        # per-key pivot-or-hash decision (SmartTextMapVectorizer.scala)
+        if ftype.__name__.replace("Map", "") in _PIVOT_TEXT_NAMES:
+            return TextMapPivotVectorizer(
+                top_k=defaults.top_k, min_support=defaults.min_support,
+                track_nulls=defaults.track_nulls)
+        return SmartTextMapVectorizer(
+            max_cardinality=defaults.max_cardinality,
             top_k=defaults.top_k, min_support=defaults.min_support,
+            num_hashes=defaults.num_hashes,
             track_nulls=defaults.track_nulls)
-    if issubclass(ftype, OPMap):  # numeric/integral/date maps
+    if issubclass(ftype, DateMap):  # before the numeric-map catch-all
+        return DateMapToUnitCircleVectorizer(
+            time_period=defaults.date_time_period)
+    if issubclass(ftype, OPMap):  # numeric/integral maps
         return RealMapVectorizer(track_nulls=defaults.track_nulls)
     raise TypeError(
         f"transmogrify: no default vectorizer for {ftype.__name__}")

@@ -14,8 +14,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Type
 
 from ..evaluators import (BinaryClassificationEvaluator, Evaluator,
                           MultiClassificationEvaluator, RegressionEvaluator)
-from ..models import (LinearRegression, LinearSVC, LogisticRegression,
-                      Predictor)
+from ..models import Predictor
 from .selector import ModelSelector
 from .splitters import DataBalancer, DataCutter, DataSplitter, Splitter
 from .validator import CrossValidation, TrainValidationSplit
@@ -25,47 +24,48 @@ __all__ = ["BinaryClassificationModelSelector",
 
 
 def _default_binary_models() -> List[Tuple[Predictor, List[Dict]]]:
-    """(reference BinaryClassificationModelSelector defaults :68-128;
-    grids follow DefaultSelectorParams)"""
+    """(reference defaultModelsToUse = LR/RF/GBT/SVC,
+    BinaryClassificationModelSelector.scala:57-60; grids follow
+    DefaultSelectorParams — see models/registry.py)"""
     from ..models import registry
-    models: List[Tuple[Predictor, List[Dict]]] = [
-        (LogisticRegression(),
-         [{"reg_param": r, "elastic_net_param": e}
-          for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
-        (LinearSVC(), [{"reg_param": r} for r in (0.01, 0.1)]),
-    ]
-    models.extend(registry.default_binary_extra_models())
-    return models
+    return registry.default_binary_models()
+
+
+def _binary_opt_in_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from ..models import registry
+    return registry.default_binary_extra_models()
 
 
 def _default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
     from ..models import registry
-    models: List[Tuple[Predictor, List[Dict]]] = [
-        (LogisticRegression(),
-         [{"reg_param": r, "elastic_net_param": e}
-          for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]),
-    ]
-    models.extend(registry.default_multiclass_extra_models())
-    return models
+    return registry.default_multiclass_models()
+
+
+def _multiclass_opt_in_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from ..models import registry
+    return registry.default_multiclass_extra_models()
 
 
 def _default_regression_models() -> List[Tuple[Predictor, List[Dict]]]:
     from ..models import registry
-    models: List[Tuple[Predictor, List[Dict]]] = [
-        (LinearRegression(),
-         [{"reg_param": r, "elastic_net_param": e}
-          for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]),
-    ]
-    models.extend(registry.default_regression_extra_models())
-    return models
+    return registry.default_regression_models()
 
 
-def _filter_models(models, model_types_to_use):
+def _regression_opt_in_models() -> List[Tuple[Predictor, List[Dict]]]:
+    from ..models import registry
+    return registry.default_regression_extra_models()
+
+
+def _filter_models(models, opt_in_models, model_types_to_use):
+    """No filter -> the default pool; with ``model_types_to_use`` pick
+    from default + opt-in families (reference modelTypesToUse selects
+    among the full modelsAndParams set)."""
     if model_types_to_use is None:
         return models
     allowed = {t.__name__ if isinstance(t, type) else str(t)
                for t in model_types_to_use}
-    kept = [(est, grid) for est, grid in models
+    pool = list(models) + list(opt_in_models)
+    kept = [(est, grid) for est, grid in pool
             if type(est).__name__ in allowed]
     if not kept:
         raise ValueError(f"No candidate models left after filtering to "
@@ -83,19 +83,31 @@ class _SelectorFactory:
         raise NotImplementedError
 
     @classmethod
+    def _opt_in_models(cls):
+        return []
+
+    @classmethod
+    def _pool(cls, models, model_types_to_use):
+        if models is not None:
+            return _filter_models(list(models), [], model_types_to_use)
+        return _filter_models(cls._default_models(), cls._opt_in_models(),
+                              model_types_to_use)
+
+    @classmethod
     def with_cross_validation(cls, num_folds: int = 3, seed: int = 42,
                               evaluator: Optional[Evaluator] = None,
                               splitter: Optional[Splitter] = None,
                               models: Optional[Sequence] = None,
                               model_types_to_use: Optional[Sequence] = None,
-                              stratify: bool = False) -> ModelSelector:
-        """(reference withCrossValidation:159)"""
+                              stratify: bool = False,
+                              mesh=None) -> ModelSelector:
+        """(reference withCrossValidation:159; ``mesh`` shards the
+        fold x grid candidate axis over chips, parallel/cv.py)"""
         ev = evaluator or cls.default_evaluator()
         return ModelSelector(
-            models=_filter_models(list(models or cls._default_models()),
-                                  model_types_to_use),
+            models=cls._pool(models, model_types_to_use),
             validator=CrossValidation(ev, num_folds=num_folds, seed=seed,
-                                      stratify=stratify),
+                                      stratify=stratify, mesh=mesh),
             splitter=(splitter if splitter is not None
                       else cls.default_splitter(seed=seed)),
             problem_type=cls.problem_type)
@@ -108,13 +120,14 @@ class _SelectorFactory:
                                     models: Optional[Sequence] = None,
                                     model_types_to_use: Optional[Sequence]
                                     = None,
-                                    stratify: bool = False) -> ModelSelector:
+                                    stratify: bool = False,
+                                    mesh=None) -> ModelSelector:
         ev = evaluator or cls.default_evaluator()
         return ModelSelector(
-            models=_filter_models(list(models or cls._default_models()),
-                                  model_types_to_use),
+            models=cls._pool(models, model_types_to_use),
             validator=TrainValidationSplit(ev, train_ratio=train_ratio,
-                                           seed=seed, stratify=stratify),
+                                           seed=seed, stratify=stratify,
+                                           mesh=mesh),
             splitter=(splitter if splitter is not None
                       else cls.default_splitter(seed=seed)),
             problem_type=cls.problem_type)
@@ -129,6 +142,10 @@ class BinaryClassificationModelSelector(_SelectorFactory):
     def _default_models(cls):
         return _default_binary_models()
 
+    @classmethod
+    def _opt_in_models(cls):
+        return _binary_opt_in_models()
+
 
 class MultiClassificationModelSelector(_SelectorFactory):
     problem_type = "MultiClassification"
@@ -139,6 +156,10 @@ class MultiClassificationModelSelector(_SelectorFactory):
     def _default_models(cls):
         return _default_multiclass_models()
 
+    @classmethod
+    def _opt_in_models(cls):
+        return _multiclass_opt_in_models()
+
 
 class RegressionModelSelector(_SelectorFactory):
     problem_type = "Regression"
@@ -148,3 +169,7 @@ class RegressionModelSelector(_SelectorFactory):
     @classmethod
     def _default_models(cls):
         return _default_regression_models()
+
+    @classmethod
+    def _opt_in_models(cls):
+        return _regression_opt_in_models()

@@ -117,6 +117,10 @@ class ModelSelector(Predictor):
         self.validator = validator
         self.splitter = splitter
         self.problem_type = problem_type
+        #: pre-computed winner from workflow-level CV (reference
+        #: findBestEstimator, ModelSelector.scala:113): when set, fit
+        #: skips validation and refits this estimator on the full data
+        self.best_estimator: Optional[BestEstimator] = None
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> SelectedModel:
         if not self.models:
@@ -151,8 +155,16 @@ class ModelSelector(Predictor):
         else:
             Xp, yp = X, y
 
-        # 2. validation (reference validator.validate)
-        best: BestEstimator = self.validator.validate(self.models, Xp, yp)
+        # 2. validation (reference validator.validate) — unless workflow-
+        # level CV already found the winner (ModelSelector.scala:136
+        # bestEstimator.getOrElse{...}). The preset is CONSUMED so a
+        # reused selector instance re-validates on its new data instead
+        # of silently recycling a stale winner.
+        best: BestEstimator
+        if self.best_estimator is not None:
+            best, self.best_estimator = self.best_estimator, None
+        else:
+            best = self.validator.validate(self.models, Xp, yp)
 
         # 3. refit winner on the full prepared train set
         # (reference ModelSelector.scala:163)

@@ -62,10 +62,15 @@ class BestEstimator:
 
 class _ValidatorBase:
     def __init__(self, evaluator: Evaluator, seed: int = 42,
-                 stratify: bool = False):
+                 stratify: bool = False, mesh=None):
         self.evaluator = evaluator
         self.seed = seed
         self.stratify = stratify
+        #: optional ("models", "data") jax.sharding.Mesh — candidates of
+        #: kernel-capable families then train as ONE SPMD program across
+        #: chips (see parallel/cv.py); without it they still batch into
+        #: one vmapped program on the local device.
+        self.mesh = mesh
 
     # -- split construction ------------------------------------------------
     def _splits(self, y: np.ndarray
@@ -99,19 +104,36 @@ class _ValidatorBase:
                  models: Sequence[Tuple[Predictor, Sequence[Dict]]],
                  X: np.ndarray, y: np.ndarray) -> BestEstimator:
         splits = self._splits(y)
+        masks = np.zeros((len(splits), len(y)))
+        for f, (train_idx, _) in enumerate(splits):
+            masks[f, train_idx] = 1.0
         results: List[ValidationResult] = []
         for estimator, grid in models:
             grid = list(grid) or [{}]
+            # fast path: families exposing a fold x grid kernel train all
+            # candidates in ONE batched XLA program (mesh-sharded when
+            # self.mesh is set) instead of len(grid) x folds fits
+            fitted = None
+            if hasattr(estimator, "fit_fold_grid_arrays"):
+                try:
+                    fitted = estimator.fit_fold_grid_arrays(
+                        X, y, masks, grid, mesh=self.mesh)
+                except NotImplementedError:
+                    fitted = None   # grid not traceable -> sequential
             for gi, params in enumerate(grid):
-                candidate = estimator.with_params(**params)
+                candidate = (None if fitted is not None
+                             else estimator.with_params(**params))
                 res = ValidationResult(
                     model_name=type(estimator).__name__,
                     model_uid=estimator.uid, grid_index=gi,
                     params=dict(params))
-                for train_idx, val_idx in splits:
+                for f, (train_idx, val_idx) in enumerate(splits):
                     try:
-                        model: PredictionModel = candidate.fit_arrays(
-                            X[train_idx], y[train_idx])
+                        if fitted is not None:
+                            model: PredictionModel = fitted[f][gi]
+                        else:
+                            model = candidate.fit_arrays(
+                                X[train_idx], y[train_idx])
                         pred = model.predict_arrays(X[val_idx])
                         metrics = self.evaluator.evaluate_arrays(
                             y[val_idx], pred)
@@ -126,6 +148,56 @@ class _ValidatorBase:
                         res.metric_values.append(float("nan"))
                 results.append(res)
 
+        return self._pick_best(models, results)
+
+    def validate_prepared(self,
+                          models: Sequence[Tuple[Predictor, Sequence[Dict]]],
+                          folds: Sequence[Tuple[np.ndarray, np.ndarray,
+                                                np.ndarray, np.ndarray]]
+                          ) -> BestEstimator:
+        """Validate over pre-materialized per-fold data — the
+        workflow-level-CV entry point (reference OpValidator.applyDAG:228
+        + getSummary): each fold's in-CV DAG segment was refit on that
+        fold's train rows, so feature matrices may differ across folds
+        (even in width). ``folds`` is [(X_tr, y_tr, X_val, y_val), ...].
+        Grid batching still applies per fold via the family kernels."""
+        results: List[ValidationResult] = []
+        for estimator, grid in models:
+            grid = list(grid) or [{}]
+            fitted = None
+            if hasattr(estimator, "fit_fold_grid_arrays"):
+                try:
+                    fitted = [
+                        estimator.fit_fold_grid_arrays(
+                            X_tr, y_tr, np.ones((1, len(y_tr))), grid,
+                            mesh=self.mesh)[0]
+                        for X_tr, y_tr, _, _ in folds]
+                except NotImplementedError:
+                    fitted = None
+            for gi, params in enumerate(grid):
+                candidate = (None if fitted is not None
+                             else estimator.with_params(**params))
+                res = ValidationResult(
+                    model_name=type(estimator).__name__,
+                    model_uid=estimator.uid, grid_index=gi,
+                    params=dict(params))
+                for f, (X_tr, y_tr, X_val, y_val) in enumerate(folds):
+                    try:
+                        model = (fitted[f][gi] if fitted is not None
+                                 else candidate.fit_arrays(X_tr, y_tr))
+                        pred = model.predict_arrays(X_val)
+                        metrics = self.evaluator.evaluate_arrays(y_val, pred)
+                        res.metric_values.append(
+                            self.evaluator.metric_from(metrics))
+                    except (ValueError, FloatingPointError) as e:
+                        _log.warning("candidate %s%s failed on a fold: %s",
+                                     res.model_name, params, e)
+                        res.metric_values.append(float("nan"))
+                results.append(res)
+        return self._pick_best(models, results)
+
+    def _pick_best(self, models, results: List[ValidationResult]
+                   ) -> BestEstimator:
         sign = 1.0 if self.evaluator.is_larger_better else -1.0
         finite = [r for r in results if np.isfinite(r.mean_metric)]
         if not finite:
@@ -147,8 +219,8 @@ class CrossValidation(_ValidatorBase):
     validation_type = "CrossValidation"
 
     def __init__(self, evaluator: Evaluator, num_folds: int = 3,
-                 seed: int = 42, stratify: bool = False):
-        super().__init__(evaluator, seed, stratify)
+                 seed: int = 42, stratify: bool = False, mesh=None):
+        super().__init__(evaluator, seed, stratify, mesh=mesh)
         if num_folds < 2:
             raise ValueError("num_folds must be >= 2")
         self.num_folds = num_folds
@@ -170,8 +242,8 @@ class TrainValidationSplit(_ValidatorBase):
     validation_type = "TrainValidationSplit"
 
     def __init__(self, evaluator: Evaluator, train_ratio: float = 0.75,
-                 seed: int = 42, stratify: bool = False):
-        super().__init__(evaluator, seed, stratify)
+                 seed: int = 42, stratify: bool = False, mesh=None):
+        super().__init__(evaluator, seed, stratify, mesh=mesh)
         if not 0.0 < train_ratio < 1.0:
             raise ValueError("train_ratio must be in (0, 1)")
         self.train_ratio = train_ratio

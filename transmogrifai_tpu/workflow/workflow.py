@@ -115,11 +115,14 @@ def _generate_raw_data(raw_features: Sequence[Feature], data: Any,
 
 def _fit_and_transform_layers(
         layers: List[List[PipelineStage]], ds: Dataset, fit: bool,
-        listener=None) -> Tuple[Dataset, Dict[str, PipelineStage]]:
+        listener=None, prefitted: Optional[Dict[str, PipelineStage]] = None
+        ) -> Tuple[Dataset, Dict[str, PipelineStage]]:
     """Layer-by-layer DAG execution (reference
     FitStagesUtil.fitAndTransformDAG:213 / fitAndTransformLayer:254):
     estimators in a layer are fitted then their models applied; plain
-    transformers are applied directly."""
+    transformers are applied directly. ``prefitted`` supplies models
+    already fitted on THIS dataset (the workflow-CV pre-pass) so they
+    are not fitted twice."""
     import time as _time
     fitted: Dict[str, PipelineStage] = {}
 
@@ -140,7 +143,9 @@ def _fit_and_transform_layers(
                     raise RuntimeError(
                         f"Unfitted estimator {stage!r} in scoring DAG — "
                         "train the workflow first")
-                model = timed(stage, "fit", lambda: stage.fit(ds))
+                model = (prefitted or {}).get(stage.uid)
+                if model is None:
+                    model = timed(stage, "fit", lambda: stage.fit(ds))
                 fitted[stage.uid] = model
                 out = stage.get_output()
                 ds = ds.with_column(
@@ -156,6 +161,69 @@ def _fit_and_transform_layers(
     return ds, fitted
 
 
+def _transform_with_fitted(layers: List[List[PipelineStage]],
+                           fitted: Dict[str, PipelineStage],
+                           ds: Dataset) -> Dataset:
+    """Apply already-fitted stages to new rows (the validation side of a
+    workflow-CV fold; reference FittedDAG.transformers application,
+    FitStagesUtil.scala:254-292)."""
+    for layer in layers:
+        for stage in layer:
+            if isinstance(stage, FeatureGeneratorStage):
+                continue
+            if isinstance(stage, Estimator):
+                model = fitted[stage.uid]
+                out = stage.get_output()
+                ds = ds.with_column(out.name, model.transform_columns(
+                    [ds[f.name] for f in model.input_features]))
+            else:
+                ds = stage.transform_dataset(ds)
+    return ds
+
+
+def cut_dag(result_features: Sequence[Feature]):
+    """Split the DAG around the ModelSelector for leakage-free
+    workflow-level CV (reference FitStagesUtil.cutDAG:305).
+
+    Returns (selector, during_layers) where ``during_layers`` are the
+    selector-ancestor layers from the FIRST stage whose inputs mix a
+    response with predictors (e.g. SanityChecker) onward — exactly the
+    stages whose full-data fit would leak validation-fold label
+    information into model selection. Empty when there is no selector or
+    no label-consuming ancestor. Raises on >1 selector (reference
+    "at most 1 Model Selector").
+    """
+    from ..selector.selector import ModelSelector
+    layers = topo_layers(result_features)
+    selectors = [s for layer in layers for s in layer
+                 if isinstance(s, ModelSelector)]
+    if len(selectors) > 1:
+        raise ValueError(
+            f"Workflow can contain at most 1 ModelSelector for "
+            f"workflow-level CV; found {len(selectors)}")
+    if not selectors:
+        return None, []
+    ms = selectors[0]
+    anc_layers = topo_layers(list(ms.input_features))
+    first = None
+    for i, layer in enumerate(anc_layers):
+        for s in layer:
+            if isinstance(s, FeatureGeneratorStage):
+                continue
+            ins = getattr(s, "input_features", ())
+            if (any(f.is_response for f in ins)
+                    and any(not f.is_response for f in ins)):
+                first = i
+                break
+        if first is not None:
+            break
+    if first is None:
+        return ms, []
+    during = [[s for s in layer if not isinstance(s, FeatureGeneratorStage)]
+              for layer in anc_layers[first:]]
+    return ms, [l for l in during if l]
+
+
 class Workflow:
     """Declare result features + input data, then ``train()``
     (reference OpWorkflow.scala:59)."""
@@ -165,6 +233,7 @@ class Workflow:
         self._input_data: Any = None
         self._raw_feature_filter = None
         self._rff_score_data: Any = None
+        self._workflow_cv = False
         #: raw features removed by the RawFeatureFilter (reference
         #: blacklistedFeatures on OpWorkflow)
         self.blacklisted_features: Tuple[Feature, ...] = ()
@@ -214,6 +283,16 @@ class Workflow:
         self._rff_score_data = score_data
         return self
 
+    def with_workflow_cv(self) -> "Workflow":
+        """Leakage-free workflow-level CV (reference withWorkflowCV,
+        OpWorkflowCore.scala:109 + OpWorkflow.scala:388-440): during
+        model selection, every label-consuming ancestor stage of the
+        ModelSelector (e.g. SanityChecker) is REFIT inside each CV fold
+        on that fold's training rows only, so validation metrics carry no
+        fold leakage. The winner is then refit on the full data."""
+        self._workflow_cv = True
+        return self
+
     # -- introspection -----------------------------------------------------
     def raw_features(self) -> List[Feature]:
         return _unique_raw_features(self.result_features)
@@ -259,16 +338,71 @@ class Workflow:
                 result_features, removed = rewire_without(
                     result_features, results.excluded_names)
                 self.blacklisted_features = tuple(removed)
+        prefitted = None
+        if self._workflow_cv:
+            prefitted = self._find_best_with_workflow_cv(result_features, ds)
         layers = topo_layers(result_features)
         listener = getattr(self, "_listener", None)
         train_ds, fitted = _fit_and_transform_layers(
-            layers, ds, fit=True, listener=listener)
+            layers, ds, fit=True, listener=listener, prefitted=prefitted)
         result = tuple(f.copy_with_new_stages(fitted)
                        for f in result_features)
         if listener is not None:
             listener.on_application_end()
         return WorkflowModel(result_features=result,
                              train_dataset=train_ds)
+
+    def _find_best_with_workflow_cv(self, result_features, ds
+                                    ) -> Optional[Dict[str, PipelineStage]]:
+        """Leakage-free model selection (reference OpWorkflow.scala:
+        388-440 + OpValidator.applyDAG:228): refit the in-CV DAG segment
+        per fold, validate candidates on per-fold matrices, preset the
+        winner on the selector. Returns the models fitted by the
+        pre-pass (selector ancestors OUTSIDE the in-CV segment, fitted
+        on full data) so the final pass reuses instead of refitting
+        them; the in-CV segment itself IS refit on full data there.
+
+        Documented deviation: the selector's splitter (balancer/cutter)
+        resampling applies only to the final full refit, not inside the
+        per-fold search — fold stratification covers class balance
+        during the search."""
+        selector, during = cut_dag(result_features)
+        if selector is None or not during:
+            return None  # nothing label-consuming feeds the selector
+        during_uids = {s.uid for layer in during for s in layer}
+        label_f, features_f = selector.input_features
+        # 1. fit the selector's ancestors OUTSIDE the in-CV segment once
+        #    on full data (reference nonCVTS DAG); non-ancestor stages
+        #    and in-CV/selector consumers wait for the final pass
+        anc_layers = [[s for s in layer
+                       if not isinstance(s, FeatureGeneratorStage)
+                       and s.uid not in during_uids]
+                      for layer in topo_layers(list(selector.input_features))]
+        pre, prefitted = _fit_and_transform_layers(
+            [l for l in anc_layers if l], ds, fit=True)
+        if label_f.name not in pre:
+            _log.warning(
+                "workflow-level CV skipped: label %r is produced inside "
+                "the in-CV DAG segment", label_f.name)
+            return prefitted
+        # 2. per fold: refit the in-CV segment on the fold's train rows,
+        #    transform its validation rows with those fitted stages
+        y_pre = np.asarray(pre[label_f.name].data, dtype=np.float64)
+        validator = selector.validator
+        folds = []
+        for train_idx, val_idx in validator._splits(y_pre):
+            tr_ds, fitted_cv = _fit_and_transform_layers(
+                during, pre.take(train_idx), fit=True)
+            val_ds = _transform_with_fitted(during, fitted_cv,
+                                            pre.take(val_idx))
+            folds.append((
+                np.asarray(tr_ds[features_f.name].data, dtype=np.float64),
+                np.asarray(tr_ds[label_f.name].data, dtype=np.float64),
+                np.asarray(val_ds[features_f.name].data, dtype=np.float64),
+                np.asarray(val_ds[label_f.name].data, dtype=np.float64)))
+        selector.best_estimator = validator.validate_prepared(
+            selector.models, folds)
+        return prefitted
 
 
 class WorkflowModel:
