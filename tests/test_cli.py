@@ -41,7 +41,7 @@ class TestGenerateProject:
         assert "RegressionModelSelector" in src
 
     def test_unknown_response_raises(self, csv_file, tmp_path):
-        with pytest.raises(ValueError, match="not in CSV"):
+        with pytest.raises(ValueError, match="not in data"):
             generate_project(csv_file, response="nope",
                              output=str(tmp_path / "p"))
 
@@ -59,3 +59,29 @@ class TestGenerateProject:
                            env=env)
         assert r.returncode == 0, r.stderr[-2000:]
         assert "Selected model" in r.stdout
+
+
+class TestCliAvroAndKind:
+    def test_gen_from_avro_with_avsc_and_kind(self, tmp_path):
+        import json
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        from transmogrifai_tpu.utils.avro_io import write_avro
+        recs = [{"age": float(i % 40 + 20), "city": f"c{i % 3}",
+                 "target": float(i % 7)} for i in range(40)]
+        data = str(tmp_path / "data.avro")
+        write_avro(data, recs)
+        avsc = str(tmp_path / "schema.avsc")
+        with open(avsc, "w") as fh:
+            json.dump({"type": "record", "name": "Row", "fields": [
+                {"name": "age", "type": ["null", "double"]},
+                {"name": "city", "type": ["null", "string"]},
+                {"name": "target", "type": ["null", "double"]}]}, fh)
+        out = str(tmp_path / "proj")
+        rc = cli_main(["gen", "--input", data, "--response", "target",
+                       "--output", out, "--schema", avsc,
+                       "--kind", "regression"])
+        assert rc == 0
+        src = open(f"{out}/main.py").read()
+        assert "DataReaders.Simple.avro" in src
+        assert "RegressionModelSelector" in src
+        compile(src, "main.py", "exec")   # generated code parses

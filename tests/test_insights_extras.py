@@ -1,0 +1,156 @@
+"""RecordInsightsCorr, insights parser, isotonic calibration, random
+param builder, log-loss evaluator (reference RecordInsightsCorr.scala,
+RecordInsightsParser.scala, IsotonicRegressionCalibrator.scala,
+RandomParamBuilder.scala, OPLogLoss.scala)."""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import Evaluators, LogLossEvaluator
+from transmogrifai_tpu.features.columns import (Dataset, FeatureColumn,
+                                                PredictionColumn)
+from transmogrifai_tpu.insights import (RecordInsightsCorr, parse_insights)
+from transmogrifai_tpu.models import (IsotonicRegressionCalibrator,
+                                      LogisticRegression, pava)
+from transmogrifai_tpu.selector import RandomParamBuilder
+from transmogrifai_tpu.utils.vector_meta import (VectorColumnMetadata,
+                                                 VectorMetadata)
+
+
+class TestRecordInsightsCorr:
+    def _fit(self, rng):
+        n = 200
+        X = rng.normal(size=(n, 3))
+        y = (X[:, 0] > 0).astype(float)
+        model = LogisticRegression(max_iter=50).fit_arrays(X, y)
+        pred = model.predict_arrays(X)
+        meta = VectorMetadata(name="fv", columns=[
+            VectorColumnMetadata(parent_feature_name=f"f{j}",
+                                 parent_feature_type="Real")
+            for j in range(3)])
+        fcol = FeatureColumn.vector(X, meta)
+        stage = RecordInsightsCorr(top_k=2)
+        stage.input_features = ()  # arrays-level use
+        model_stage = stage.fit_columns([pred, fcol])
+        return model_stage, pred, fcol
+
+    def test_insights_rank_informative_feature(self, rng):
+        model_stage, pred, fcol = self._fit(rng)
+        out = model_stage.transform_columns([pred, fcol])
+        insights = parse_insights(out.data[0])
+        # the informative feature f0 appears in the top-k of row 0
+        names = {json.loads(k).get("parentFeatureName") for k in insights}
+        assert "f0" in names
+        # every insight is [(pred_index, importance)] pairs
+        for seq in insights.values():
+            for p, v in seq:
+                assert isinstance(p, int) and np.isfinite(v)
+
+    def test_spearman_and_znorm(self, rng):
+        n = 100
+        X = rng.normal(size=(n, 2))
+        pred = PredictionColumn.from_arrays(
+            (X[:, 0] > 0).astype(float),
+            probability=np.stack([1 - (X[:, 0] > 0), (X[:, 0] > 0)],
+                                 axis=1).astype(float))
+        meta = VectorMetadata(name="fv", columns=[
+            VectorColumnMetadata(parent_feature_name=f"f{j}",
+                                 parent_feature_type="Real")
+            for j in range(2)])
+        fcol = FeatureColumn.vector(X, meta)
+        stage = RecordInsightsCorr(top_k=1, norm_type="znorm",
+                                   correlation_type="spearman")
+        stage.input_features = ()
+        out = stage.fit_columns([pred, fcol]).transform_columns(
+            [pred, fcol])
+        assert len(out.data) == n
+
+
+class TestIsotonicCalibrator:
+    def test_pava_monotone(self):
+        x = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+        y = np.array([1.0, 2.0, 1.5, 4.0, 5.0])
+        b, p = pava(x, y)
+        assert np.all(np.diff(p) >= 0)
+        # pooled block for the violation at x=2,3
+        model = IsotonicRegressionCalibrator().fit_arrays(x, y)
+        out = model.predict_values(np.array([2.5, 0.0, 10.0]))
+        assert out[0] == pytest.approx(1.75, abs=1e-9)
+        assert out[1] == pytest.approx(1.0)   # clamped left
+        assert out[2] == pytest.approx(5.0)   # clamped right
+
+    def test_antitonic(self):
+        x = np.array([1.0, 2.0, 3.0])
+        y = np.array([3.0, 2.0, 1.0])
+        model = IsotonicRegressionCalibrator(isotonic=False).fit_arrays(x, y)
+        np.testing.assert_allclose(model.predict_values(x), y)
+
+    def test_calibration_improves_brier(self, rng):
+        n = 400
+        raw = rng.uniform(0, 1, n)
+        y = (rng.uniform(0, 1, n) < raw ** 2).astype(float)  # miscalibrated
+        model = IsotonicRegressionCalibrator().fit_arrays(raw, y)
+        cal = model.calibrate(raw)
+        brier_raw = np.mean((raw - y) ** 2)
+        brier_cal = np.mean((cal - y) ** 2)
+        assert brier_cal < brier_raw
+
+
+class TestRandomParamBuilder:
+    def test_distributions(self):
+        grids = (RandomParamBuilder(seed=7)
+                 .uniform("max_depth", 2, 10, integer=True)
+                 .exponential("reg_param", 1e-4, 1.0)
+                 .subset("impurity", ["gini", "entropy"])
+                 .build(50))
+        assert len(grids) == 50
+        assert all(2 <= g["max_depth"] <= 10 for g in grids)
+        assert all(1e-4 <= g["reg_param"] <= 1.0 for g in grids)
+        assert {g["impurity"] for g in grids} == {"gini", "entropy"}
+        # log-uniform: about half the draws below the geometric middle
+        below = sum(g["reg_param"] < 1e-2 for g in grids)
+        assert 10 <= below <= 40
+
+    def test_selector_integration(self, rng):
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector)
+        X = rng.normal(size=(120, 3))
+        y = (X[:, 0] > 0).astype(float)
+        grid = (RandomParamBuilder(seed=3)
+                .exponential("reg_param", 1e-3, 1.0).build(4))
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, stratify=True, splitter=None,
+            models=[(LogisticRegression(max_iter=25), grid)])
+        fitted = sel.fit_arrays(X, y)
+        assert len(fitted.summary.validation_results) == 4
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            RandomParamBuilder().uniform("x", 5, 5)
+        with pytest.raises(ValueError):
+            RandomParamBuilder().exponential("x", 0.0, 1.0)
+        with pytest.raises(ValueError):
+            RandomParamBuilder().build(3)
+
+
+class TestLogLoss:
+    def test_perfect_and_uncertain(self):
+        ev = LogLossEvaluator()
+        y = np.array([0.0, 1.0, 1.0])
+        certain = PredictionColumn.from_arrays(
+            y, probability=np.array([[1.0, 0.0], [0.0, 1.0], [0.0, 1.0]]))
+        uncertain = PredictionColumn.from_arrays(
+            y, probability=np.full((3, 2), 0.5))
+        assert ev.evaluate_arrays(y, certain).LogLoss == pytest.approx(
+            0.0, abs=1e-9)
+        assert ev.evaluate_arrays(y, uncertain).LogLoss == pytest.approx(
+            np.log(2.0))
+        assert not ev.is_larger_better
+
+    def test_factory_and_errors(self):
+        ev = Evaluators.BinaryClassification.log_loss()
+        assert isinstance(ev, LogLossEvaluator)
+        with pytest.raises(ValueError):
+            ev.evaluate_arrays(np.array([]), PredictionColumn.from_arrays(
+                np.array([])))

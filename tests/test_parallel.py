@@ -128,3 +128,44 @@ def test_validator_mesh_selects_same_winner(rng):
     for rm, rb in zip(best_mesh.results, best_seq.results):
         np.testing.assert_allclose(rm.metric_values, rb.metric_values,
                                    atol=2e-3)
+
+
+def test_wide_matrix_sharding(rng):
+    """Feature-axis sharding of a wide matrix (SURVEY §5.7): per-chip
+    memory is d/n_chips columns and a matvec against it contracts the
+    sharded axis with an XLA-inserted psum."""
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.parallel import make_mesh, shard_wide_matrix
+    mesh = make_mesh({"data": 8})
+    X = rng.normal(size=(16, 21))           # 21 -> padded to 24 = 8*3
+    Xs = shard_wide_matrix(X, mesh)
+    assert Xs.shape == (16, 24)
+    shard_widths = {s.data.shape[1] for s in Xs.addressable_shards}
+    assert shard_widths == {3}
+    w = jnp.asarray(rng.normal(size=24))
+    out = jax.jit(lambda A, v: A @ v)(Xs, w)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.concatenate([X, np.zeros((16, 3))],
+                                              axis=1) @ np.asarray(w),
+                               atol=1e-8)
+
+
+def test_distinct_uid_validation(rng):
+    """Reference OpWorkflow.scala:305 — duplicate stage uids fail fast."""
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.workflow import Workflow
+    import pytest as _pytest
+    a = FeatureBuilder.real("a").extract(lambda r: r["a"]).as_predictor()
+    b = FeatureBuilder.real("b").extract(lambda r: r["b"]).as_predictor()
+    shared = RealVectorizer()
+    va = shared.set_input(a).get_output()
+    # reusing ONE stage instance for different inputs aliases its uid
+    import copy
+    clone = copy.copy(shared)
+    vb = clone.set_input(b).get_output()
+    wf = (Workflow().set_result_features(va, vb)
+          .set_input_records([{"a": 1.0, "b": 2.0}]))
+    with _pytest.raises(ValueError, match="Duplicate stage uid"):
+        wf.train()

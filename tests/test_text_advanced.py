@@ -111,3 +111,46 @@ class TestLDA:
         dominant = out.data[:, 0] > 0.5
         agreement = np.mean(dominant == np.asarray(labels))
         assert agreement > 0.9 or agreement < 0.1  # topic ids may swap
+
+
+class TestNameEntityRecognizer:
+    """(reference NameEntityRecognizerTest.scala — heuristic tagger
+    stands in for OpenNLP, SURVEY §2.9)"""
+
+    def test_entities(self):
+        from transmogrifai_tpu.ops import NameEntityRecognizer
+        ner = NameEntityRecognizer()
+        out = ner.transform_value(
+            "Dr. Alice Smith of Acme Corp. visited Paris on Friday "
+            "at 10:30 and paid $5,000 (a 20% deposit).")
+        tags = out.value
+        assert tags["Alice"] == {"Person"} and tags["Smith"] == {"Person"}
+        assert "Organization" in tags["Acme"]
+        assert tags["Paris"] == {"Location"}
+        assert tags["Friday"] == {"Date"}
+        assert tags["10:30"] == {"Time"}
+        assert "Money" in tags["$5,000"]
+        assert "Percentage" in tags["20%"]
+
+    def test_empty_and_column_path(self):
+        from transmogrifai_tpu.features.columns import FeatureColumn
+        from transmogrifai_tpu.ops import NameEntityRecognizer
+        from transmogrifai_tpu.types import MultiPickListMap, Text
+        ner = NameEntityRecognizer()
+        assert ner.transform_value(None).is_empty
+        col = FeatureColumn.from_values(
+            Text, ["Paris is lovely in June.", None])
+        out = ner.transform_columns([col])
+        assert out.data[0]["Paris"] == {"Location"}
+        assert out.data[1] == {} or not out.data[1]
+
+
+def test_check_serializable_flags_lambdas():
+    """(reference OpWorkflow.checkSerializable:265)"""
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.workflow.workflow import check_serializable
+    from tests.test_workflow_serde_helpers import extract_x
+    lam = FeatureBuilder.real("a").extract(lambda r: r["a"]).as_predictor()
+    good = FeatureBuilder.real("x").extract(extract_x).as_predictor()
+    problems = check_serializable([lam, good])
+    assert len(problems) == 1 and "'a'" in problems[0]

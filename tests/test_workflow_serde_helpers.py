@@ -1,0 +1,5 @@
+"""Importable extract fns for serializability tests."""
+
+
+def extract_x(r):
+    return r["x"]

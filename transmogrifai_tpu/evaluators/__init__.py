@@ -13,6 +13,7 @@ from .binary import (BinaryClassificationEvaluator,
 from .multiclass import (MultiClassificationEvaluator,
                          MultiClassificationMetrics, ThresholdMetrics,
                          multiclass_metrics)
+from .logloss import LogLossEvaluator, LogLossMetrics, log_loss
 from .regression import (RegressionEvaluator, RegressionMetrics,
                          regression_metrics)
 
@@ -22,6 +23,7 @@ __all__ = [
     "BinScoreEvaluator", "BinScoreMetrics", "binary_metrics", "au_pr",
     "au_roc", "roc_curve", "pr_curve",
     "MultiClassificationEvaluator", "MultiClassificationMetrics",
+    "LogLossEvaluator", "LogLossMetrics", "log_loss",
     "ThresholdMetrics", "multiclass_metrics",
     "RegressionEvaluator", "RegressionMetrics", "regression_metrics",
     "Evaluators",
@@ -57,6 +59,10 @@ class Evaluators:
         @staticmethod
         def error(**kw) -> BinaryClassificationEvaluator:
             return BinaryClassificationEvaluator(default_metric="Error", **kw)
+
+        @staticmethod
+        def log_loss(**kw) -> LogLossEvaluator:
+            return LogLossEvaluator(**kw)
 
     class MultiClassification:
         @staticmethod

@@ -9,6 +9,8 @@ from .linear import (LinearRegression, LinearRegressionModel, LinearSVC,
 from .bayes import NaiveBayes, NaiveBayesModel
 from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel)
+from .isotonic import (IsotonicRegressionCalibrator,
+                       IsotonicRegressionCalibratorModel, pava)
 from .mlp import (MultilayerPerceptronClassifier,
                   MultilayerPerceptronClassifierModel)
 from .trees import (DecisionTreeClassifier, DecisionTreeRegressor,
@@ -28,6 +30,8 @@ __all__ = [
     "RandomForestClassifier", "RandomForestRegressor",
     "GBTClassifier", "GBTClassifierModel",
     "GBTRegressor", "GBTRegressorModel",
+    "IsotonicRegressionCalibrator", "IsotonicRegressionCalibratorModel",
+    "pava",
     "XGBoostClassifier", "XGBoostRegressor",
     "TreeEnsembleClassifierModel", "TreeEnsembleRegressorModel",
     "NaiveBayes", "NaiveBayesModel",

@@ -1,0 +1,119 @@
+"""IsotonicRegressionCalibrator: monotone score calibration.
+
+TPU-native port of the reference IsotonicRegressionCalibrator
+(core/src/main/scala/com/salesforce/op/stages/impl/regression/
+IsotonicRegressionCalibrator.scala — a thin wrapper over Spark MLlib
+IsotonicRegression): fit runs Pool-Adjacent-Violators (PAVA) over
+(score, label) pairs and keeps the compressed (boundary, prediction)
+pairs; prediction linearly interpolates between boundaries exactly as
+MLlib's IsotonicRegressionModel does (clamped at the ends).
+
+PAVA itself is the classic stack algorithm on sorted scores — O(n) on
+host after an O(n log n) device-friendly sort; the fitted calibrator's
+transform is a pure ``searchsorted`` + lerp, trivially jittable.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..features.columns import FeatureColumn, PredictionColumn
+from .base import Predictor, RegressionModel
+
+__all__ = ["IsotonicRegressionCalibrator",
+           "IsotonicRegressionCalibratorModel", "pava"]
+
+
+def pava(x: np.ndarray, y: np.ndarray,
+         w: Optional[np.ndarray] = None,
+         increasing: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Weighted isotonic fit: returns (boundaries, predictions), the
+    compressed representation MLlib stores (adjacent equal fitted values
+    merged; duplicate x pooled by weight)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    w = np.ones_like(y) if w is None else np.asarray(w, dtype=np.float64)
+    if not increasing:
+        b, p = pava(-x, y, w, increasing=True)
+        return -b[::-1], p[::-1]
+    order = np.lexsort((y, x))
+    xs, ys, ws = x[order], y[order], w[order]
+    # pool duplicate scores first (one block per distinct x)
+    ux, inv = np.unique(xs, return_inverse=True)
+    wsum = np.bincount(inv, weights=ws)
+    ysum = np.bincount(inv, weights=ws * ys)
+    means = ysum / wsum
+    # PAVA stack: blocks of (weight, weighted-mean, x_lo, x_hi)
+    blocks: List[List[float]] = []
+    for i in range(len(ux)):
+        blocks.append([wsum[i], means[i], ux[i], ux[i]])
+        while len(blocks) > 1 and blocks[-2][1] >= blocks[-1][1]:
+            w2, m2, lo2, hi2 = blocks.pop()
+            w1, m1, lo1, hi1 = blocks.pop()
+            wt = w1 + w2
+            blocks.append([wt, (w1 * m1 + w2 * m2) / wt, lo1, hi2])
+    boundaries: List[float] = []
+    preds: List[float] = []
+    for wt, m, lo, hi in blocks:
+        if preds and preds[-1] == m:
+            boundaries[-1] = hi       # merge equal-valued neighbors
+            continue
+        if lo == hi:
+            boundaries.append(lo)
+            preds.append(m)
+        else:
+            boundaries.extend([lo, hi])
+            preds.extend([m, m])
+    return np.asarray(boundaries), np.asarray(preds)
+
+
+class IsotonicRegressionCalibrator(Predictor):
+    """Calibrate a score against a label monotonically
+    (reference IsotonicRegressionCalibrator.scala; input 1 the RealNN
+    label, input 2 an OPVector whose ``feature_index`` column carries
+    the score — MLlib's featureIndex param)."""
+
+    def __init__(self, isotonic: bool = True, feature_index: int = 0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.isotonic = isotonic
+        self.feature_index = feature_index
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray
+                   ) -> "IsotonicRegressionCalibratorModel":
+        scores = np.asarray(X, dtype=np.float64)
+        if scores.ndim == 2:
+            scores = scores[:, self.feature_index]
+        b, p = pava(scores, y, increasing=self.isotonic)
+        return IsotonicRegressionCalibratorModel(
+            boundaries=b, predictions=p,
+            feature_index=self.feature_index)
+
+
+class IsotonicRegressionCalibratorModel(RegressionModel):
+    """Piecewise-linear monotone map (reference/MLlib
+    IsotonicRegressionModel semantics: linear interpolation between
+    boundaries, clamping outside)."""
+
+    def __init__(self, boundaries=None, predictions=None,
+                 feature_index: int = 0, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.boundaries = np.asarray(boundaries, dtype=np.float64)
+        self.predictions = np.asarray(predictions, dtype=np.float64)
+        self.feature_index = int(feature_index)
+
+    def calibrate(self, scores: np.ndarray) -> np.ndarray:
+        b, p = self.boundaries, self.predictions
+        if b.size == 0:
+            return np.zeros_like(scores)
+        if b.size == 1:
+            return np.full_like(scores, p[0])
+        out = np.interp(scores, b, p)
+        return np.clip(out, min(p[0], p[-1]), max(p[0], p[-1]))
+
+    def predict_values(self, X: np.ndarray) -> np.ndarray:
+        scores = np.asarray(X, dtype=np.float64)
+        if scores.ndim == 2:
+            scores = scores[:, self.feature_index]
+        return self.calibrate(scores)

@@ -42,6 +42,7 @@ device arrays and are safe to call inside ``shard_map``.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -656,13 +657,30 @@ def _resolve_max_features(strategy: str, d: int, classification: bool
     return max(1, min(d, int(float(s) * d) if "." in s else int(s)))
 
 
+#: binning memo: the validator holds each fold's matrix with stable
+#: identity across the whole grid, so one O(d) host binning pass serves
+#: every grid point of every tree family on that fold. Strong refs to
+#: the keyed arrays keep their id()s valid while cached.
+_DESIGN_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_DESIGN_CACHE_SIZE = 8
+
+
 def _design_args(X: np.ndarray, max_bins: int):
     """Host-bin X and return the device-ready design arrays:
     (packed, feat_of, block_start, packed_thr, binned, col_thr)."""
+    key = (id(X), getattr(X, "shape", None), max_bins)
+    hit = _DESIGN_CACHE.get(key)
+    if hit is not None and hit[0] is X:
+        _DESIGN_CACHE.move_to_end(key)
+        return hit[1]
     design = _PackedDesign(X, max_bins)
-    return (jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+    args = (jnp.asarray(design.packed), jnp.asarray(design.feat_of),
             jnp.asarray(design.block_start), jnp.asarray(design.packed_thr),
             jnp.asarray(design.binned), jnp.asarray(design.col_thr))
+    _DESIGN_CACHE[key] = (X, args)
+    while len(_DESIGN_CACHE) > _DESIGN_CACHE_SIZE:
+        _DESIGN_CACHE.popitem(last=False)
+    return args
 
 
 def _pool_size(d: int, mf: Optional[int]) -> Optional[int]:

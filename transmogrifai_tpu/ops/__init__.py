@@ -27,6 +27,7 @@ from .maps import (BinaryMapVectorizer, DateMapToUnitCircleVectorizer,
                    RealMapVectorizer, RealMapVectorizerModel,
                    SmartTextMapVectorizer, SmartTextMapVectorizerModel,
                    TextMapPivotVectorizer, TextMapPivotVectorizerModel)
+from .ner import NameEntityRecognizer
 from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
                       RealVectorizerModel)
 from .text import (SmartTextVectorizer, SmartTextVectorizerModel,
@@ -54,7 +55,7 @@ __all__ = [
     "MultiPickListMapVectorizer", "GeolocationMapVectorizer",
     "GeolocationMapVectorizerModel",
     "GeolocationVectorizer", "GeolocationVectorizerModel",
-    "NumericBucketizer", "DecisionTreeNumericBucketizer",
+    "NumericBucketizer", "NameEntityRecognizer", "DecisionTreeNumericBucketizer",
     "DecisionTreeNumericBucketizerModel", "PercentileCalibrator",
     "PercentileCalibratorModel", "ScalerTransformer", "DescalerTransformer",
     "ScalingType",

@@ -1,0 +1,45 @@
+"""NameEntityRecognizer: Text -> MultiPickListMap of entity tags.
+
+TPU-native port of the reference NameEntityRecognizer
+(core/src/main/scala/com/salesforce/op/stages/impl/feature/
+NameEntityRecognizer.scala:57-90): sentence-split the text, tag each
+sentence, and merge {token -> set(entity types)} maps. The statistical
+OpenNLP tagger is replaced by the deterministic heuristic tagger in
+utils/text_ner.py (SURVEY §2.9 — JVM analyzers get pure-Python host
+equivalents).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..features.columns import FeatureColumn
+from ..stages.base import UnaryTransformer
+from ..types import MultiPickListMap, Text
+from ..utils.text_ner import (HeuristicNameEntityTagger, NameEntityType,
+                              split_sentences)
+
+__all__ = ["NameEntityRecognizer", "NameEntityType"]
+
+
+class NameEntityRecognizer(UnaryTransformer):
+    """(reference NameEntityRecognizer.scala:57)"""
+
+    input_types = (Text,)
+    output_type = MultiPickListMap
+
+    def __init__(self, tagger: Optional[HeuristicNameEntityTagger] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="nameEntityRec", uid=uid)
+        self.tagger = tagger or HeuristicNameEntityTagger()
+
+    def transform_value(self, value) -> MultiPickListMap:
+        text = value.value if hasattr(value, "value") else value
+        merged: Dict[str, Set[str]] = {}
+        for sentence in split_sentences(text or ""):
+            for tok, ents in self.tagger.tag(sentence).items():
+                merged.setdefault(tok, set()).update(ents)
+        return MultiPickListMap({k: set(v) for k, v in merged.items()})
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        values = [self.transform_value(v) for v in cols[0].data]
+        return FeatureColumn.from_values(MultiPickListMap, values)

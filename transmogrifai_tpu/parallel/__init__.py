@@ -1,8 +1,12 @@
 """Multi-chip execution: device meshes, sharding, collectives (SURVEY §2.9,
 §5.8). Replaces the reference's Spark task parallelism + Rabit allreduce
 with jax.sharding meshes and XLA collectives over ICI."""
+from .distributed import (initialize_distributed, shard_wide_matrix,
+                          wide_matrix_sharding)
 from .mesh import (Mesh, NamedSharding, PartitionSpec, cv_mesh, make_mesh,
                    n_devices, replicate, shard_rows)
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "cv_mesh", "make_mesh",
-           "n_devices", "replicate", "shard_rows"]
+           "n_devices", "replicate", "shard_rows",
+           "initialize_distributed", "wide_matrix_sharding",
+           "shard_wide_matrix"]

@@ -1,10 +1,13 @@
 """Typed data ingestion (SURVEY §2.12; readers/src/main/scala/com/
 salesforce/op/readers/)."""
-from .data_readers import (AggregateDataReader, ConditionalDataReader,
-                           CSVAutoReader, CSVProductReader, DataReader,
-                           DataReaders, ParquetProductReader)
+from .data_readers import (AggregateDataReader, AvroProductReader,
+                           ConditionalDataReader, CSVAutoReader,
+                           CSVProductReader, DataReader, DataReaders,
+                           ParquetProductReader)
 from .joined import JoinedDataReader, JoinKeys
+from .streaming import StreamingReader, StreamingReaders
 
 __all__ = ["DataReader", "AggregateDataReader", "ConditionalDataReader",
-           "CSVProductReader", "CSVAutoReader", "ParquetProductReader",
-           "DataReaders", "JoinedDataReader", "JoinKeys"]
+           "CSVProductReader", "CSVAutoReader", "AvroProductReader",
+           "ParquetProductReader", "DataReaders", "JoinedDataReader",
+           "JoinKeys", "StreamingReader", "StreamingReaders"]

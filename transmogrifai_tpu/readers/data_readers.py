@@ -295,6 +295,26 @@ def _is_number(v: str) -> bool:
     return np.isfinite(f)
 
 
+class AvroProductReader(DataReader):
+    """Avro object container file(s) -> dict records (reference
+    AvroReaders.scala; decoding via utils/avro_io.py — no avro library
+    in the image). ``path`` may be a file or a glob."""
+
+    def __init__(self, path: str,
+                 key_fn: Optional[Callable[[Any], str]] = None):
+        super().__init__(records=None, key_fn=key_fn)
+        self.path = path
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        import glob as _glob
+        from ..utils.avro_io import read_avro
+        paths = sorted(_glob.glob(self.path)) or [self.path]
+        out: List[Dict[str, Any]] = []
+        for p in paths:
+            out.extend(read_avro(p))
+        return out
+
+
 class ParquetProductReader(DataReader):
     """Parquet via pandas/pyarrow (reference ParquetProductReader.scala)."""
 
@@ -325,6 +345,10 @@ class DataReaders:
         @staticmethod
         def csv_auto(path: str, key_fn=None) -> CSVAutoReader:
             return CSVAutoReader(path, key_fn)
+
+        @staticmethod
+        def avro(path: str, key_fn=None) -> AvroProductReader:
+            return AvroProductReader(path, key_fn)
 
         @staticmethod
         def parquet(path: str, key_fn=None) -> ParquetProductReader:

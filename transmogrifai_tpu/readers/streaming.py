@@ -1,0 +1,80 @@
+"""Streaming readers: micro-batch record streams for scoring.
+
+TPU-native equivalent of the reference streaming stack
+(readers/src/main/scala/com/salesforce/op/readers/StreamingReader.scala:54
+and StreamingReaders.scala:43-59): the reference turns a directory of
+Avro files into a Spark DStream of micro-batches; here a
+:class:`StreamingReader` yields batches of dict records that plug
+straight into ``WorkflowRunner.streaming_score`` (workflow/runner.py).
+Sources: an iterable of records (chunked), a directory of Avro/CSV
+files (one batch per file — the DStream fileStream analogue), or any
+iterator of pre-built batches.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+__all__ = ["StreamingReader", "StreamingReaders"]
+
+
+class StreamingReader:
+    """A re-iterable stream of record micro-batches."""
+
+    def __init__(self, batch_source: Callable[[], Iterator[List[dict]]]):
+        self._batch_source = batch_source
+
+    def stream(self) -> Iterator[List[dict]]:
+        """(reference StreamingReader.stream:54)"""
+        return self._batch_source()
+
+    def __iter__(self) -> Iterator[List[dict]]:
+        return self.stream()
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_records(records: Iterable[dict],
+                     batch_size: int = 1000) -> "StreamingReader":
+        """Chunk an iterable of records into fixed-size micro-batches."""
+        records = list(records)
+
+        def gen():
+            for i in range(0, len(records), batch_size):
+                yield records[i:i + batch_size]
+        return StreamingReader(gen)
+
+    @staticmethod
+    def from_batches(batches: Iterable[List[dict]]) -> "StreamingReader":
+        batches = [list(b) for b in batches]
+        return StreamingReader(lambda: iter(batches))
+
+    @staticmethod
+    def avro(path_glob: str) -> "StreamingReader":
+        """One micro-batch per Avro container file, in name order
+        (reference StreamingReaders.Simple.avro:43 fileStream)."""
+        from ..utils.avro_io import read_avro
+
+        def gen():
+            for p in sorted(glob.glob(path_glob)):
+                yield read_avro(p)
+        return StreamingReader(gen)
+
+    @staticmethod
+    def csv(path_glob: str) -> "StreamingReader":
+        """One micro-batch per CSV file, in name order."""
+        from .data_readers import CSVAutoReader
+
+        def gen():
+            for p in sorted(glob.glob(path_glob)):
+                yield CSVAutoReader(p).read_records()
+        return StreamingReader(gen)
+
+
+class StreamingReaders:
+    """Factory namespace (reference StreamingReaders.scala:43)."""
+
+    class Simple:
+        avro = staticmethod(StreamingReader.avro)
+        csv = staticmethod(StreamingReader.csv)
+        custom = staticmethod(StreamingReader.from_records)

@@ -3,6 +3,7 @@ ModelSelector.scala:74 — the north-star TPU-acceleration target)."""
 from .factories import (BinaryClassificationModelSelector,
                         MultiClassificationModelSelector,
                         RegressionModelSelector)
+from .random_params import RandomParamBuilder
 from .selector import ModelSelector, ModelSelectorSummary, SelectedModel
 from .splitters import (DataBalancer, DataCutter, DataSplitter, Splitter,
                         SplitterSummary)
@@ -16,5 +17,5 @@ __all__ = [
     "Splitter", "SplitterSummary", "DataSplitter", "DataBalancer",
     "DataCutter",
     "CrossValidation", "TrainValidationSplit", "BestEstimator",
-    "ValidationResult",
+    "ValidationResult", "RandomParamBuilder",
 ]

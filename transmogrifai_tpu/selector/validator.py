@@ -107,6 +107,10 @@ class _ValidatorBase:
         masks = np.zeros((len(splits), len(y)))
         for f, (train_idx, _) in enumerate(splits):
             masks[f, train_idx] = 1.0
+        # fold arrays materialized ONCE and shared across every family
+        # and grid point — stable array identity also lets the tree
+        # family's host-side binning memoize per fold
+        fold_data = [(X[tr], y[tr], X[va], y[va]) for tr, va in splits]
         results: List[ValidationResult] = []
         for estimator, grid in models:
             grid = list(grid) or [{}]
@@ -127,16 +131,15 @@ class _ValidatorBase:
                     model_name=type(estimator).__name__,
                     model_uid=estimator.uid, grid_index=gi,
                     params=dict(params))
-                for f, (train_idx, val_idx) in enumerate(splits):
+                for f, (X_tr, y_tr, X_val, y_val) in enumerate(fold_data):
                     try:
                         if fitted is not None:
                             model: PredictionModel = fitted[f][gi]
                         else:
-                            model = candidate.fit_arrays(
-                                X[train_idx], y[train_idx])
-                        pred = model.predict_arrays(X[val_idx])
+                            model = candidate.fit_arrays(X_tr, y_tr)
+                        pred = model.predict_arrays(X_val)
                         metrics = self.evaluator.evaluate_arrays(
-                            y[val_idx], pred)
+                            y_val, pred)
                         res.metric_values.append(
                             self.evaluator.metric_from(metrics))
                     except (ValueError, FloatingPointError) as e:

@@ -18,11 +18,31 @@ _DEFAULT_CACHE = os.path.join(
         os.path.abspath(__file__)))), ".jax_cache")
 
 
+def _machine_fingerprint() -> str:
+    """Cache namespace per CPU capability set: XLA:CPU AOT artifacts are
+    machine-feature-specific, and loading one compiled for a different
+    microarchitecture can SIGILL (cpu_aot_loader warns exactly this)."""
+    import hashlib
+    import platform
+    ident = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    ident += ",".join(sorted(line.split(":")[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(ident.encode()).hexdigest()[:12]
+
+
 def enable_compilation_cache(path: str = None) -> str:
     """Turn on JAX's persistent compilation cache at ``path`` (defaults
-    to ``<repo>/.jax_cache``). Safe to call multiple times."""
+    to ``<repo>/.jax_cache/<machine-fingerprint>``). Safe to call
+    multiple times."""
     import jax
     path = path or os.environ.get("TX_JAX_CACHE_DIR", _DEFAULT_CACHE)
+    path = os.path.join(path, _machine_fingerprint())
     os.makedirs(path, exist_ok=True)
     try:
         jax.config.update("jax_compilation_cache_dir", path)

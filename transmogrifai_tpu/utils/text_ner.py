@@ -1,0 +1,147 @@
+"""Name-entity tagging — host-side heuristic tagger.
+
+TPU-native stand-in for the reference's OpenNLP statistical NER
+(utils/.../text/NameEntityTagger.scala:71-86 NameEntityType enum,
+core/.../utils/text/OpenNLPNameEntityTagger.scala): the image ships no
+OpenNLP-style maxent models, so tagging is rule/gazetteer-based —
+honorific-introduced capitalized spans tag Person, corporate suffixes
+Organization, a compact country/city gazetteer Location, and
+month/clock/currency/percent patterns Date/Time/Money/Percentage.
+Deterministic, dependency-free, and (like the reference's text stack,
+SURVEY §2.9) strictly a pre-device host pass.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence, Set, Tuple
+
+__all__ = ["NameEntityType", "HeuristicNameEntityTagger",
+           "split_sentences"]
+
+
+class NameEntityType:
+    """(reference NameEntityTagger.scala:71-86)"""
+    Date = "Date"
+    Location = "Location"
+    Money = "Money"
+    Organization = "Organization"
+    Percentage = "Percentage"
+    Person = "Person"
+    Time = "Time"
+    Misc = "Misc"
+    Other = "Other"
+    values = (Date, Location, Money, Organization, Percentage, Person,
+              Time, Misc, Other)
+
+
+_HONORIFICS = {"mr", "mr.", "mrs", "mrs.", "ms", "ms.", "dr", "dr.",
+               "prof", "prof.", "sir", "president", "senator", "judge",
+               "captain", "st", "st."}
+_ORG_SUFFIXES = {"inc", "inc.", "corp", "corp.", "co", "co.", "ltd",
+                 "ltd.", "llc", "plc", "gmbh", "ag", "company",
+                 "corporation", "university", "institute", "bank"}
+_LOCATIONS = {
+    "paris", "london", "tokyo", "berlin", "madrid", "rome", "moscow",
+    "beijing", "sydney", "toronto", "chicago", "boston", "seattle",
+    "francisco", "york", "angeles", "usa", "u.s.", "uk", "france",
+    "germany", "spain", "italy", "china", "japan", "india", "canada",
+    "australia", "brazil", "mexico", "russia", "england", "america",
+    "europe", "asia", "africa", "california", "texas", "washington",
+}
+_MONTHS = {"january", "february", "march", "april", "may", "june", "july",
+           "august", "september", "october", "november", "december",
+           "jan", "feb", "mar", "apr", "jun", "jul", "aug", "sep", "sept",
+           "oct", "nov", "dec"}
+_WEEKDAYS = {"monday", "tuesday", "wednesday", "thursday", "friday",
+             "saturday", "sunday"}
+
+_TIME_RE = re.compile(r"^\d{1,2}:\d{2}(:\d{2})?([ap]m)?$", re.IGNORECASE)
+_MONEY_RE = re.compile(r"^[$€£¥]\d[\d,.]*[kmb]?$", re.IGNORECASE)
+_PCT_RE = re.compile(r"^\d[\d,.]*%$")
+_YEAR_RE = re.compile(r"^(1[89]|20)\d\d$")
+_SENT_RE = re.compile(r"(?<=[.!?])\s+(?=[A-Z0-9\"'(])")
+_TOKEN_RE = re.compile(r"[^\s]+")
+
+
+def split_sentences(text: str) -> List[str]:
+    """Sentence split on terminal punctuation followed by a capital,
+    with abbreviation/honorific periods rejoined ("Dr. Alice" is not a
+    boundary) — the reference OpenNLPSentenceSplitter's role."""
+    text = (text or "").strip()
+    if not text:
+        return []
+    parts = [s for s in _SENT_RE.split(text) if s]
+    merged: List[str] = []
+    no_break = _HONORIFICS | _ORG_SUFFIXES | {"no.", "vs.", "etc.", "e.g.",
+                                              "i.e.", "jr.", "sr."}
+    for part in parts:
+        if merged and merged[-1].rsplit(None, 1)[-1].lower() in no_break:
+            merged[-1] += " " + part
+        else:
+            merged.append(part)
+    return merged
+
+
+def _strip(tok: str) -> str:
+    return tok.strip(".,;:!?\"'()[]{}")
+
+
+class HeuristicNameEntityTagger:
+    """tag(sentence) -> {token: {entity types}}
+    (reference NameEntityTagger.tag returning TaggerResult.tokenTags)."""
+
+    def tag(self, sentence: str,
+            entities: Sequence[str] = NameEntityType.values
+            ) -> Dict[str, Set[str]]:
+        raw = _TOKEN_RE.findall(sentence or "")
+        toks = [_strip(t) for t in raw]
+        tags: Dict[str, Set[str]] = {}
+        want = set(entities)
+
+        def add(token: str, ent: str) -> None:
+            if ent in want and token:
+                tags.setdefault(token, set()).add(ent)
+
+        for i, (rtok, tok) in enumerate(zip(raw, toks)):
+            low = tok.lower()
+            if _TIME_RE.match(tok):
+                add(tok, NameEntityType.Time)
+            if _MONEY_RE.match(tok):
+                add(tok, NameEntityType.Money)
+            if _PCT_RE.match(tok):
+                add(tok, NameEntityType.Percentage)
+            if low in _MONTHS or low in _WEEKDAYS or _YEAR_RE.match(tok):
+                add(tok, NameEntityType.Date)
+            if low in _LOCATIONS and tok[:1].isupper():
+                add(tok, NameEntityType.Location)
+            cap = tok[:1].isupper() and not tok.isupper() or \
+                (tok.isupper() and len(tok) > 1)
+            if not cap or low in _HONORIFICS:
+                continue
+            prev = toks[i - 1].lower() if i else ""
+            nxt = toks[i + 1].lower() if i + 1 < len(toks) else ""
+            # corporate suffix tags the capitalized span before it
+            if nxt in _ORG_SUFFIXES or low in _ORG_SUFFIXES and i:
+                add(tok, NameEntityType.Organization)
+                if low in _ORG_SUFFIXES:
+                    add(toks[i - 1], NameEntityType.Organization)
+                continue
+            # honorific-introduced or capitalized-bigram mid-sentence span
+            if prev in _HONORIFICS:
+                add(tok, NameEntityType.Person)
+                if i + 1 < len(toks) and toks[i + 1][:1].isupper():
+                    add(toks[i + 1], NameEntityType.Person)
+                continue
+            prev_cap = i > 0 and toks[i - 1][:1].isupper() \
+                and toks[i - 1].lower() not in _HONORIFICS
+            if i > 0 and prev_cap and tags.get(toks[i - 1]) \
+                    and NameEntityType.Person in tags[toks[i - 1]]:
+                add(tok, NameEntityType.Person)
+            elif i > 0 and not prev_cap and i + 1 < len(toks) \
+                    and toks[i + 1][:1].isupper() \
+                    and _strip(toks[i + 1]).lower() not in _ORG_SUFFIXES \
+                    and low not in _LOCATIONS:
+                # mid-sentence capitalized bigram start -> likely Person
+                add(tok, NameEntityType.Person)
+                add(toks[i + 1], NameEntityType.Person)
+        return tags

@@ -152,9 +152,7 @@ class WorkflowRunner:
         elif run_type == RunType.EVALUATE:
             result = self._evaluate(params)
         elif run_type == RunType.STREAMING_SCORE:
-            raise ValueError(
-                "streaming_score needs a batch stream; call "
-                "streaming_score(batches, params) directly")
+            result = self._streaming_score_reader(params)
         else:
             raise ValueError(f"Unknown run type {run_type!r}; "
                              f"one of {RunType.ALL}")
@@ -219,6 +217,38 @@ class WorkflowRunner:
             self.score_reader or self.train_reader, self.evaluator)
         return RunResult(run_type=RunType.EVALUATE,
                          metrics=metrics.to_json())
+
+    def _streaming_score_reader(self, params: OpParams) -> RunResult:
+        """run(STREAMING_SCORE): drain the StreamingReader set as
+        score_reader (reference streamingScore:232-270 drains the
+        DStream), optionally appending scored batches as JSON lines."""
+        from ..readers.streaming import StreamingReader
+        if not isinstance(self.score_reader, StreamingReader):
+            raise ValueError(
+                "streaming_score requires score_reader to be a "
+                "StreamingReader (or call streaming_score(batches, "
+                "params) directly)")
+        n = 0
+        out_path = None
+        sink = None
+        if params.write_location:
+            os.makedirs(params.write_location, exist_ok=True)
+            out_path = os.path.join(params.write_location,
+                                    "scores.jsonl")
+            sink = open(out_path, "w")
+        try:
+            for batch in self.streaming_score(self.score_reader.stream(),
+                                              params):
+                n += len(batch)
+                if sink is not None:
+                    for row in batch:
+                        sink.write(json.dumps(row, default=float) + "\n")
+        finally:
+            if sink is not None:
+                sink.close()
+        return RunResult(run_type=RunType.STREAMING_SCORE,
+                         model_location=params.model_location,
+                         write_location=out_path, n_rows=n)
 
     def streaming_score(self, batches: Iterable[Iterable[dict]],
                         params: Optional[OpParams] = None

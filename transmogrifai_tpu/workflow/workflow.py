@@ -161,6 +161,55 @@ def _fit_and_transform_layers(
     return ds, fitted
 
 
+def check_serializable(result_features: Sequence[Feature]) -> List[str]:
+    """Pre-train serializability audit (reference
+    OpWorkflow.checkSerializable:265 + ClosureUtils): every feature
+    extract fn and stage ctor arg must be importable (module:qualname)
+    for the saved model to round-trip; lambdas/closures survive
+    in-process scoring but are DROPPED by persistence. Returns the list
+    of problems (empty = fully serializable)."""
+    problems: List[str] = []
+
+    def fn_importable(fn) -> bool:
+        mod = getattr(fn, "__module__", None)
+        qual = getattr(fn, "__qualname__", "")
+        return bool(mod and qual and "<" not in qual)
+
+    for layer in topo_layers(result_features):
+        for stage in layer:
+            if isinstance(stage, FeatureGeneratorStage):
+                if not fn_importable(stage.extract_fn):
+                    problems.append(
+                        f"raw feature {stage.get_output().name!r}: "
+                        f"extract fn is a lambda/closure (not importable)")
+                continue
+            for k, v in getattr(stage, "_ctor_args", {}).items():
+                if callable(v) and not isinstance(v, type) \
+                        and not fn_importable(v):
+                    problems.append(
+                        f"stage {type(stage).__name__}({stage.uid}): "
+                        f"ctor arg {k!r} is a lambda/closure "
+                        f"(not importable)")
+    return problems
+
+
+def _validate_distinct_uids(result_features: Sequence[Feature]) -> None:
+    """Every stage in the DAG must have a unique uid — duplicate uids
+    silently alias fitted models during DAG rewiring (reference
+    OpWorkflow.scala:305 validation)."""
+    seen: Dict[str, PipelineStage] = {}
+    for layer in topo_layers(result_features):
+        for stage in layer:
+            other = seen.get(stage.uid)
+            if other is not None and other is not stage:
+                raise ValueError(
+                    f"Duplicate stage uid {stage.uid!r}: "
+                    f"{type(other).__name__} and {type(stage).__name__}. "
+                    f"Each stage instance needs its own uid — don't reuse "
+                    f"one stage object with different inputs")
+            seen[stage.uid] = stage
+
+
 def _transform_with_fitted(layers: List[List[PipelineStage]],
                            fitted: Dict[str, PipelineStage],
                            ds: Dataset) -> Dataset:
@@ -338,6 +387,11 @@ class Workflow:
                 result_features, removed = rewire_without(
                     result_features, results.excluded_names)
                 self.blacklisted_features = tuple(removed)
+        _validate_distinct_uids(result_features)
+        for problem in check_serializable(result_features):
+            _log.warning("serializability: %s — model save/load will "
+                         "drop it (reference checkSerializable, "
+                         "OpWorkflow.scala:265)", problem)
         prefitted = None
         if self._workflow_cv:
             prefitted = self._find_best_with_workflow_cv(result_features, ds)
