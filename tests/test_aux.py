@@ -134,3 +134,38 @@ class TestVersionInfo:
         model.save(path)
         doc = json.loads(open(f"{path}/op-model.json").read())
         assert "versionInfo" in doc and doc["versionInfo"]["version"]
+
+
+class TestProfiling:
+    def test_profile_pretty(self, rng):
+        """Per-stage profile table, slowest first (aux SURVEY 5.5)."""
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.models import LogisticRegression
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.utils.listener import WorkflowListener
+        from transmogrifai_tpu.workflow import Workflow
+        recs = [{"x": float(v), "label": float(v > 0)}
+                for v in rng.normal(size=60)]
+        label = FeatureBuilder.real_nn("label").extract(
+            lambda r: r["label"]).as_response()
+        x = FeatureBuilder.real("x").extract(lambda r: r["x"]).as_predictor()
+        pred = LogisticRegression().set_input(
+            label, transmogrify([x])).get_output()
+        listener = WorkflowListener()
+        (Workflow().set_result_features(label, pred)
+         .set_input_records(recs).with_listener(listener).train())
+        out = listener.metrics.profile_pretty()
+        assert "Stage profile" in out and "% of total" in out
+        assert "LogisticRegression" in out
+        # slowest-first ordering
+        secs = [float(m.seconds) for m in sorted(
+            listener.metrics.stage_metrics, key=lambda m: -m.seconds)]
+        assert secs == sorted(secs, reverse=True)
+
+    def test_device_trace(self, tmp_path):
+        import jax.numpy as jnp
+        from transmogrifai_tpu.utils.jax_setup import device_trace
+        with device_trace(str(tmp_path / "trace")):
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        import os
+        assert any(True for _ in os.scandir(tmp_path / "trace"))

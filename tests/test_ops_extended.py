@@ -400,3 +400,38 @@ class TestTransmogrifyMapRouting:
                           SmartTextMapVectorizer)
         assert isinstance(_dispatch_group(PickListMap, d),
                           TextMapPivotVectorizer)
+
+
+class TestFilterMapAndMapAux:
+    def test_filter_map(self):
+        from transmogrifai_tpu.ops import FilterMap
+        from transmogrifai_tpu.types import TextMap
+        f = _feat("m", TextMap)
+        stage = FilterMap(block_keys=["secret"]).set_input(f)
+        out = stage.transform_value(TextMap({"a": "x", "secret": "y"}))
+        assert out.value == {"a": "x"}
+        allow = FilterMap(allow_keys=["a"]).set_input(_feat("m2", TextMap))
+        assert allow.transform_value(
+            TextMap({"a": "x", "b": "y"})).value == {"a": "x"}
+
+    def test_text_map_len_and_null(self):
+        from transmogrifai_tpu.ops import (TextMapLenEstimator,
+                                           TextMapNullEstimator)
+        ds = Dataset({"m": FeatureColumn.from_values(TextMap, [
+            {"k": "hello world"}, {"k": None, "j": "abc"}, None])})
+        lens = (TextMapLenEstimator().set_input(_feat("m", TextMap))
+                .fit(ds).transform_columns([ds["m"]]))
+        # keys sorted: j, k; row0 k -> len("hello")+len("world") = 10
+        assert lens.data.shape == (3, 2)
+        assert lens.data[0, 1] == 10.0 and lens.data[1, 0] == 3.0
+        nulls = (TextMapNullEstimator().set_input(_feat("m", TextMap))
+                 .fit(ds).transform_columns([ds["m"]]))
+        np.testing.assert_allclose(nulls.data,
+                                   [[1, 0], [0, 1], [1, 1]])
+
+    def test_text_list_null(self):
+        from transmogrifai_tpu.ops import TextListNullTransformer
+        col = FeatureColumn.from_values(TextList, [("a",), (), None])
+        stage = TextListNullTransformer().set_input(_feat("t", TextList))
+        out = stage.transform_columns([col])
+        np.testing.assert_allclose(out.data[:, 0], [0, 1, 1])

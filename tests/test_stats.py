@@ -102,3 +102,39 @@ class TestStreamingHistogram:
         h = StreamingHistogram(16).update(rng.normal(size=100))
         h2 = StreamingHistogram.from_json(h.to_json())
         np.testing.assert_allclose(h.centroids, h2.centroids)
+
+
+class TestNativeHistogramKernel:
+    """C++ merge kernel (native/streaming_histogram.cpp) vs the numpy
+    fallback — same closest-pair semantics, O(k log k)."""
+
+    def test_native_matches_numpy(self, rng):
+        import transmogrifai_tpu.utils.histogram as H
+        from transmogrifai_tpu.utils.histogram import StreamingHistogram
+        pts = rng.normal(size=3000)
+        weights = rng.uniform(0.5, 2.0, size=3000)
+        saved = H._NATIVE
+        try:
+            H._NATIVE = "unset"           # allow native load
+            h_native = StreamingHistogram(40).update(pts, weights)
+            if H._NATIVE is None:
+                pytest.skip("native toolchain unavailable")
+            H._NATIVE = None              # force numpy fallback
+            h_numpy = StreamingHistogram(40).update(pts, weights)
+        finally:
+            H._NATIVE = saved
+        np.testing.assert_allclose(h_native.centroids, h_numpy.centroids,
+                                   rtol=1e-12)
+        np.testing.assert_allclose(h_native.counts, h_numpy.counts,
+                                   rtol=1e-12)
+        assert h_native.total == pytest.approx(weights.sum())
+
+    def test_merge_and_quantiles_with_native(self, rng):
+        from transmogrifai_tpu.utils.histogram import StreamingHistogram
+        a = StreamingHistogram(64).update(rng.normal(size=20_000))
+        b = StreamingHistogram(64).update(rng.normal(loc=3.0,
+                                                     size=20_000))
+        a.merge(b)
+        assert len(a.centroids) <= 64
+        assert 0.9 < a.quantile(0.5) < 2.1    # between the two modes
+        assert a.sum_upto(10.0) == pytest.approx(40_000, rel=1e-6)

@@ -1,0 +1,4 @@
+"""Native (C++) runtime kernels with numpy fallbacks (SURVEY §2.9)."""
+from .build import histogram_merge_kernel, load_kernel
+
+__all__ = ["load_kernel", "histogram_merge_kernel"]

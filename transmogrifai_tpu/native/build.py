@@ -1,0 +1,73 @@
+"""Build-on-demand loader for the native kernels.
+
+The image ships a full C++ toolchain but no pybind11, so native pieces
+are plain ``extern "C"`` shared objects compiled with g++ at first use
+(cached by source hash) and bound with ctypes — the same
+runtime-native-code posture the reference gets from its JNI
+dependencies (SURVEY §2.9), without a build step for pure-Python users:
+every native kernel has a numpy fallback and ``TX_NO_NATIVE=1``
+disables compilation entirely.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_CACHE_DIR = os.environ.get(
+    "TX_NATIVE_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(_SRC_DIR)),
+                 ".native_cache"))
+
+_loaded: dict = {}
+
+
+def load_kernel(source_name: str) -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and dlopen a kernel source from this package;
+    returns None when native is disabled or the build fails (callers
+    fall back to their numpy paths)."""
+    if os.environ.get("TX_NO_NATIVE") == "1":
+        return None
+    if source_name in _loaded:
+        return _loaded[source_name]
+    src = os.path.join(_SRC_DIR, source_name)
+    try:
+        with open(src, "rb") as fh:
+            digest = hashlib.sha1(fh.read()).hexdigest()[:16]
+        so_path = os.path.join(
+            _CACHE_DIR, f"{os.path.splitext(source_name)[0]}-{digest}.so")
+        if not os.path.exists(so_path):
+            os.makedirs(_CACHE_DIR, exist_ok=True)
+            tmp = f"{so_path}.tmp.{os.getpid()}"
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   src, "-o", tmp]
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, so_path)   # atomic vs concurrent builders
+        lib = ctypes.CDLL(so_path)
+    except Exception as e:
+        _log.warning("native kernel %s unavailable (%s); using numpy "
+                     "fallback", source_name, e)
+        lib = None
+    _loaded[source_name] = lib
+    return lib
+
+
+def histogram_merge_kernel():
+    """ctypes binding for hist_merge (streaming_histogram.cpp), or None."""
+    lib = load_kernel("streaming_histogram.cpp")
+    if lib is None:
+        return None
+    fn = lib.hist_merge
+    fn.restype = ctypes.c_int64
+    fn.argtypes = [ctypes.POINTER(ctypes.c_double),
+                   ctypes.POINTER(ctypes.c_double),
+                   ctypes.c_int64, ctypes.c_int64]
+    return fn

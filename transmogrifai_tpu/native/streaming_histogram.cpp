@@ -1,0 +1,78 @@
+// Streaming-histogram bin merging — native kernel.
+//
+// TPU-native counterpart of the reference's one in-tree native-path
+// source (utils/src/main/java/com/salesforce/op/utils/stats/
+// StreamingHistogram.java:36, Ben-Haim/Tom-Tov): given SORTED
+// (centroid, count) bins, repeatedly merge the closest adjacent pair
+// until at most max_bins remain. The Java reference (and the numpy
+// fallback in utils/histogram.py) rescans for the minimum gap each
+// round — O(k^2); here a lazy-deletion min-heap over gap candidates
+// with doubly-linked neighbor indices gives O(k log k), which is what
+// makes batch inserts of ~1e6 raw points per feature practical in
+// RawFeatureFilter.
+//
+// Built on demand by transmogrifai_tpu/native/build.py via
+//   g++ -O2 -shared -fPIC; loaded with ctypes (no pybind11 in image).
+
+#include <cstdint>
+#include <queue>
+#include <utility>
+#include <vector>
+
+extern "C" {
+
+// In-place merge; returns the new bin count. c and n are length `size`,
+// sorted ascending by c; results are compacted into the array prefix.
+int64_t hist_merge(double* c, double* n, int64_t size, int64_t max_bins) {
+    if (size <= max_bins || size < 2) return size;
+
+    std::vector<int64_t> prev(size), next(size);
+    std::vector<bool> dead(size, false);
+    for (int64_t i = 0; i < size; ++i) {
+        prev[i] = i - 1;
+        next[i] = (i + 1 < size) ? i + 1 : -1;
+    }
+
+    // min-heap of (gap, left-index); stale entries are skipped lazily by
+    // re-checking the CURRENT gap when popped. Ties break on the lower
+    // index, matching numpy argmin's first-occurrence rule.
+    using Entry = std::pair<double, int64_t>;
+    std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap;
+    for (int64_t i = 0; i + 1 < size; ++i)
+        heap.push({c[i + 1] - c[i], i});
+
+    int64_t remaining = size;
+    while (remaining > max_bins && !heap.empty()) {
+        auto [gap, i] = heap.top();
+        heap.pop();
+        if (dead[i]) continue;
+        int64_t j = next[i];
+        if (j < 0 || dead[j]) continue;
+        if (c[j] - c[i] != gap) continue;          // stale gap entry
+        // merge j into i (weighted centroid)
+        double tot = n[i] + n[j];
+        c[i] = (c[i] * n[i] + c[j] * n[j]) / tot;
+        n[i] = tot;
+        dead[j] = true;
+        int64_t k = next[j];
+        next[i] = k;
+        if (k >= 0) {
+            prev[k] = i;
+            heap.push({c[k] - c[i], i});
+        }
+        int64_t p = prev[i];
+        if (p >= 0) heap.push({c[i] - c[p], p});
+        --remaining;
+    }
+
+    // compact live bins into the prefix
+    int64_t w = 0;
+    for (int64_t i = 0; i >= 0; i = next[i]) {
+        c[w] = c[i];
+        n[w] = n[i];
+        ++w;
+    }
+    return w;
+}
+
+}  // extern "C"

@@ -24,14 +24,17 @@ from .maps import (BinaryMapVectorizer, DateMapToUnitCircleVectorizer,
                    DateMapToUnitCircleVectorizerModel,
                    GeolocationMapVectorizer,
                    GeolocationMapVectorizerModel, MultiPickListMapVectorizer,
+                   FilterMap,
                    RealMapVectorizer, RealMapVectorizerModel,
                    SmartTextMapVectorizer, SmartTextMapVectorizerModel,
+                   TextMapLenEstimator, TextMapNullEstimator,
                    TextMapPivotVectorizer, TextMapPivotVectorizerModel)
 from .ner import NameEntityRecognizer
 from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
                       RealVectorizerModel)
 from .text import (SmartTextVectorizer, SmartTextVectorizerModel,
-                   TextHashVectorizer, TextListHashVectorizer, TextTokenizer,
+                   TextHashVectorizer, TextListHashVectorizer,
+                   TextListNullTransformer, TextTokenizer,
                    tokenize)
 from .text_advanced import (LDA, LDAModel, CountVectorizer,
                             CountVectorizerModel, TfIdfVectorizer,
@@ -53,6 +56,7 @@ __all__ = [
     "RealMapVectorizer", "RealMapVectorizerModel", "BinaryMapVectorizer",
     "TextMapPivotVectorizer", "TextMapPivotVectorizerModel",
     "MultiPickListMapVectorizer", "GeolocationMapVectorizer",
+    "FilterMap", "TextMapLenEstimator", "TextMapNullEstimator",
     "GeolocationMapVectorizerModel",
     "GeolocationVectorizer", "GeolocationVectorizerModel",
     "NumericBucketizer", "NameEntityRecognizer", "DecisionTreeNumericBucketizer",
@@ -64,6 +68,7 @@ __all__ = [
     "PhoneNumberParser", "EmailToPickList", "UrlToPickList",
     "MimeTypeDetector", "LangDetector", "TextLenTransformer",
     "NGramSimilarity", "JaccardSimilarity", "ToOccurTransformer",
+    "TextListNullTransformer",
     "DropIndicesByTransformer",
     "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
     "TfIdfVectorizerModel", "Word2Vec", "Word2VecModel", "LDA", "LDAModel",

@@ -18,7 +18,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..features.columns import FeatureColumn
-from ..stages.base import SequenceEstimator, SequenceModel
+from ..stages.base import (SequenceEstimator, SequenceModel,
+                           UnaryTransformer)
 from ..types import (BinaryMap, DateMap, GeolocationMap, MultiPickListMap,
                      NumericMap, OPMap, OPVector, TextMap)
 from .vector_utils import (NULL_INDICATOR, OTHER_INDICATOR,
@@ -30,7 +31,8 @@ __all__ = ["RealMapVectorizer", "RealMapVectorizerModel",
            "GeolocationMapVectorizer", "GeolocationMapVectorizerModel",
            "SmartTextMapVectorizer", "SmartTextMapVectorizerModel",
            "DateMapToUnitCircleVectorizer",
-           "DateMapToUnitCircleVectorizerModel"]
+           "DateMapToUnitCircleVectorizerModel", "FilterMap",
+           "TextMapLenEstimator", "TextMapNullEstimator"]
 
 
 def _sorted_keys(cols: List[FeatureColumn],
@@ -541,3 +543,130 @@ class DateMapToUnitCircleVectorizer(SequenceEstimator):
         return DateMapToUnitCircleVectorizerModel(
             keys=_sorted_keys(cols, self.allow_keys),
             time_period=self.time_period)
+
+
+class FilterMap(UnaryTransformer):
+    """Key whitelist/blacklist filtering of any map feature
+    (reference FilterMap.scala:45 with MapPivotParams white/blacklist)."""
+
+    input_types = (OPMap,)
+    output_type = OPMap
+
+    def __init__(self, allow_keys: Optional[Sequence[str]] = None,
+                 block_keys: Optional[Sequence[str]] = None,
+                 clean_keys: bool = False, uid: Optional[str] = None):
+        super().__init__(operation_name="filterMap", uid=uid)
+        self.allow_keys = list(allow_keys) if allow_keys else None
+        self.block_keys = list(block_keys) if block_keys else None
+        self.clean_keys = clean_keys
+
+    def set_input(self, *features):
+        # output type mirrors the concrete input map type
+        out = super().set_input(*features)
+        self.output_type = features[0].ftype
+        return out
+
+    def _clean(self, k: str) -> str:
+        return "".join(ch for ch in str(k) if ch.isalnum()) \
+            if self.clean_keys else str(k)
+
+    def transform_value(self, value):
+        m = value.value if hasattr(value, "value") else value
+        allow = {self._clean(k) for k in self.allow_keys} \
+            if self.allow_keys else None
+        block = {self._clean(k) for k in self.block_keys} \
+            if self.block_keys else set()
+        out = {}
+        for k, v in (m or {}).items():
+            ck = self._clean(k)
+            if (allow is None or ck in allow) and ck not in block:
+                out[ck] = v
+        return self.output_type(out)
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        return FeatureColumn.from_values(
+            self.output_type,
+            [self.transform_value(v) for v in cols[0].data])
+
+
+class TextMapLenEstimator(SequenceEstimator):
+    """Text maps -> per-key total token length columns
+    (reference TextMapLenEstimator.scala:44)."""
+
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="textLenMap", uid=uid)
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]) -> "_TextMapLenModel":
+        return _TextMapLenModel(keys=_sorted_keys(cols, self.allow_keys))
+
+
+class _TextMapLenModel(SequenceModel):
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, keys: List[List[str]], uid: Optional[str] = None):
+        super().__init__(operation_name="textLenMap", uid=uid)
+        self.keys = [list(k) for k in keys]
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        from .text import tokenize
+        blocks, metas = [], []
+        for f, col, keys in zip(self.input_features, cols, self.keys):
+            n = col.n_rows
+            for k in keys:
+                vals = np.zeros(n)
+                for i, m in enumerate(col.data):
+                    v = m.get(k) if m else None
+                    if v is not None:
+                        vals[i] = float(sum(len(t) for t in tokenize(v)))
+                blocks.append(vals)
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__, grouping=k,
+                    descriptor_value="textLen"))
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class TextMapNullEstimator(SequenceEstimator):
+    """Text maps -> per-key null-indicator columns
+    (reference TextMapNullEstimator in TextMapLenEstimator.scala)."""
+
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="textNullMap", uid=uid)
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]) -> "_TextMapNullModel":
+        return _TextMapNullModel(keys=_sorted_keys(cols, self.allow_keys))
+
+
+class _TextMapNullModel(SequenceModel):
+    input_types = (TextMap,)
+    output_type = OPVector
+
+    def __init__(self, keys: List[List[str]], uid: Optional[str] = None):
+        super().__init__(operation_name="textNullMap", uid=uid)
+        self.keys = [list(k) for k in keys]
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        blocks, metas = [], []
+        for f, col, keys in zip(self.input_features, cols, self.keys):
+            n = col.n_rows
+            for k in keys:
+                isnull = np.array(
+                    [0.0 if (m and m.get(k) is not None) else 1.0
+                     for m in col.data])
+                blocks.append(isnull)
+                metas.append(VectorColumnMetadata(
+                    parent_feature_name=f.name,
+                    parent_feature_type=f.ftype.__name__, grouping=k,
+                    indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)

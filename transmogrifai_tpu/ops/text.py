@@ -224,3 +224,29 @@ class TextHashVectorizer(SequenceModel):
                                       self.track_nulls, self.binary_freq))
             metas.extend(_hash_metas(f, self.num_hashes, self.track_nulls))
         return vector_output(self.get_output().name, blocks, metas)
+
+
+class TextListNullTransformer(SequenceModel):
+    """Text lists -> per-feature null/empty indicator column
+    (reference TextListNullTransformer.scala)."""
+
+    from ..types import TextList as _TL
+    input_types = (_TL,)
+    output_type = OPVector
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(operation_name="textListNull", uid=uid)
+
+    def transform_columns(self, cols):
+        import numpy as _np
+        from .vector_utils import NULL_INDICATOR, VectorColumnMetadata, \
+            vector_output
+        blocks, metas = [], []
+        for f, col in zip(self.input_features, cols):
+            blocks.append(_np.array(
+                [0.0 if toks else 1.0 for toks in col.data]))
+            metas.append(VectorColumnMetadata(
+                parent_feature_name=f.name,
+                parent_feature_type=f.ftype.__name__, grouping=f.name,
+                indicator_value=NULL_INDICATOR))
+        return vector_output(self.get_output().name, blocks, metas)

@@ -15,6 +15,19 @@ import numpy as np
 __all__ = ["StreamingHistogram"]
 
 
+def _native_merge():
+    """Lazily-loaded C++ merge kernel (native/streaming_histogram.cpp);
+    None -> numpy fallback."""
+    global _NATIVE
+    if _NATIVE == "unset":
+        from ..native import histogram_merge_kernel
+        _NATIVE = histogram_merge_kernel()
+    return _NATIVE
+
+
+_NATIVE = "unset"
+
+
 class StreamingHistogram:
     """Fixed-size histogram of (centroid, count) bins supporting merge and
     interpolated sum/quantile queries."""
@@ -60,16 +73,28 @@ class StreamingHistogram:
         c = np.concatenate([self.centroids, cents])
         n = np.concatenate([self.counts, cnts])
         order = np.argsort(c)
-        c, n = c[order], n[order]
-        # repeatedly merge the closest pair until within max_bins
-        while c.size > self.max_bins:
-            gaps = np.diff(c)
-            i = int(np.argmin(gaps))
-            tot = n[i] + n[i + 1]
-            c[i] = (c[i] * n[i] + c[i + 1] * n[i + 1]) / tot
-            n[i] = tot
-            c = np.delete(c, i + 1)
-            n = np.delete(n, i + 1)
+        c, n = np.ascontiguousarray(c[order]), np.ascontiguousarray(n[order])
+        if c.size > self.max_bins:
+            kernel = _native_merge()
+            if kernel is not None:
+                # O(k log k) heap merge in C++ (native/
+                # streaming_histogram.cpp); same closest-pair semantics
+                import ctypes
+                size = kernel(
+                    c.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    n.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    c.size, self.max_bins)
+                c, n = c[:size].copy(), n[:size].copy()
+            else:
+                # numpy fallback: rescan for the closest pair each round
+                while c.size > self.max_bins:
+                    gaps = np.diff(c)
+                    i = int(np.argmin(gaps))
+                    tot = n[i] + n[i + 1]
+                    c[i] = (c[i] * n[i] + c[i + 1] * n[i + 1]) / tot
+                    n[i] = tot
+                    c = np.delete(c, i + 1)
+                    n = np.delete(n, i + 1)
         self.centroids, self.counts = c, n
 
     # -- queries -----------------------------------------------------------

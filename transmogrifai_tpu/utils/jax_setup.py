@@ -11,7 +11,29 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["enable_compilation_cache"]
+__all__ = ["enable_compilation_cache", "device_trace"]
+
+
+def device_trace(log_dir: str):
+    """Context manager around ``jax.profiler`` tracing: per-op device
+    timelines viewable in TensorBoard/Perfetto — the accelerator-level
+    profile the reference leaves to the Spark UI (aux SURVEY §5.5).
+
+    >>> with device_trace("/tmp/trace"):
+    ...     model = workflow.train()
+    """
+    import contextlib
+
+    import jax
+
+    @contextlib.contextmanager
+    def _trace():
+        jax.profiler.start_trace(log_dir)
+        try:
+            yield log_dir
+        finally:
+            jax.profiler.stop_trace()
+    return _trace()
 
 _DEFAULT_CACHE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(

@@ -57,6 +57,24 @@ class AppMetrics:
                 "appDurationSeconds": round(self.app_duration, 3),
                 "stageMetrics": [m.to_json() for m in self.stage_metrics]}
 
+    def profile_pretty(self, top: int = 0) -> str:
+        """Human per-stage profile, slowest first — the role of the
+        reference's Spark-UI stage table (aux SURVEY §5.5); rendered
+        with the same Table util summaryPretty uses."""
+        from .table import Table
+        rows = sorted(self.stage_metrics, key=lambda m: -m.seconds)
+        if top:
+            rows = rows[:top]
+        total = sum(m.seconds for m in self.stage_metrics) or 1.0
+        t = Table(
+            ["stage", "phase", "seconds", "% of total", "rows"],
+            [[m.stage_name, m.phase, f"{m.seconds:.3f}",
+              f"{100.0 * m.seconds / total:.1f}%", m.n_rows]
+             for m in rows],
+            name=f"Stage profile ({self.app_name}, "
+                 f"{self.app_duration:.2f}s wall)")
+        return t.pretty()
+
 
 class WorkflowListener:
     """Attach via ``Workflow.with_listener`` to collect per-stage metrics
