@@ -1,0 +1,75 @@
+"""Synthetic-scale throughput measurement (BASELINE.md config 4).
+
+Generates an n-row tabular matrix (numeric + one-hot-ish binary blocks,
+the shape a transmogrified wide dataset takes), then times the two
+heavyweight paths: histogram-GBT boosting and bootstrap random-forest
+fitting. Prints one JSON line per model with rows/sec.
+
+Run:  python examples/scale_bench.py [--rows 200000] [--cols 100]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_data(rows: int, cols: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    n_num = max(cols // 5, 1)
+    X_num = rng.normal(size=(rows, n_num))
+    X_bin = (rng.uniform(size=(rows, cols - n_num)) < 0.15).astype(float)
+    X = np.concatenate([X_num, X_bin], axis=1)
+    logits = X_num[:, 0] + X_bin[:, :3].sum(axis=1) - 0.5
+    y = (logits + rng.logistic(size=rows) * 0.5 > 0).astype(float)
+    return X, y
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--cols", type=int, default=100)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (the env may register a "
+                         "remote TPU platform that wins over "
+                         "JAX_PLATFORMS)")
+    args = ap.parse_args()
+
+    if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from transmogrifai_tpu.utils.jax_setup import enable_compilation_cache
+    enable_compilation_cache()
+    from transmogrifai_tpu.models.trees import (GBTClassifier,
+                                                RandomForestClassifier)
+
+    X, y = make_data(args.rows, args.cols)
+    for name, est in [
+        ("gbt_20rounds_d6", GBTClassifier(num_rounds=20, max_depth=6)),
+        ("rf_50trees_d6",
+         RandomForestClassifier(num_trees=50, max_depth=6,
+                                min_instances_per_node=10)),
+    ]:
+        t0 = time.perf_counter()
+        model = est.fit_arrays(X, y)
+        fit_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        pred = model.predict_arrays(X[:50_000])
+        score_s = time.perf_counter() - t0
+        acc = float(np.mean(pred.data == y[:50_000]))
+        print(json.dumps({
+            "model": name, "rows": args.rows, "cols": args.cols,
+            "fit_seconds": round(fit_s, 2),
+            "fit_rows_per_sec": round(args.rows / fit_s),
+            "score_rows_per_sec": round(50_000 / max(score_s, 1e-9)),
+            "train_subset_acc": round(acc, 4)}))
+
+
+if __name__ == "__main__":
+    main()
