@@ -146,10 +146,13 @@ def default_selector(num_folds: int = 3, seed: int = 42):
         num_folds=num_folds, seed=seed, stratify=True)
 
 
-def run(csv_path: str = None, model_stage=None, verbose: bool = True):
+def run(csv_path: str = None, model_stage=None, verbose: bool = True,
+        workflow_cv: bool = False):
     """Train on a 75% split, evaluate on the 25% holdout.
 
-    Returns (metrics, wall_clock_seconds, model).
+    ``workflow_cv=True`` enables leakage-free workflow-level CV (every
+    label-consuming selector ancestor refit per fold; reference
+    withWorkflowCV). Returns (metrics, wall_clock_seconds, model).
     """
     records = load_titanic(csv_path)
     train, test = stratified_split(records)
@@ -161,6 +164,8 @@ def run(csv_path: str = None, model_stage=None, verbose: bool = True):
     wf = (Workflow()
           .set_result_features(survived, prediction)
           .set_input_records(train))
+    if workflow_cv:
+        wf = wf.with_workflow_cv()
     model = wf.train()
     evaluator = BinaryClassificationEvaluator(
         label_col="survived", prediction_col=prediction.name)

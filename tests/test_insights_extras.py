@@ -154,3 +154,36 @@ class TestLogLoss:
         with pytest.raises(ValueError):
             ev.evaluate_arrays(np.array([]), PredictionColumn.from_arrays(
                 np.array([])))
+
+
+class TestCorrModelPersistence:
+    def test_ctor_args_round_trip(self, rng, tmp_path):
+        """RecordInsightsCorrModel survives the persistence codec
+        (arrays + vector metadata in ctor args)."""
+        from transmogrifai_tpu.insights import RecordInsightsCorrModel
+        from transmogrifai_tpu.workflow.persistence import (decode_value,
+                                                            encode_value)
+        meta = VectorMetadata(name="fv", columns=[
+            VectorColumnMetadata(parent_feature_name="f0",
+                                 parent_feature_type="Real")])
+        model = RecordInsightsCorrModel(
+            score_corr=rng.normal(size=(2, 1)),
+            norm_shift=np.zeros(1), norm_scale=np.ones(1),
+            top_k=5, metadata=meta)
+        arrays = {}
+        enc = {k: encode_value(v, arrays, k)
+               for k, v in model._ctor_args.items()}
+        dec = {k: decode_value(v, arrays) for k, v in enc.items()}
+        clone = RecordInsightsCorrModel(**dec)
+        np.testing.assert_allclose(clone.score_corr, model.score_corr)
+        assert clone.metadata.columns[0].parent_feature_name == "f0"
+        # and the clone produces identical insights
+        X = rng.normal(size=(4, 1))
+        pred = PredictionColumn.from_arrays(
+            np.zeros(4), probability=np.full((4, 2), 0.5))
+        fcol = FeatureColumn.vector(X, meta)
+        a = model.transform_columns([pred, fcol])
+        b = clone.transform_columns([pred, fcol])
+        va = [m.value if hasattr(m, 'value') else m for m in a.data]
+        vb = [m.value if hasattr(m, 'value') else m for m in b.data]
+        assert va == vb

@@ -435,3 +435,27 @@ class TestFilterMapAndMapAux:
         stage = TextListNullTransformer().set_input(_feat("t", TextList))
         out = stage.transform_columns([col])
         np.testing.assert_allclose(out.data[:, 0], [0, 1, 1])
+
+
+class TestFilterMapSpec(StageSpecBase):
+    def build(self):
+        from transmogrifai_tpu.ops import FilterMap
+        ds = Dataset({"m": FeatureColumn.from_values(TextMap, [
+            {"a": "x", "b": "y"}, {"b": "z"}, None])})
+        return FilterMap(block_keys=["b"]).set_input(_feat("m", TextMap)), ds
+
+
+class TestTextMapLenSpec(StageSpecBase):
+    def build(self):
+        from transmogrifai_tpu.ops import TextMapLenEstimator
+        ds = Dataset({"m": FeatureColumn.from_values(TextMap, [
+            {"k": "one two"}, {"j": "abc"}, None])})
+        return TextMapLenEstimator().set_input(_feat("m", TextMap)), ds
+
+
+class TestTextMapNullSpec(StageSpecBase):
+    def build(self):
+        from transmogrifai_tpu.ops import TextMapNullEstimator
+        ds = Dataset({"m": FeatureColumn.from_values(TextMap, [
+            {"k": "v"}, None])})
+        return TextMapNullEstimator().set_input(_feat("m", TextMap)), ds

@@ -79,6 +79,10 @@ class Evaluators:
             return MultiClassificationEvaluator(default_metric="Recall", **kw)
 
         @staticmethod
+        def log_loss(**kw) -> LogLossEvaluator:
+            return LogLossEvaluator(**kw)
+
+        @staticmethod
         def error(**kw) -> MultiClassificationEvaluator:
             return MultiClassificationEvaluator(default_metric="Error", **kw)
 
