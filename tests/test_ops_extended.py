@@ -459,3 +459,41 @@ class TestTextMapNullSpec(StageSpecBase):
         ds = Dataset({"m": FeatureColumn.from_values(TextMap, [
             {"k": "v"}, None])})
         return TextMapNullEstimator().set_input(_feat("m", TextMap)), ds
+
+
+class TestCollectionAndMapBucketizer:
+    def test_collection_transformer_lifts_scalar(self):
+        from transmogrifai_tpu.ops import (CollectionTransformer,
+                                           TextLenTransformer)
+        from transmogrifai_tpu.types import IntegralMap
+        f = _feat("m", TextMap)
+        ct = CollectionTransformer(TextLenTransformer(),
+                                   output_type=IntegralMap).set_input(f)
+        out = ct.transform_value(TextMap({"a": "hello", "b": "xy"}))
+        assert out.value == {"a": 5, "b": 2}
+        col = FeatureColumn.from_values(TextMap, [{"a": "xyz"}, None])
+        res = ct.transform_columns([col])
+        assert res.data[0] == {"a": 3}
+
+    def test_dt_numeric_map_bucketizer(self, rng):
+        from transmogrifai_tpu.ops import DecisionTreeNumericMapBucketizer
+        n = 200
+        x = rng.normal(size=n)
+        y = (x > 0).astype(float)
+        rows = [{"v": float(x[i]), "noise": float(rng.normal())}
+                for i in range(n)]
+        ds = Dataset({
+            "label": FeatureColumn.from_values(RealNN, y.tolist()),
+            "m": FeatureColumn.from_values(RealMap, rows)})
+        label = _feat("label", RealNN, response=True)
+        stage = DecisionTreeNumericMapBucketizer(
+            min_instances_per_node=5).set_input(label, _feat("m", RealMap))
+        model = stage.fit(ds)
+        assert set(model.keys) == {"noise", "v"}
+        # the informative key found a split near 0
+        v_splits = [s for s in model.split_points["v"]
+                    if np.isfinite(s)]
+        assert v_splits and min(abs(s) for s in v_splits) < 0.5
+        out = model.transform_columns([ds["label"], ds["m"]])
+        groups = {c.grouping for c in out.metadata.columns}
+        assert groups == {"noise", "v"}

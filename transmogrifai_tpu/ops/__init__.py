@@ -1,6 +1,7 @@
 """Automated feature engineering: vectorizers + Transmogrifier (SURVEY §2.5;
 core/.../stages/impl/feature/)."""
 from .bucketizers import (DecisionTreeNumericBucketizer,
+                           DecisionTreeNumericMapBucketizer,
                           DecisionTreeNumericBucketizerModel,
                           DescalerTransformer, NumericBucketizer,
                           PercentileCalibrator, PercentileCalibratorModel,
@@ -29,6 +30,7 @@ from .maps import (BinaryMapVectorizer, DateMapToUnitCircleVectorizer,
                    SmartTextMapVectorizer, SmartTextMapVectorizerModel,
                    TextMapLenEstimator, TextMapNullEstimator,
                    TextMapPivotVectorizer, TextMapPivotVectorizerModel)
+from .derived import CollectionTransformer
 from .ner import NameEntityRecognizer
 from .numeric import (BinaryVectorizer, IntegralVectorizer, RealVectorizer,
                       RealVectorizerModel)
@@ -60,7 +62,8 @@ __all__ = [
     "GeolocationMapVectorizerModel",
     "GeolocationVectorizer", "GeolocationVectorizerModel",
     "NumericBucketizer", "NameEntityRecognizer", "DecisionTreeNumericBucketizer",
-    "DecisionTreeNumericBucketizerModel", "PercentileCalibrator",
+    "DecisionTreeNumericBucketizerModel",
+    "DecisionTreeNumericMapBucketizer", "PercentileCalibrator",
     "PercentileCalibratorModel", "ScalerTransformer", "DescalerTransformer",
     "ScalingType",
     "StringIndexer", "StringIndexerModel", "IndexToString",
@@ -68,7 +71,7 @@ __all__ = [
     "PhoneNumberParser", "EmailToPickList", "UrlToPickList",
     "MimeTypeDetector", "LangDetector", "TextLenTransformer",
     "NGramSimilarity", "JaccardSimilarity", "ToOccurTransformer",
-    "TextListNullTransformer",
+    "TextListNullTransformer", "CollectionTransformer",
     "DropIndicesByTransformer",
     "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
     "TfIdfVectorizerModel", "Word2Vec", "Word2VecModel", "LDA", "LDAModel",

@@ -21,7 +21,7 @@ PercentileCalibrator.scala, ScalerTransformer.scala}):
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -45,7 +45,8 @@ class ScalingType:
 
 def _bucket_block(vals: np.ndarray, splits: Sequence[float],
                   feature, track_nulls: bool,
-                  bucket_labels: Optional[Sequence[str]] = None
+                  bucket_labels: Optional[Sequence[str]] = None,
+                  grouping: Optional[str] = None
                   ) -> Tuple[List[np.ndarray], List[VectorColumnMetadata]]:
     """One-hot bucket membership columns for ascending ``splits``
     (buckets are [s_i, s_{i+1}) as in the reference/Spark Bucketizer)."""
@@ -58,17 +59,18 @@ def _bucket_block(vals: np.ndarray, splits: Sequence[float],
     block[np.arange(len(vals))[~isnan], idx[~isnan]] = 1.0
     labels = list(bucket_labels) if bucket_labels else [
         f"{splits[i]}-{splits[i + 1]}" for i in range(n_buckets)]
+    group = grouping if grouping is not None else feature.name
     metas = [VectorColumnMetadata(
         parent_feature_name=feature.name,
         parent_feature_type=feature.ftype.__name__,
-        grouping=feature.name, indicator_value=lab) for lab in labels]
+        grouping=group, indicator_value=lab) for lab in labels]
     blocks = [block]
     if track_nulls:
         blocks.append(isnan.astype(np.float64))
         metas.append(VectorColumnMetadata(
             parent_feature_name=feature.name,
             parent_feature_type=feature.ftype.__name__,
-            grouping=feature.name, indicator_value=NULL_INDICATOR))
+            grouping=group, indicator_value=NULL_INDICATOR))
     return blocks, metas
 
 
@@ -269,3 +271,81 @@ class DescalerTransformer(BinaryTransformer):
     def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
         vals = np.asarray(cols[0].data, dtype=np.float64)
         return FeatureColumn(ftype=Real, data=self._scaler()._descale(vals))
+
+
+class DecisionTreeNumericMapBucketizerModel(AllowLabelAsInput, BinaryModel):
+    from ..types import NumericMap as _NM
+    input_types = (RealNN, _NM)
+    output_type = OPVector
+
+    def __init__(self, keys: Sequence[str],
+                 split_points: Dict[str, Sequence[float]],
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(operation_name="dtNumMapBucket", uid=uid)
+        self.keys = list(keys)
+        self.split_points = {k: [float(s) for s in v]
+                             for k, v in split_points.items()}
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        col = cols[-1]
+        n = col.n_rows
+        blocks, metas = [], []
+        for k in self.keys:
+            vals = np.full(n, np.nan)
+            for i, m in enumerate(col.data):
+                if m and k in m and m[k] is not None:
+                    vals[i] = float(m[k])
+            b, me = _bucket_block(vals, self.split_points[k],
+                                  self.input_features[-1],
+                                  self.track_nulls, grouping=k)
+            blocks.extend(b)
+            metas.extend(me)
+        return vector_output(self.get_output().name, blocks, metas)
+
+
+class DecisionTreeNumericMapBucketizer(AllowLabelAsInput, BinaryEstimator):
+    """Per-KEY label-aware buckets for numeric maps
+    (reference DecisionTreeNumericMapBucketizer.scala) — each key gets
+    its own single-feature decision-tree split points."""
+
+    from ..types import NumericMap as _NM
+    input_types = (RealNN, _NM)
+    output_type = OPVector
+
+    def __init__(self, max_depth: int = 2, max_bins: int = 32,
+                 min_info_gain: float = 0.01,
+                 min_instances_per_node: int = 1,
+                 track_nulls: bool = True,
+                 allow_keys: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="dtNumMapBucket", uid=uid)
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
+        self.track_nulls = track_nulls
+        self.allow_keys = list(allow_keys) if allow_keys else None
+
+    def fit_columns(self, cols: List[FeatureColumn]
+                    ) -> DecisionTreeNumericMapBucketizerModel:
+        from .maps import _sorted_keys
+        y = np.asarray(cols[0].data, dtype=np.float64)
+        keys = _sorted_keys([cols[1]], self.allow_keys)[0]
+        scalar = DecisionTreeNumericBucketizer(
+            max_depth=self.max_depth, max_bins=self.max_bins,
+            min_info_gain=self.min_info_gain,
+            min_instances_per_node=self.min_instances_per_node,
+            track_nulls=self.track_nulls)
+        scalar.input_features = self.input_features
+        splits: Dict[str, List[float]] = {}
+        for k in keys:
+            vals = np.full(len(y), np.nan)
+            for i, m in enumerate(cols[1].data):
+                if m and k in m and m[k] is not None:
+                    vals[i] = float(m[k])
+            vcol = FeatureColumn(ftype=self.input_types[1], data=vals)
+            sub = scalar.fit_columns([cols[0], vcol])
+            splits[k] = sub.split_points
+        return DecisionTreeNumericMapBucketizerModel(
+            keys=keys, split_points=splits, track_nulls=self.track_nulls)

@@ -290,3 +290,62 @@ class DropIndicesByTransformer(UnaryTransformer):
         return FeatureColumn.vector(
             np.asarray(vec.data, dtype=np.float64)[:, keep],
             meta.select(keep, name=self.get_output().name))
+
+
+class CollectionTransformer(UnaryTransformer):
+    """Lift a scalar unary transformer over a collection feature
+    (reference OPCollectionTransformer.scala: OPMap/OPList/OPSet
+    variants wrapping any Text/Numeric transformer): map VALUES / list /
+    set ELEMENTS are boxed into the inner stage's input type, pushed
+    through its ``transform_value``, and unboxed back into the same
+    collection shape."""
+
+    from ..types import OPCollection as _OPC, OPMap as _OPM
+    input_types = (object,)   # concrete collection type set at set_input
+    output_type = None
+
+    def __init__(self, inner, output_type=None, uid: Optional[str] = None):
+        super().__init__(
+            operation_name=f"collection_{inner.operation_name}"
+            if hasattr(inner, "operation_name") else "collection",
+            uid=uid)
+        self.inner = inner
+        self._out_override = output_type
+
+    def set_input(self, *features):
+        out = super().set_input(*features)
+        self.output_type = self._out_override or features[0].ftype
+        if not getattr(self.inner, "input_features", ()):
+            # wire the inner stage to a synthetic element-typed feature
+            # so its row path has an input to describe
+            from ..features.builder import FeatureBuilder
+            dummy = FeatureBuilder.of(
+                f"{features[0].name}_element",
+                self.inner.input_types[0]).extract(
+                lambda r: None).as_predictor()
+            self.inner.set_input(dummy)
+        return out
+
+    def _apply_scalar(self, v):
+        inner_in = self.inner.input_types[0]
+        boxed = self.inner.transform_value(inner_in(v))
+        return boxed.value if hasattr(boxed, "value") else boxed
+
+    def transform_value(self, value):
+        from ..types import OPMap, OPList, OPSet
+        raw = value.value if hasattr(value, "value") else value
+        ftype = self.output_type
+        if raw is None:
+            return ftype(None)
+        if issubclass(ftype, OPMap):
+            return ftype({k: self._apply_scalar(v)
+                          for k, v in raw.items()})
+        if issubclass(ftype, OPSet):
+            return ftype({self._apply_scalar(v) for v in raw})
+        return ftype(tuple(self._apply_scalar(v) for v in raw))
+
+    def transform_columns(self, cols):
+        from ..features.columns import FeatureColumn
+        return FeatureColumn.from_values(
+            self.output_type,
+            [self.transform_value(v) for v in cols[0].data])
