@@ -150,3 +150,28 @@ class TestGridSupport:
         assert est2.max_depth == 9 and est2.num_trees == 3
         assert est.max_depth == 5  # original untouched
         assert type(est2) is RandomForestClassifier
+
+
+class TestHistogramModes:
+    """scatter vs matmul histogram strategies must produce IDENTICAL
+    trees (models/trees._hist_mode; matmul rides the MXU on TPU)."""
+
+    def test_modes_agree(self, rng, monkeypatch):
+        import numpy as np
+        from transmogrifai_tpu.models.trees import (GBTClassifier,
+                                                    RandomForestClassifier)
+        X = rng.normal(size=(300, 12))
+        X[:, 6:] = (X[:, 6:] > 0).astype(float)   # binary block
+        y = (X[:, 0] + X[:, 6] > 0.3).astype(float)
+        fits = {}
+        for mode in ("scatter", "matmul"):
+            monkeypatch.setenv("TX_TREE_HIST", mode)
+            fits[mode] = (
+                GBTClassifier(num_rounds=8, max_depth=4).fit_arrays(X, y),
+                RandomForestClassifier(num_trees=4, max_depth=6,
+                                       min_instances_per_node=5
+                                       ).fit_arrays(X, y))
+        for a, b in zip(fits["scatter"], fits["matmul"]):
+            np.testing.assert_allclose(a.thrs, b.thrs, rtol=1e-6)
+            np.testing.assert_allclose(a.feats, b.feats)
+            np.testing.assert_allclose(a.leaves, b.leaves, rtol=1e-5)

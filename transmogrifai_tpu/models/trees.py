@@ -171,17 +171,54 @@ _DEFAULT_NODE_CAP = 256
 _HIST_CHUNK_ELEMS = 32_000_000
 
 
+def _hist_mode() -> str:
+    """Histogram strategy: "scatter" (fused segment_sum — best on CPU)
+    or "matmul" (one-hot contractions that ride the MXU — best on TPU,
+    where XLA scatters serialize). Auto by backend; TX_TREE_HIST
+    overrides."""
+    import os
+    mode = os.environ.get("TX_TREE_HIST")
+    if mode in ("scatter", "matmul"):
+        return mode
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        platform = "cpu"
+    return "scatter" if platform == "cpu" else "matmul"
+
+
+def _bin_indicator(packed: jnp.ndarray, total_bins: int,
+                   dtype) -> jnp.ndarray:
+    """(n, TB) 0/1 bin-membership matrix: feature bin ranges are
+    DISJOINT in the packed axis, so each row has exactly d ones. Built
+    with ONE scatter per tree and reused by every level's matmul-mode
+    histogram (amortizing scatter cost that would otherwise recur per
+    level on TPU, where XLA scatters serialize)."""
+    n = packed.shape[0]
+    return jnp.zeros((n, total_bins), dtype).at[
+        jnp.arange(n)[:, None], packed].set(1.0)
+
+
 def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
                       stats: jnp.ndarray, num_slots: int,
-                      total_bins: int) -> jnp.ndarray:
-    """(num_slots, total_bins, S) histograms via fused scatter-adds over
-    feature blocks (segment id = slot*TB + packed bin) — no serial
-    per-feature scan; on TPU each block is one large segment_sum that
-    XLA lowers to a vectorized scatter. Blocks bound the broadcasted
-    (n x d_block x S) scatter input to ~_HIST_CHUNK_ELEMS elements so
-    wide matrices don't materialize an O(n*d) stats tensor at once."""
+                      total_bins: int,
+                      bin_oh: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """(num_slots, total_bins, S) histograms. Two mathematically
+    identical strategies (see _hist_mode):
+
+    - scatter (bin_oh None): fused segment_sum per feature block
+      (segment id = slot*TB + packed bin), blocks bounding the
+      broadcasted (n x d_block x S) scatter input to _HIST_CHUNK_ELEMS;
+    - matmul (bin_oh given): hist[c,b,s] = sum_i 1[slot_i=c] *
+      binOH[i,b] * stats[i,s] — S dense contractions on the MXU, no
+      per-level scatters. Peak memory is the (n, TB) indicator built
+      once per tree.
+    """
     n, d = packed.shape
     s_dim = stats.shape[1]
+    if bin_oh is not None:
+        slot_oh = jax.nn.one_hot(slot, num_slots, dtype=stats.dtype)
+        return jnp.einsum("nc,ns,nb->cbs", slot_oh, stats, bin_oh)
     n_chunks = max(1, -(- (n * d * s_dim) // _HIST_CHUNK_ELEMS))
     step = -(-d // n_chunks)
     segs = num_slots * total_bins
@@ -231,11 +268,13 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     feat_heap = jnp.zeros((heap_len,), jnp.int32)[:2 ** depth - 1]
     thr_heap = jnp.full((heap_len,), jnp.inf, stats.dtype)[:2 ** depth - 1]
     not_a_split = ~jnp.isfinite(packed_thr)     # last + padded bins
+    bin_oh = (_bin_indicator(packed, TB, stats.dtype)
+              if _hist_mode() == "matmul" else None)
     key = feat_key
     for level in range(depth):
         C = min(2 ** level, cap)                   # static slots this level
         slot, node_of_slot, active = _compress_nodes(node, C)
-        hist = _level_histograms(packed, slot, stats, C, TB)
+        hist = _level_histograms(packed, slot, stats, C, TB, bin_oh)
         cs = jnp.cumsum(hist, axis=1)              # packed-axis running sum
         # per-feature segmented cumsum: subtract the running sum at the
         # owning block's start; splitting at bin b sends bins<=b left
