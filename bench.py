@@ -23,7 +23,10 @@ import time
 BASELINE_AUPR = 0.8225
 #: watchdog for the ambient-backend (TPU) attempt; generous enough for
 #: cold remote compiles, small enough to leave room for the CPU fallback
-INNER_TIMEOUT_S = int(os.environ.get("TX_BENCH_TPU_TIMEOUT", "900"))
+INNER_TIMEOUT_S = int(os.environ.get("TX_BENCH_TPU_TIMEOUT", "600"))
+#: cheap init probe before committing to the long attempt — a hung
+#: tunnel costs 60 s here instead of the full watchdog
+PROBE_TIMEOUT_S = int(os.environ.get("TX_BENCH_PROBE_TIMEOUT", "60"))
 
 
 def _measure() -> dict:
@@ -83,27 +86,47 @@ def _parse_result(stdout: str) -> dict | None:
     return None
 
 
+def _probe_ambient() -> tuple[bool, str]:
+    """Initialize the ambient backend in a disposable child under a
+    short timeout; a hung tunnel is detected here for PROBE_TIMEOUT_S
+    instead of burning the full measurement watchdog."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT_S)
+        if r.returncode == 0 and r.stdout.strip():
+            return True, r.stdout.strip().splitlines()[-1]
+        return False, (f"ambient backend failed rc={r.returncode}: "
+                       + r.stderr.strip()[-200:])
+    except subprocess.TimeoutExpired:
+        return False, f"ambient backend init hung > {PROBE_TIMEOUT_S}s"
+    except Exception as e:  # pragma: no cover - defensive
+        return False, f"probe error: {e!r}"
+
+
 def main() -> None:
     # attempt 1: ambient backend (TPU when the tunnel is up) in a child
-    # the watchdog can kill — covers init AND mid-run hangs
-    note = ""
-    try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__),
-                            "--inner"],
-                           capture_output=True, text=True,
-                           timeout=INNER_TIMEOUT_S,
-                           cwd=os.path.dirname(os.path.abspath(__file__)))
-        out = _parse_result(r.stdout)
-        if r.returncode == 0 and out is not None and out.get("value"):
-            print(json.dumps(out))
-            return
-        note = (f"ambient run rc={r.returncode}: "
-                + (out or {}).get("error_msg",
-                                  r.stderr.strip()[-300:]))[:400]
-    except subprocess.TimeoutExpired:
-        note = f"ambient backend run hung > {INNER_TIMEOUT_S}s"
-    except Exception as e:  # pragma: no cover - defensive
-        note = f"ambient attempt error: {e!r}"
+    # the watchdog can kill — covers init AND mid-run hangs. A cheap
+    # probe gates the long attempt so a dead tunnel fails fast.
+    healthy, note = _probe_ambient()
+    if healthy:
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"],
+                capture_output=True, text=True, timeout=INNER_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            out = _parse_result(r.stdout)
+            if r.returncode == 0 and out is not None and out.get("value"):
+                print(json.dumps(out))
+                return
+            note = (f"ambient run rc={r.returncode}: "
+                    + (out or {}).get("error_msg",
+                                      r.stderr.strip()[-300:]))[:400]
+        except subprocess.TimeoutExpired:
+            note = f"ambient backend run hung > {INNER_TIMEOUT_S}s"
+        except Exception as e:  # pragma: no cover - defensive
+            note = f"ambient attempt error: {e!r}"
 
     # attempt 2: forced-CPU in-process measurement (cannot hang)
     try:

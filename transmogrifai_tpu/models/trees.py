@@ -171,11 +171,14 @@ _DEFAULT_NODE_CAP = 256
 _HIST_CHUNK_ELEMS = 32_000_000
 
 
-def _hist_mode() -> str:
-    """Histogram strategy: "scatter" (fused segment_sum — best on CPU)
-    or "matmul" (one-hot contractions that ride the MXU — best on TPU,
-    where XLA scatters serialize). Auto by backend; TX_TREE_HIST
-    overrides."""
+def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
+    """Histogram strategy: "scatter" (fused segment_sum) or "matmul"
+    (one-hot contractions that ride the MXU). Auto: matmul on
+    accelerators (XLA scatters serialize there) and for small problems
+    on CPU (dense BLAS beats the scatter for n*TB up to a few million);
+    scatter for large problems on CPU where the contraction FLOPs
+    explode. TX_TREE_HIST overrides. Decided at trace time from static
+    shapes, so both modes stay available side by side."""
     import os
     mode = os.environ.get("TX_TREE_HIST")
     if mode in ("scatter", "matmul"):
@@ -184,7 +187,9 @@ def _hist_mode() -> str:
         platform = jax.default_backend()
     except Exception:
         platform = "cpu"
-    return "scatter" if platform == "cpu" else "matmul"
+    if platform != "cpu":
+        return "matmul"
+    return "matmul" if 0 < n * total_bins <= 2_000_000 else "scatter"
 
 
 def _bin_indicator(packed: jnp.ndarray, total_bins: int,
@@ -269,7 +274,7 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     thr_heap = jnp.full((heap_len,), jnp.inf, stats.dtype)[:2 ** depth - 1]
     not_a_split = ~jnp.isfinite(packed_thr)     # last + padded bins
     bin_oh = (_bin_indicator(packed, TB, stats.dtype)
-              if _hist_mode() == "matmul" else None)
+              if _hist_mode(n, TB) == "matmul" else None)
     key = feat_key
     for level in range(depth):
         C = min(2 ** level, cap)                   # static slots this level
