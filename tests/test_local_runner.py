@@ -164,3 +164,71 @@ class TestWorkflowRunner:
     def test_unknown_run_type(self):
         with pytest.raises(ValueError, match="Unknown run type"):
             WorkflowRunner().run("bogus")
+
+
+def test_runner_avro_score_sink(tmp_path, rng):
+    """score_format="avro" writes scores as an Avro container
+    (reference RichDataset.saveAvro score output)."""
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.models import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.utils.avro_io import read_avro
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.workflow.runner import (OpParams, RunType,
+                                                   WorkflowRunner)
+    recs = [{"x": float(v), "label": float(v > 0)}
+            for v in rng.normal(size=50)]
+    label = FeatureBuilder.real_nn("label").extract(
+        lambda r: r["label"]).as_response()
+    x = FeatureBuilder.real("x").extract(lambda r: r["x"]).as_predictor()
+    pred = LogisticRegression().set_input(
+        label, transmogrify([x])).get_output()
+    model = (Workflow().set_result_features(label, pred)
+             .set_input_records(recs).train())
+    mdir = str(tmp_path / "model")
+    model.save(mdir)
+    runner = WorkflowRunner(score_reader=recs[:20])
+    res = runner.run(RunType.SCORE, OpParams(
+        model_location=mdir, write_location=str(tmp_path / "out"),
+        score_format="avro"))
+    assert res.write_location.endswith("scores.avro")
+    rows = read_avro(res.write_location)
+    assert len(rows) == 20 and pred.name in rows[0]
+    import json as _json
+    parsed = _json.loads(rows[0][pred.name])
+    assert "prediction" in parsed
+
+
+def test_score_sink_non_numeric_maps(tmp_path):
+    """Map/collection result values survive both sinks (review finding:
+    float() coercion crashed TextMap-valued results)."""
+    import json as _json
+    from transmogrifai_tpu.features.columns import (Dataset,
+                                                    FeatureColumn)
+    from transmogrifai_tpu.types import MultiPickList, TextMap
+    from transmogrifai_tpu.utils.avro_io import read_avro
+    from transmogrifai_tpu.workflow.runner import WorkflowRunner
+
+    class _F:
+        def __init__(self, name):
+            self.name = name
+
+    class _M:
+        result_features = [_F("tags"), _F("picks")]
+
+    ds = Dataset({
+        "tags": FeatureColumn.from_values(TextMap, [
+            {"a": "x"}, {"b": "y"}]),
+        "picks": FeatureColumn.from_values(MultiPickList, [
+            {"p", "q"}, set()])})
+    runner = WorkflowRunner()
+    out = runner._write_scores(ds, _M(), str(tmp_path / "j"), "json")
+    rows = _json.load(open(out))
+    assert rows[0]["tags"] == {"a": "x"}
+    assert sorted(rows[0]["picks"]) == ["p", "q"]
+    out = runner._write_scores(ds, _M(), str(tmp_path / "a"), "avro")
+    arows = read_avro(out)
+    assert _json.loads(arows[0]["tags"]) == {"a": "x"}
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="score_format"):
+        runner._write_scores(ds, _M(), str(tmp_path / "x"), "parquet")

@@ -46,6 +46,8 @@ class OpParams:
     write_location: Optional[str] = None
     metrics_location: Optional[str] = None
     batch_size: int = 1000
+    #: "json" | "avro" — format for saved scores (reference writes Avro)
+    score_format: str = "json"
     custom_params: Dict[str, Any] = field(default_factory=dict)
     custom_tag_name: Optional[str] = None
     custom_tag_value: Optional[str] = None
@@ -58,6 +60,7 @@ class OpParams:
                 "writeLocation": self.write_location,
                 "metricsLocation": self.metrics_location,
                 "batchSize": self.batch_size,
+                "scoreFormat": self.score_format,
                 "customParams": self.custom_params,
                 "customTagName": self.custom_tag_name,
                 "customTagValue": self.custom_tag_value,
@@ -72,6 +75,7 @@ class OpParams:
             write_location=d.get("writeLocation"),
             metrics_location=d.get("metricsLocation"),
             batch_size=d.get("batchSize", 1000),
+            score_format=d.get("scoreFormat", "json"),
             custom_params=d.get("customParams", {}),
             custom_tag_name=d.get("customTagName"),
             custom_tag_value=d.get("customTagValue"),
@@ -196,7 +200,8 @@ class WorkflowRunner:
         n = scored.n_rows
         write = None
         if params.write_location:
-            write = self._write_scores(scored, model, params.write_location)
+            write = self._write_scores(scored, model, params.write_location,
+                                       params.score_format)
         return RunResult(run_type=RunType.SCORE, write_location=write,
                          model_location=params.model_location, n_rows=n)
 
@@ -264,9 +269,34 @@ class WorkflowRunner:
             yield fn.score_batch(list(batch))
 
     # -- output ------------------------------------------------------------
-    def _write_scores(self, scored, model, location: str) -> str:
+    @staticmethod
+    def _jsonable(v):
+        """Boxed feature value -> JSON-representable value (arrays and
+        tuples to lists, sets to sorted lists, numpy scalars unboxed);
+        recurses through maps and collections."""
+        if isinstance(v, np.ndarray):
+            v = v.tolist()
+        if isinstance(v, (np.floating, np.integer)):
+            return v.item()
+        if isinstance(v, dict):
+            return {str(k): WorkflowRunner._jsonable(x)
+                    for k, x in v.items()}
+        if isinstance(v, (set, frozenset)):
+            return sorted(WorkflowRunner._jsonable(x) for x in v)
+        if isinstance(v, (list, tuple)):
+            return [WorkflowRunner._jsonable(x) for x in v]
+        return v
+
+    def _write_scores(self, scored, model, location: str,
+                      fmt: str = "json") -> str:
+        """Persist result-feature rows; fmt "json" or "avro" (the
+        reference saves scores as Avro, RichDataset.saveAvro;
+        OpParams.score_format selects). Map/collection values stay
+        structured in JSON and flatten to JSON strings for the
+        flat-record Avro schema."""
+        if fmt not in ("json", "avro"):
+            raise ValueError(f"score_format must be json|avro, got {fmt!r}")
         os.makedirs(location, exist_ok=True)
-        out = os.path.join(location, "scores.json")
         names = [f.name for f in model.result_features]
         rows = []
         for i in range(scored.n_rows):
@@ -274,11 +304,18 @@ class WorkflowRunner:
             for name in names:
                 col = scored[name]
                 boxed = col.boxed(i)
-                v = boxed.value if hasattr(boxed, "value") else boxed
-                if isinstance(v, np.ndarray):
-                    v = v.tolist()
+                v = self._jsonable(
+                    boxed.value if hasattr(boxed, "value") else boxed)
+                if fmt == "avro" and isinstance(v, (dict, list)):
+                    v = json.dumps(v)
                 row[name] = v
             rows.append(row)
+        if fmt == "avro":
+            from ..utils.avro_io import write_avro
+            out = os.path.join(location, "scores.avro")
+            write_avro(out, rows)
+            return out
+        out = os.path.join(location, "scores.json")
         with open(out, "w") as fh:
             json.dump(rows, fh)
         return out
