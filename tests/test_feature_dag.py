@@ -151,3 +151,53 @@ class TestDataset:
         batch = stage.transform_columns([col]).data.tolist()
         rows = [stage.transform_value(v).value for v in [1.5, 2.5]]
         assert batch == rows
+
+
+class TestDslEnrichments:
+    """Reference dsl/Rich*Feature shortcut coverage."""
+
+    def test_numeric_and_text_sugar(self, rng):
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.features.columns import Dataset, \
+            FeatureColumn
+        from transmogrifai_tpu.types import Real, Text
+        from transmogrifai_tpu.workflow import Workflow
+        x = FeatureBuilder.real("x").extract(
+            lambda r: r["x"]).as_predictor()
+        t = FeatureBuilder.text("t").extract(
+            lambda r: r["t"]).as_predictor()
+        buck = x.bucketize([-10.0, 0.0, 10.0])
+        vec = x.vectorize()
+        toks = t.tokenize()
+        smart = t.smart_vectorize(max_cardinality=2, num_hashes=8,
+                                  min_support=1)
+        combined = buck.combine(vec, smart)
+        recs = [{"x": float(v), "t": f"word{i % 5} common"}
+                for i, v in enumerate(rng.normal(size=30))]
+        model = (Workflow()
+                 .set_result_features(combined, toks)
+                 .set_input_records(recs).train())
+        scored = model.score(recs)
+        assert scored[combined.name].data.shape[0] == 30
+        assert scored[combined.name].data.shape[1] >= 4
+        assert isinstance(scored[toks.name].data[0], tuple)
+
+    def test_auto_bucketize_and_lda(self, rng):
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.workflow import Workflow
+        y = FeatureBuilder.real_nn("y").extract(
+            lambda r: r["y"]).as_response()
+        x = FeatureBuilder.real("x").extract(
+            lambda r: r["x"]).as_predictor()
+        t = FeatureBuilder.text("t").extract(
+            lambda r: r["t"]).as_predictor()
+        ab = x.auto_bucketize(y, min_instances_per_node=5)
+        topics = t.tokenize().lda(k=3, max_iter=3)
+        recs = [{"x": float(v), "y": float(v > 0),
+                 "t": "alpha beta gamma delta"}
+                for v in rng.normal(size=60)]
+        model = (Workflow().set_result_features(y, ab, topics)
+                 .set_input_records(recs).train())
+        scored = model.score(recs)
+        assert scored[topics.name].data.shape == (60, 3)
+        assert scored[ab.name].data.shape[0] == 60

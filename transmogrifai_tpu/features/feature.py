@@ -221,6 +221,58 @@ class Feature:
         return AliasTransformer(alias=name, output_type=self.ftype
                                 ).set_input(self).get_output()
 
+    def bucketize(self, split_points, bucket_labels=None,
+                  track_nulls: bool = True) -> "Feature":
+        """One-hot bucket membership for a numeric feature
+        (reference RichNumericFeature.bucketize)."""
+        from ..ops.bucketizers import NumericBucketizer
+        return NumericBucketizer(split_points=split_points,
+                                 bucket_labels=bucket_labels,
+                                 track_nulls=track_nulls
+                                 ).set_input(self).get_output()
+
+    def auto_bucketize(self, label: "Feature", **params) -> "Feature":
+        """Label-aware decision-tree buckets
+        (reference RichNumericFeature.autoBucketize)."""
+        from ..ops.bucketizers import DecisionTreeNumericBucketizer
+        return DecisionTreeNumericBucketizer(**params).set_input(
+            label, self).get_output()
+
+    def tokenize(self, **params) -> "Feature":
+        """Text -> TextList tokens (reference RichTextFeature.tokenize)."""
+        from ..ops.text import TextTokenizer
+        return TextTokenizer(**params).set_input(self).get_output()
+
+    def vectorize(self, track_nulls: bool = True) -> "Feature":
+        """Default numeric vectorization with null tracking
+        (reference RichNumericFeature.vectorize:325)."""
+        from ..ops.numeric import RealVectorizer
+        return RealVectorizer(track_nulls=track_nulls
+                              ).set_input(self).get_output()
+
+    def smart_vectorize(self, max_cardinality: int = 30, top_k: int = 20,
+                        min_support: int = 10, num_hashes: int = 512,
+                        track_nulls: bool = True) -> "Feature":
+        """Pivot-or-hash text vectorization
+        (reference RichTextFeature.smartVectorize)."""
+        from ..ops.text import SmartTextVectorizer
+        return SmartTextVectorizer(
+            max_cardinality=max_cardinality, top_k=top_k,
+            min_support=min_support, num_hashes=num_hashes,
+            track_nulls=track_nulls).set_input(self).get_output()
+
+    def combine(self, *others: "Feature") -> "Feature":
+        """Concatenate OPVector features
+        (reference RichVectorFeature.combine)."""
+        from ..ops.combiner import VectorsCombiner
+        return VectorsCombiner().set_input(self, *others).get_output()
+
+    def lda(self, k: int = 10, **params) -> "Feature":
+        """Topic-distribution vector from a token list
+        (reference RichVectorFeature.lda)."""
+        from ..ops.text_advanced import LDA
+        return LDA(k=k, **params).set_input(self).get_output()
+
     # -- dunder ------------------------------------------------------------
     def __repr__(self) -> str:
         kind = "response" if self.is_response else "predictor"
