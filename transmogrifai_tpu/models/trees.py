@@ -90,7 +90,8 @@ class _PackedDesign:
     """
 
     __slots__ = ("packed", "feat_of", "block_start", "packed_thr",
-                 "binned", "col_thr", "max_width", "n", "d", "total_bins")
+                 "binned", "col_thr", "widths", "max_width", "n", "d",
+                 "total_bins")
 
     def __init__(self, X: np.ndarray, max_bins: int):
         X = np.asarray(X, dtype=np.float64)
@@ -122,6 +123,7 @@ class _PackedDesign:
         #: pool gathers) and (d, max_width) per-feature thresholds
         #: (+inf padded = not-a-split)
         self.binned = np.stack(binned_cols, axis=1)
+        self.widths = np.asarray(widths, dtype=np.int64)
         self.max_width = int(max(widths))
         self.col_thr = np.full((d, self.max_width), np.inf)
         for f in range(d):
@@ -406,33 +408,74 @@ def _variance_gain(min_instances: float):
 # jitted fit programs
 # ---------------------------------------------------------------------------
 
-def _tree_pool(pkey, binned, col_thr, pool_size: int):
-    """Per-tree feature pool: gather ``pool_size`` random columns into a
-    uniform-width packed sub-design. Histogram/scatter work then scales
-    with the pool, not the full feature count — per-node max_features
+#: feature widths <= this form the "narrow" pool class (one-hot-ish
+#: columns); wider columns form the other. Stratified per-tree pools
+#: then use per-class bin widths instead of the global max, cutting
+#: pooled-histogram width ~(global_max / 2) x on one-hot-heavy data
+_NARROW_WIDTH = 4
+
+
+def _pool_classes(widths: np.ndarray, pool_size: int, max_features: int):
+    """Host-side stratified pool plan from per-feature bin widths:
+    ((narrow_idx, wide_idx) host arrays, (Pn, Pw, Bn, Bw) static ints,
+    effective per-node max_features)."""
+    narrow = np.nonzero(widths <= _NARROW_WIDTH)[0].astype(np.int32)
+    wide = np.nonzero(widths > _NARROW_WIDTH)[0].astype(np.int32)
+    d = len(widths)
+    p_n = min(len(narrow), int(round(pool_size * len(narrow) / d)))
+    p_w = min(len(wide), pool_size - p_n)
+    p_n = min(len(narrow), pool_size - p_w)   # hand leftovers back
+    b_n = int(widths[narrow].max()) if len(narrow) and p_n else 0
+    b_w = int(widths[wide].max()) if len(wide) and p_w else 0
+    return ((narrow, wide), (p_n, p_w, b_n, b_w),
+            min(max_features, p_n + p_w))
+
+
+def _tree_pool(pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg):
+    """Per-tree STRATIFIED feature pool: sample narrow and wide columns
+    separately (proportional to their population) and pack them with
+    per-class bin widths. Histogram work then scales with the pooled
+    bins, not feature_count x global_max_bins — per-node max_features
     sampling applies WITHIN the pool (documented deviation from MLlib's
     per-node-over-all-features sampling; across a 50-tree forest the
     pools cover the full feature set many times over)."""
-    d = binned.shape[1]
-    maxB = col_thr.shape[1]
-    pool = jax.random.choice(pkey, d, (pool_size,), replace=False)
-    offs = jnp.arange(pool_size, dtype=jnp.int32) * maxB
-    packed_sub = jnp.take(binned, pool, axis=1) + offs[None, :]
-    thr_sub = col_thr[pool].reshape(pool_size * maxB)
-    feat_of_sub = jnp.repeat(jnp.arange(pool_size, dtype=jnp.int32), maxB)
-    block_start_sub = jnp.repeat(offs, maxB)
-    return pool, packed_sub, feat_of_sub, block_start_sub, thr_sub
+    p_n, p_w, b_n, b_w = pool_cfg
+    kn, kw = jax.random.split(pkey)
+    parts_pool, parts_packed, parts_thr = [], [], []
+    parts_feat, parts_block = [], []
+    base_bin = 0
+    base_feat = 0
+    for key, idx, p, b in ((kn, narrow_idx, p_n, b_n),
+                           (kw, wide_idx, p_w, b_w)):
+        if p == 0:
+            continue
+        sel = idx[jax.random.choice(key, idx.shape[0], (p,),
+                                    replace=False)]
+        offs = base_bin + jnp.arange(p, dtype=jnp.int32) * b
+        parts_pool.append(sel)
+        parts_packed.append(jnp.take(binned, sel, axis=1) + offs[None, :])
+        parts_thr.append(col_thr[sel][:, :b].reshape(p * b))
+        parts_feat.append(base_feat
+                          + jnp.repeat(jnp.arange(p, dtype=jnp.int32), b))
+        parts_block.append(jnp.repeat(offs, b))
+        base_bin += p * b
+        base_feat += p
+    return (jnp.concatenate(parts_pool),
+            jnp.concatenate(parts_packed, axis=1),
+            jnp.concatenate(parts_feat),
+            jnp.concatenate(parts_block),
+            jnp.concatenate(parts_thr))
 
 
 @functools.partial(
     jax.jit, static_argnames=("depth", "num_classes", "num_trees",
-                              "max_features", "pool_size", "impurity",
+                              "max_features", "pool_cfg", "impurity",
                               "bootstrap"))
 def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
-                           binned, col_thr, y, key,
+                           binned, col_thr, narrow_idx, wide_idx, y, key,
                            *, depth: int, num_classes: int, num_trees: int,
                            max_features: Optional[int],
-                           pool_size: Optional[int], impurity: str,
+                           pool_cfg: Optional[tuple], impurity: str,
                            min_instances: float, min_info_gain: float,
                            subsample: float, bootstrap: bool):
     n, d = packed.shape
@@ -447,9 +490,9 @@ def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
             w = jax.random.poisson(wkey, subsample, (n,)).astype(dtype)
         else:
             w = jnp.ones((n,), dtype)
-        if pool_size is not None and pool_size < d:
+        if pool_cfg is not None:
             pool, p_sub, fo_sub, bs_sub, thr_sub = _tree_pool(
-                pkey, binned, col_thr, pool_size)
+                pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg)
             feat, thr, leaf_stats, _ = _grow_tree(
                 p_sub, fo_sub, bs_sub, thr_sub,
                 onehot * w[:, None], depth=depth, gain_fn=gain_fn,
@@ -472,12 +515,12 @@ def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
 
 @functools.partial(
     jax.jit, static_argnames=("depth", "num_trees", "max_features",
-                              "pool_size", "bootstrap"))
+                              "pool_cfg", "bootstrap"))
 def _fit_forest_regressor(packed, feat_of, block_start, packed_thr,
-                          binned, col_thr, y, key,
+                          binned, col_thr, narrow_idx, wide_idx, y, key,
                           *, depth: int, num_trees: int,
                           max_features: Optional[int],
-                          pool_size: Optional[int],
+                          pool_cfg: Optional[tuple],
                           min_instances: float, min_info_gain: float,
                           subsample: float, bootstrap: bool):
     n, d = packed.shape
@@ -491,9 +534,9 @@ def _fit_forest_regressor(packed, feat_of, block_start, packed_thr,
         else:
             w = jnp.ones((n,), dtype)
         stats = jnp.stack([w, w * y, w * y * y], axis=1)
-        if pool_size is not None and pool_size < d:
+        if pool_cfg is not None:
             pool, p_sub, fo_sub, bs_sub, thr_sub = _tree_pool(
-                pkey, binned, col_thr, pool_size)
+                pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg)
             feat, thr, leaf_stats, _ = _grow_tree(
                 p_sub, fo_sub, bs_sub, thr_sub, stats, depth=depth,
                 gain_fn=gain_fn, min_info_gain=min_info_gain, feat_key=fkey,
@@ -710,17 +753,19 @@ _DESIGN_CACHE_SIZE = 8
 
 
 def _design_args(X: np.ndarray, max_bins: int):
-    """Host-bin X and return the device-ready design arrays:
-    (packed, feat_of, block_start, packed_thr, binned, col_thr)."""
+    """Host-bin X and return ((packed, feat_of, block_start, packed_thr,
+    binned, col_thr) device arrays, widths host array)."""
     key = (id(X), getattr(X, "shape", None), max_bins)
     hit = _DESIGN_CACHE.get(key)
     if hit is not None and hit[0] is X:
         _DESIGN_CACHE.move_to_end(key)
         return hit[1]
     design = _PackedDesign(X, max_bins)
-    args = (jnp.asarray(design.packed), jnp.asarray(design.feat_of),
-            jnp.asarray(design.block_start), jnp.asarray(design.packed_thr),
-            jnp.asarray(design.binned), jnp.asarray(design.col_thr))
+    args = ((jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+             jnp.asarray(design.block_start),
+             jnp.asarray(design.packed_thr),
+             jnp.asarray(design.binned), jnp.asarray(design.col_thr)),
+            design.widths)
     _DESIGN_CACHE[key] = (X, args)
     while len(_DESIGN_CACHE) > _DESIGN_CACHE_SIZE:
         _DESIGN_CACHE.popitem(last=False)
@@ -735,6 +780,18 @@ def _pool_size(d: int, mf: Optional[int]) -> Optional[int]:
     return min(d, max(4 * mf, 8))
 
 
+def _pool_plan(widths: np.ndarray, mf: Optional[int]):
+    """((narrow_idx, wide_idx) device arrays, pool_cfg static tuple,
+    effective max_features) — or (dummies, None, mf) when no pooling."""
+    d = len(widths)
+    pool = _pool_size(d, mf)
+    empty = jnp.zeros((0,), jnp.int32)
+    if pool is None:
+        return (empty, empty), None, mf
+    (narrow, wide), cfg, mf_eff = _pool_classes(widths, pool, mf)
+    return ((jnp.asarray(narrow), jnp.asarray(wide)), cfg, mf_eff)
+
+
 class _ForestClassifierBase(Predictor):
     num_trees = 1
     bootstrap = False
@@ -745,11 +802,13 @@ class _ForestClassifierBase(Predictor):
         d = X.shape[1]
         mf = _resolve_max_features(self.feature_subset_strategy, d, True) \
             if self.bootstrap else None
+        design, widths = _design_args(X, self.max_bins)
+        (narrow, wide), pool_cfg, mf = _pool_plan(widths, mf)
         feats, thrs, leaves = _fit_forest_classifier(
-            *_design_args(X, self.max_bins), jnp.asarray(y),
+            *design, narrow, wide, jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
             num_classes=k, num_trees=self.num_trees, max_features=mf,
-            pool_size=_pool_size(d, mf), impurity=self.impurity,
+            pool_cfg=pool_cfg, impurity=self.impurity,
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap)
@@ -767,11 +826,13 @@ class _ForestRegressorBase(Predictor):
         d = X.shape[1]
         mf = _resolve_max_features(self.feature_subset_strategy, d, False) \
             if self.bootstrap else None
+        design, widths = _design_args(X, self.max_bins)
+        (narrow, wide), pool_cfg, mf = _pool_plan(widths, mf)
         feats, thrs, leaves = _fit_forest_regressor(
-            *_design_args(X, self.max_bins), jnp.asarray(y),
+            *design, narrow, wide, jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
             num_trees=self.num_trees, max_features=mf,
-            pool_size=_pool_size(d, mf),
+            pool_cfg=pool_cfg,
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap)
@@ -894,7 +955,7 @@ class GBTClassifier(Predictor):
                 f"{bad.tolist()} — use RandomForestClassifier or "
                 f"LogisticRegression for multiclass")
         feats, thrs, leaves, base = _fit_gbt(
-            *_design_args(X, self.max_bins)[:4], jnp.asarray(y),
+            *_design_args(X, self.max_bins)[0][:4], jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
             num_rounds=self.num_rounds,
             step_size=self.step_size, reg_lambda=self.reg_lambda,
@@ -926,7 +987,7 @@ class GBTRegressor(Predictor):
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTRegressorModel:
         feats, thrs, leaves, base = _fit_gbt(
-            *_design_args(X, self.max_bins)[:4], jnp.asarray(y),
+            *_design_args(X, self.max_bins)[0][:4], jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
             num_rounds=self.num_rounds,
             step_size=self.step_size, reg_lambda=self.reg_lambda,
