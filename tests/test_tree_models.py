@@ -175,3 +175,23 @@ class TestHistogramModes:
             np.testing.assert_allclose(a.thrs, b.thrs, rtol=1e-6)
             np.testing.assert_allclose(a.feats, b.feats)
             np.testing.assert_allclose(a.leaves, b.leaves, rtol=1e-5)
+
+
+class TestPoolPlan:
+    """Stratified feature-pool planning edge cases (review findings)."""
+
+    def test_minority_class_never_starved(self):
+        import numpy as np
+        from transmogrifai_tpu.models.trees import _pool_classes
+        widths = np.array([2] * 3 + [32] * 997)
+        (_, _), (p_n, p_w, b_n, b_w), _ = _pool_classes(widths, 124, 31)
+        assert p_n >= 1 and p_w >= 1
+        widths = np.array([32] + [2] * 999)
+        (_, _), (p_n, p_w, _, _), _ = _pool_classes(widths, 124, 31)
+        assert p_n >= 1 and p_w >= 1
+
+    def test_full_coverage_pool_uses_exact_design(self):
+        import numpy as np
+        from transmogrifai_tpu.models.trees import _pool_plan
+        (_, _), cfg, mf = _pool_plan(np.array([2] * 8), 2)
+        assert cfg is None and mf == 2

@@ -422,9 +422,15 @@ def _pool_classes(widths: np.ndarray, pool_size: int, max_features: int):
     narrow = np.nonzero(widths <= _NARROW_WIDTH)[0].astype(np.int32)
     wide = np.nonzero(widths > _NARROW_WIDTH)[0].astype(np.int32)
     d = len(widths)
+    # proportional split, but every NON-EMPTY class keeps >= 1 slot so no
+    # feature is deterministically unreachable across the whole forest
     p_n = min(len(narrow), int(round(pool_size * len(narrow) / d)))
+    if len(narrow):
+        p_n = max(p_n, 1)
     p_w = min(len(wide), pool_size - p_n)
-    p_n = min(len(narrow), pool_size - p_w)   # hand leftovers back
+    if len(wide):
+        p_w = max(p_w, 1)
+    p_n = min(len(narrow), max(pool_size - p_w, 1 if len(narrow) else 0))
     b_n = int(widths[narrow].max()) if len(narrow) and p_n else 0
     b_w = int(widths[wide].max()) if len(wide) and p_w else 0
     return ((narrow, wide), (p_n, p_w, b_n, b_w),
@@ -786,7 +792,9 @@ def _pool_plan(widths: np.ndarray, mf: Optional[int]):
     d = len(widths)
     pool = _pool_size(d, mf)
     empty = jnp.zeros((0,), jnp.int32)
-    if pool is None:
+    if pool is None or pool >= d:
+        # pool covers everything: the shared pre-packed design is both
+        # exact and free of per-tree gather/pad work
         return (empty, empty), None, mf
     (narrow, wide), cfg, mf_eff = _pool_classes(widths, pool, mf)
     return ((jnp.asarray(narrow), jnp.asarray(wide)), cfg, mf_eff)
