@@ -169,3 +169,25 @@ def test_distinct_uid_validation(rng):
           .set_input_records([{"a": 1.0, "b": 2.0}]))
     with _pytest.raises(ValueError, match="Duplicate stage uid"):
         wf.train()
+
+
+def test_batched_grid_respects_estimator_defaults(rng):
+    """Grid dicts omitting a param inherit the ESTIMATOR's configured
+    value in the batched kernel, matching with_params semantics
+    (r3 review finding)."""
+    X, y = _toy(rng, n=160, d=4)
+    est = LogisticRegression(reg_param=0.2, max_iter=50)
+    grid = [{"elastic_net_param": 0.5}]     # reg_param omitted -> 0.2
+    cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                         stratify=True)
+    best = cv.validate([(est, grid)], X, y)
+
+    class _Seq(LogisticRegression):
+        def fit_fold_grid_arrays(self, *a, **k):
+            raise NotImplementedError
+
+    seq = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                          stratify=True).validate(
+        [(_Seq(reg_param=0.2, max_iter=50), grid)], X, y)
+    np.testing.assert_allclose(best.results[0].metric_values,
+                               seq.results[0].metric_values, atol=2e-3)
