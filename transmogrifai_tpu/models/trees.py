@@ -473,6 +473,61 @@ def _tree_pool(pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg):
             jnp.concatenate(parts_thr))
 
 
+def _forest_body(packed, feat_of, block_start, packed_thr,
+                 binned, col_thr, narrow_idx, wide_idx, y, key, mask,
+                 min_instances, min_info_gain, subsample, *, kind: str,
+                 depth: int, num_classes: int, num_trees: int,
+                 max_features: Optional[int], pool_cfg: Optional[tuple],
+                 impurity: str, bootstrap: bool):
+    """Shared forest program: ``mask`` (n,) row weights let one body
+    serve the single fit (mask=ones), the fold x grid batched kernel
+    (mask = fold membership, traced per-candidate hyperparams), and the
+    "models"-axis mesh path — masked rows contribute nothing to
+    histograms or leaves, which is exactly fitting on the subset."""
+    n, d = packed.shape
+    dtype = packed_thr.dtype
+    if kind == "cls":
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes,
+                                dtype=dtype)
+        gain_fn = (_gini_gain(min_instances) if impurity == "gini"
+                   else _entropy_gain(min_instances))
+    else:
+        gain_fn = _variance_gain(min_instances)
+
+    def one_tree(carry, tkey):
+        pkey, wkey, fkey = jax.random.split(tkey, 3)
+        if bootstrap:
+            w = jax.random.poisson(wkey, subsample, (n,)).astype(dtype)
+        else:
+            w = jnp.ones((n,), dtype)
+        w = w * mask
+        stats = (onehot * w[:, None] if kind == "cls"
+                 else jnp.stack([w, w * y, w * y * y], axis=1))
+        if pool_cfg is not None:
+            pool, p_sub, fo_sub, bs_sub, thr_sub = _tree_pool(
+                pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg)
+            feat, thr, leaf_stats, _ = _grow_tree(
+                p_sub, fo_sub, bs_sub, thr_sub, stats, depth=depth,
+                gain_fn=gain_fn, min_info_gain=min_info_gain,
+                feat_key=fkey, max_features=max_features, feat_map=pool)
+        else:
+            feat, thr, leaf_stats, _ = _grow_tree(
+                packed, feat_of, block_start, packed_thr, stats,
+                depth=depth, gain_fn=gain_fn,
+                min_info_gain=min_info_gain, feat_key=fkey,
+                max_features=max_features)
+        if kind == "cls":
+            lw = jnp.sum(leaf_stats, axis=-1, keepdims=True)
+            leaf = jnp.where(lw > 0, leaf_stats / jnp.maximum(lw, 1e-12),
+                             1.0 / num_classes)
+        else:
+            leaf = leaf_stats[:, 1] / jnp.maximum(leaf_stats[:, 0], 1e-12)
+        return carry, (feat, thr, leaf)
+    _, (feats, thrs, leaves) = jax.lax.scan(
+        one_tree, None, jax.random.split(key, num_trees))
+    return feats, thrs, leaves
+
+
 @functools.partial(
     jax.jit, static_argnames=("depth", "num_classes", "num_trees",
                               "max_features", "pool_cfg", "impurity",
@@ -484,39 +539,13 @@ def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
                            pool_cfg: Optional[tuple], impurity: str,
                            min_instances: float, min_info_gain: float,
                            subsample: float, bootstrap: bool):
-    n, d = packed.shape
-    dtype = packed_thr.dtype
-    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=dtype)
-    gain_fn = (_gini_gain(min_instances) if impurity == "gini"
-               else _entropy_gain(min_instances))
-
-    def one_tree(carry, tkey):
-        pkey, wkey, fkey = jax.random.split(tkey, 3)
-        if bootstrap:
-            w = jax.random.poisson(wkey, subsample, (n,)).astype(dtype)
-        else:
-            w = jnp.ones((n,), dtype)
-        if pool_cfg is not None:
-            pool, p_sub, fo_sub, bs_sub, thr_sub = _tree_pool(
-                pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg)
-            feat, thr, leaf_stats, _ = _grow_tree(
-                p_sub, fo_sub, bs_sub, thr_sub,
-                onehot * w[:, None], depth=depth, gain_fn=gain_fn,
-                min_info_gain=min_info_gain, feat_key=fkey,
-                max_features=max_features, feat_map=pool)
-        else:
-            feat, thr, leaf_stats, _ = _grow_tree(
-                packed, feat_of, block_start, packed_thr,
-                onehot * w[:, None], depth=depth, gain_fn=gain_fn,
-                min_info_gain=min_info_gain, feat_key=fkey,
-                max_features=max_features)
-        lw = jnp.sum(leaf_stats, axis=-1, keepdims=True)
-        probs = jnp.where(lw > 0, leaf_stats / jnp.maximum(lw, 1e-12),
-                          1.0 / num_classes)
-        return carry, (feat, thr, probs)
-    _, (feats, thrs, leaves) = jax.lax.scan(
-        one_tree, None, jax.random.split(key, num_trees))
-    return feats, thrs, leaves
+    return _forest_body(
+        packed, feat_of, block_start, packed_thr, binned, col_thr,
+        narrow_idx, wide_idx, y, key, jnp.ones_like(y), min_instances,
+        min_info_gain, subsample, kind="cls", depth=depth,
+        num_classes=num_classes, num_trees=num_trees,
+        max_features=max_features, pool_cfg=pool_cfg, impurity=impurity,
+        bootstrap=bootstrap)
 
 
 @functools.partial(
@@ -529,52 +558,31 @@ def _fit_forest_regressor(packed, feat_of, block_start, packed_thr,
                           pool_cfg: Optional[tuple],
                           min_instances: float, min_info_gain: float,
                           subsample: float, bootstrap: bool):
-    n, d = packed.shape
-    dtype = packed_thr.dtype
-    gain_fn = _variance_gain(min_instances)
-
-    def one_tree(carry, tkey):
-        pkey, wkey, fkey = jax.random.split(tkey, 3)
-        if bootstrap:
-            w = jax.random.poisson(wkey, subsample, (n,)).astype(dtype)
-        else:
-            w = jnp.ones((n,), dtype)
-        stats = jnp.stack([w, w * y, w * y * y], axis=1)
-        if pool_cfg is not None:
-            pool, p_sub, fo_sub, bs_sub, thr_sub = _tree_pool(
-                pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg)
-            feat, thr, leaf_stats, _ = _grow_tree(
-                p_sub, fo_sub, bs_sub, thr_sub, stats, depth=depth,
-                gain_fn=gain_fn, min_info_gain=min_info_gain, feat_key=fkey,
-                max_features=max_features, feat_map=pool)
-        else:
-            feat, thr, leaf_stats, _ = _grow_tree(
-                packed, feat_of, block_start, packed_thr, stats, depth=depth,
-                gain_fn=gain_fn, min_info_gain=min_info_gain, feat_key=fkey,
-                max_features=max_features)
-        vals = leaf_stats[:, 1] / jnp.maximum(leaf_stats[:, 0], 1e-12)
-        return carry, (feat, thr, vals)
-    _, (feats, thrs, leaves) = jax.lax.scan(
-        one_tree, None, jax.random.split(key, num_trees))
-    return feats, thrs, leaves
+    return _forest_body(
+        packed, feat_of, block_start, packed_thr, binned, col_thr,
+        narrow_idx, wide_idx, y, key, jnp.ones_like(y), min_instances,
+        min_info_gain, subsample, kind="reg", depth=depth, num_classes=0,
+        num_trees=num_trees, max_features=max_features, pool_cfg=pool_cfg,
+        impurity="", bootstrap=bootstrap)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("depth", "num_rounds", "objective",
-                              "subsample"))
-def _fit_gbt(packed, feat_of, block_start, packed_thr, y, key, *, depth: int,
-             num_rounds: int, step_size: float, reg_lambda: float,
-             gamma: float, min_child_weight: float, subsample: float,
-             objective: str):
+def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
+              step_size, reg_lambda, gamma, min_child_weight, subsample,
+              *, depth: int, num_rounds: int, objective: str):
+    """Shared boosting program with row-mask semantics (see
+    _forest_body): masked rows get zero grad/hess weight; the base
+    margin is the mask-weighted mean."""
     n, d = packed.shape
     dtype = packed_thr.dtype
     gain_fn = _xgb_gain(reg_lambda, gamma, min_child_weight)
+    msum = jnp.maximum(jnp.sum(mask), 1.0)
+    mean_y = jnp.sum(mask * y) / msum
     if objective == "logistic":
-        p0 = jnp.clip(jnp.mean(y), 1e-6, 1 - 1e-6)
+        p0 = jnp.clip(mean_y, 1e-6, 1 - 1e-6)
         base = jnp.log(p0 / (1 - p0))
     else:
-        base = jnp.mean(y)
-    margins0 = jnp.full((n,), base, dtype)
+        base = mean_y
+    margins0 = jnp.broadcast_to(base.astype(dtype), (n,))
 
     def one_round(carry, rkey):
         margins = carry
@@ -583,9 +591,8 @@ def _fit_gbt(packed, feat_of, block_start, packed_thr, y, key, *, depth: int,
             g, h = p - y, jnp.maximum(p * (1 - p), 1e-12)
         else:
             g, h = margins - y, jnp.ones_like(y)
-        if subsample < 1.0:
-            m = jax.random.bernoulli(rkey, subsample, (n,)).astype(dtype)
-            g, h = g * m, h * m
+        m = jax.random.bernoulli(rkey, subsample, (n,)).astype(dtype) * mask
+        g, h = g * m, h * m
         feat, thr, leaf_stats, node = _grow_tree(
             packed, feat_of, block_start, packed_thr,
             jnp.stack([g, h], axis=1), depth=depth,
@@ -599,10 +606,110 @@ def _fit_gbt(packed, feat_of, block_start, packed_thr, y, key, *, depth: int,
     return feats, thrs, leaves, base
 
 
+@functools.partial(
+    jax.jit, static_argnames=("depth", "num_rounds", "objective"))
+def _fit_gbt(packed, feat_of, block_start, packed_thr, y, key, *, depth: int,
+             num_rounds: int, step_size: float, reg_lambda: float,
+             gamma: float, min_child_weight: float, subsample: float,
+             objective: str):
+    return _gbt_body(packed, feat_of, block_start, packed_thr, y, key,
+                     jnp.ones_like(y), step_size, reg_lambda, gamma,
+                     min_child_weight, subsample, depth=depth,
+                     num_rounds=num_rounds, objective=objective)
+
+
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _predict_leaves(X, feats, thrs, depth: int):
     """(T, n) leaf index per tree via vmapped static-depth traversal."""
     return jax.vmap(lambda f, t: _traverse(X, f, t, depth))(feats, thrs)
+
+
+# ---------------------------------------------------------------------------
+# fold x grid batched kernels (validator fast path + "models" mesh axis)
+# ---------------------------------------------------------------------------
+#
+# The reference's per-fold/per-grid Future pool (OpValidator.scala:270)
+# maps for tree families onto ONE vmapped program per static shape group
+# (depth/trees/rounds/bins): each candidate = (fold mask, traced
+# hyperparams). With a ("models", "data") mesh the candidate axis shards
+# over chips (data replicated — trees are task-parallel here, like the
+# reference's executor model). Documented deviation from the sequential
+# path: bin edges come from the WHOLE prepared matrix rather than each
+# fold's train rows (feature-distribution information only — standard
+# for histogram-GBM cross-validation).
+
+@functools.lru_cache(maxsize=None)
+def _forest_fg_kernel(statics: tuple, mesh=None):
+    (kind, depth, num_classes, num_trees, max_features, pool_cfg,
+     impurity, bootstrap) = statics
+
+    def one(mask, mi, mg, sr, packed, feat_of, block_start, packed_thr,
+            binned, col_thr, narrow, wide, y, key):
+        return _forest_body(
+            packed, feat_of, block_start, packed_thr, binned, col_thr,
+            narrow, wide, y, key, mask, mi, mg, sr, kind=kind,
+            depth=depth, num_classes=num_classes, num_trees=num_trees,
+            max_features=max_features, pool_cfg=pool_cfg,
+            impurity=impurity, bootstrap=bootstrap)
+
+    def batched(masks, mi, mg, sr, *rest):
+        return jax.vmap(one, in_axes=(0, 0, 0, 0) + (None,) * 10
+                        )(masks, mi, mg, sr, *rest)
+
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import PartitionSpec as P
+    leaves_spec = (P("models", None, None, None) if kind == "cls"
+                   else P("models", None, None))
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None), P("models"), P("models"),
+                  P("models")) + (P(),) * 10,
+        out_specs=(P("models", None, None), P("models", None, None),
+                   leaves_spec), check_vma=False))
+
+
+@functools.lru_cache(maxsize=None)
+def _gbt_fg_kernel(statics: tuple, mesh=None):
+    depth, num_rounds, objective = statics
+
+    def one(mask, ss, rl, ga, mcw, sub, packed, feat_of, block_start,
+            packed_thr, y, key):
+        return _gbt_body(packed, feat_of, block_start, packed_thr, y,
+                         key, mask, ss, rl, ga, mcw, sub, depth=depth,
+                         num_rounds=num_rounds, objective=objective)
+
+    def batched(masks, ss, rl, ga, mcw, sub, *rest):
+        return jax.vmap(one, in_axes=(0,) * 6 + (None,) * 6
+                        )(masks, ss, rl, ga, mcw, sub, *rest)
+
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None),) + (P("models"),) * 5 + (P(),) * 6,
+        out_specs=(P("models", None, None), P("models", None, None),
+                   P("models", None, None), P("models")),
+        check_vma=False))
+
+
+def _pad_candidates(mesh, arrays, n_rows):
+    """Pad the flattened candidate axis to a multiple of the mesh's
+    ``models`` shard count (padded slots fit on all-ones masks and are
+    discarded). Returns (padded arrays, original count)."""
+    count = arrays[0].shape[0]
+    if mesh is None:
+        return arrays, count
+    shards = mesh.shape["models"]
+    pad = (-count) % shards
+    if not pad:
+        return arrays, count
+    out = []
+    for a in arrays:
+        fill = np.ones((pad, n_rows)) if a.ndim == 2 else np.ones(pad)
+        out.append(np.concatenate([a, fill.astype(a.dtype)], axis=0))
+    return out, count
 
 
 # ---------------------------------------------------------------------------
@@ -800,9 +907,141 @@ def _pool_plan(widths: np.ndarray, mf: Optional[int]):
     return ((jnp.asarray(narrow), jnp.asarray(wide)), cfg, mf_eff)
 
 
+#: grid params the batched forest kernel traces per candidate vs the
+#: statics that partition the grid into shape groups
+_FOREST_TRACED = ("min_instances_per_node", "min_info_gain",
+                  "subsampling_rate")
+_FOREST_STATIC = ("max_depth", "num_trees", "max_bins", "impurity",
+                  "feature_subset_strategy", "seed")
+_GBT_TRACED = ("step_size", "reg_lambda", "gamma", "min_child_weight",
+               "subsample", "eta")
+_GBT_STATIC = ("max_depth", "num_rounds", "max_bins", "seed", "num_round")
+
+
+def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
+    """All (fold, grid point) forest candidates in vmapped programs (one
+    per static shape group), optionally sharded over a mesh ``models``
+    axis — see the kernel docstrings for the bin-edge deviation."""
+    grid = [dict(p) for p in (list(grid) or [{}])]
+    allowed = set(_FOREST_TRACED) | set(_FOREST_STATIC)
+    for p in grid:
+        extra = set(p) - allowed
+        if extra:
+            raise NotImplementedError(
+                f"batched tree kernel cannot vary {sorted(extra)}")
+    masks = np.asarray(masks, dtype=np.float64)
+    F, n = masks.shape
+    G = len(grid)
+    d = X.shape[1]
+    k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+    y_j = jnp.asarray(y)
+    models = [[None] * G for _ in range(F)]
+    groups: Dict[tuple, list] = {}
+    for gi, p in enumerate(grid):
+        cand = est.with_params(**p)
+        skey = (cand.max_depth, cand.num_trees, cand.max_bins,
+                getattr(cand, "impurity", ""),
+                cand.feature_subset_strategy, cand.seed)
+        groups.setdefault(skey, []).append((gi, cand))
+    for members in groups.values():
+        cand0 = members[0][1]
+        design, widths = _design_args(X, cand0.max_bins)
+        mf = _resolve_max_features(cand0.feature_subset_strategy, d,
+                                   classification) \
+            if cand0.bootstrap else None
+        (narrow, wide), pool_cfg, mf = _pool_plan(widths, mf)
+        gk = len(members)
+        mi = np.tile([float(c.min_instances_per_node)
+                      for _, c in members], F)
+        mg = np.tile([float(c.min_info_gain) for _, c in members], F)
+        sr = np.tile([float(c.subsampling_rate) for _, c in members], F)
+        masks_c = np.repeat(masks, gk, axis=0)   # fold-major candidates
+        (masks_p, mi, mg, sr), count = _pad_candidates(
+            mesh, [masks_c, mi, mg, sr], n)
+        statics = ("cls" if classification else "reg", cand0.max_depth,
+                   k if classification else 0, cand0.num_trees, mf,
+                   pool_cfg, getattr(cand0, "impurity", ""),
+                   cand0.bootstrap)
+        fn = _forest_fg_kernel(statics, mesh)
+        feats, thrs, leaves = fn(
+            jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
+            jnp.asarray(sr), *design, narrow, wide, y_j,
+            jax.random.PRNGKey(cand0.seed))
+        feats = np.asarray(feats)[:count]
+        thrs = np.asarray(thrs)[:count]
+        leaves = np.asarray(leaves)[:count]
+        model_cls = (TreeEnsembleClassifierModel if classification
+                     else TreeEnsembleRegressorModel)
+        for f in range(F):
+            for j, (gi, cand) in enumerate(members):
+                c = f * gk + j
+                models[f][gi] = model_cls(
+                    feats[c], thrs[c], leaves[c],
+                    depth=cand0.max_depth, n_features=d)
+    return models
+
+
+def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
+    grid = [dict(p) for p in (list(grid) or [{}])]
+    allowed = set(_GBT_TRACED) | set(_GBT_STATIC)
+    for p in grid:
+        extra = set(p) - allowed
+        if extra:
+            raise NotImplementedError(
+                f"batched GBT kernel cannot vary {sorted(extra)}")
+    masks = np.asarray(masks, dtype=np.float64)
+    F, n = masks.shape
+    G = len(grid)
+    d = X.shape[1]
+    y_j = jnp.asarray(y)
+    models = [[None] * G for _ in range(F)]
+    groups: Dict[tuple, list] = {}
+    for gi, p in enumerate(grid):
+        cand = est.with_params(**p)
+        skey = (cand.max_depth, cand.num_rounds, cand.max_bins, cand.seed)
+        groups.setdefault(skey, []).append((gi, cand))
+    model_cls = (GBTClassifierModel if objective == "logistic"
+                 else GBTRegressorModel)
+    for members in groups.values():
+        cand0 = members[0][1]
+        design, _ = _design_args(X, cand0.max_bins)
+        gk = len(members)
+        ss = np.tile([float(c.step_size) for _, c in members], F)
+        rl = np.tile([float(c.reg_lambda) for _, c in members], F)
+        ga = np.tile([float(c.gamma) for _, c in members], F)
+        mcw = np.tile([float(c.min_child_weight) for _, c in members], F)
+        sub = np.tile([float(c.subsample) for _, c in members], F)
+        masks_c = np.repeat(masks, gk, axis=0)
+        (masks_p, ss, rl, ga, mcw, sub), count = _pad_candidates(
+            mesh, [masks_c, ss, rl, ga, mcw, sub], n)
+        fn = _gbt_fg_kernel((cand0.max_depth, cand0.num_rounds,
+                             objective), mesh)
+        feats, thrs, leaves, base = fn(
+            jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
+            jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
+            *design[:4], y_j, jax.random.PRNGKey(cand0.seed))
+        feats = np.asarray(feats)[:count]
+        thrs = np.asarray(thrs)[:count]
+        leaves = np.asarray(leaves)[:count]
+        base = np.asarray(base)[:count]
+        for f in range(F):
+            for j, (gi, cand) in enumerate(members):
+                c = f * gk + j
+                models[f][gi] = model_cls(
+                    feats[c], thrs[c], leaves[c], depth=cand0.max_depth,
+                    base=float(base[c]), n_features=d)
+    return models
+
+
 class _ForestClassifierBase(Predictor):
     num_trees = 1
     bootstrap = False
+
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """Validator fast path: all (fold, grid) candidates in one
+        vmapped program per static group, mesh-shardable over the
+        candidate axis (reference OpValidator.scala:270 parallelism)."""
+        return _forest_fold_grid(self, X, y, masks, grid, mesh, True)
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray
                    ) -> TreeEnsembleClassifierModel:
@@ -828,6 +1067,10 @@ class _ForestClassifierBase(Predictor):
 class _ForestRegressorBase(Predictor):
     num_trees = 1
     bootstrap = False
+
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """See _ForestClassifierBase.fit_fold_grid_arrays."""
+        return _forest_fold_grid(self, X, y, masks, grid, mesh, False)
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray
                    ) -> TreeEnsembleRegressorModel:
@@ -954,6 +1197,13 @@ class GBTClassifier(Predictor):
         self.subsample = subsample
         self.seed = seed
 
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """See _ForestClassifierBase.fit_fold_grid_arrays."""
+        bad = np.setdiff1d(np.unique(y), [0.0, 1.0])
+        if bad.size:
+            raise ValueError("GBTClassifier supports binary labels only")
+        return _gbt_fold_grid(self, X, y, masks, grid, mesh, "logistic")
+
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTClassifierModel:
         bad = np.setdiff1d(np.unique(y), [0.0, 1.0])
         if bad.size:
@@ -992,6 +1242,10 @@ class GBTRegressor(Predictor):
         self.min_child_weight = min_child_weight
         self.subsample = subsample
         self.seed = seed
+
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """See _ForestClassifierBase.fit_fold_grid_arrays."""
+        return _gbt_fold_grid(self, X, y, masks, grid, mesh, "squared")
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTRegressorModel:
         feats, thrs, leaves, base = _fit_gbt(
