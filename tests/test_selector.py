@@ -214,3 +214,24 @@ class TestModelSelector:
         with pytest.raises(ValueError):
             BinaryClassificationModelSelector.with_cross_validation(
                 model_types_to_use=["NoSuchModel"])
+
+
+def test_gbt_drops_out_of_multilabel_search(rng):
+    """A family whose preconditions the data violates must drop out of
+    the race, not kill the search — including via the batched fold-grid
+    path (r3 review finding)."""
+    from transmogrifai_tpu.evaluators import MultiClassificationEvaluator
+    from transmogrifai_tpu.models import LogisticRegression
+    from transmogrifai_tpu.models.trees import GBTClassifier
+    from transmogrifai_tpu.selector.validator import CrossValidation
+    X = rng.normal(size=(120, 3))
+    y = np.clip(np.floor(X[:, 0] + 1.5), 0, 2)   # labels {0, 1, 2}
+    best = CrossValidation(
+        MultiClassificationEvaluator(), num_folds=2,
+        stratify=True).validate(
+        [(LogisticRegression(max_iter=25), [{"reg_param": 0.1}]),
+         (GBTClassifier(num_rounds=5, max_depth=3), [{}])], X, y)
+    assert best.name == "LogisticRegression"
+    gbt_res = [r for r in best.results
+               if r.model_name == "GBTClassifier"][0]
+    assert all(np.isnan(v) for v in gbt_res.metric_values)

@@ -982,6 +982,9 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
 
 
 def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
+    # mirrors _forest_fold_grid's candidate contract (fold-major
+    # flattening, static-group partitioning, padding) — change both
+    # together
     grid = [dict(p) for p in (list(grid) or [{}])]
     allowed = set(_GBT_TRACED) | set(_GBT_STATIC)
     for p in grid:
@@ -1201,7 +1204,12 @@ class GBTClassifier(Predictor):
         """See _ForestClassifierBase.fit_fold_grid_arrays."""
         bad = np.setdiff1d(np.unique(y), [0.0, 1.0])
         if bad.size:
-            raise ValueError("GBTClassifier supports binary labels only")
+            # NotImplementedError (not ValueError): the validator then
+            # takes the sequential fallback, where the per-fold handler
+            # drops this family out of the race instead of killing the
+            # whole search
+            raise NotImplementedError(
+                "batched GBT kernel requires binary labels {0, 1}")
         return _gbt_fold_grid(self, X, y, masks, grid, mesh, "logistic")
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTClassifierModel:
