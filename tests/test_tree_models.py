@@ -195,3 +195,102 @@ class TestPoolPlan:
         from transmogrifai_tpu.models.trees import _pool_plan
         (_, _), cfg, mf = _pool_plan(np.array([2] * 8), 2)
         assert cfg is None and mf == 2
+
+
+class TestIdentitySlotFastPath:
+    """The identity fast path (slots = node ids, no rank-compression
+    sort) must produce the same tree as the compressed path whenever
+    the budget mask cannot bind."""
+
+    def test_identity_matches_compressed(self, binary_data):
+        import jax.numpy as jnp
+        import jax
+        from transmogrifai_tpu.models.trees import (
+            _PackedDesign, _gini_gain, _grow_tree)
+        X, y = binary_data
+        design = _PackedDesign(X, max_bins=32)
+        onehot = jax.nn.one_hot(jnp.asarray(y, jnp.int32), 2)
+        depth = 4
+        # the target concept has <= 4 leaves, so active nodes per level
+        # stay far below both caps and the budget mask never binds in
+        # either configuration
+        out_id = _grow_tree(
+            jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+            jnp.asarray(design.block_start),
+            jnp.asarray(design.packed_thr), onehot, depth=depth,
+            gain_fn=_gini_gain(1.0), min_info_gain=1e-3)
+        out_cmp = _grow_tree(
+            jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+            jnp.asarray(design.block_start),
+            jnp.asarray(design.packed_thr), onehot, depth=depth,
+            gain_fn=_gini_gain(1.0), min_info_gain=1e-3,
+            node_cap=7)  # 2^3 > 7 forces compression at level 3
+        for a, b in zip(out_id[:2], out_cmp[:2]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(out_id[2]),
+                                   np.asarray(out_cmp[2]), rtol=1e-6)
+
+    def test_identity_matches_compressed_with_feature_sampling(
+            self, binary_data):
+        """With per-node feature sampling, full-tree equality between
+        the capped and uncapped runs is NOT a theorem (the budget mask
+        can genuinely deny splits near capacity). What IS guaranteed —
+        because the feature draw is node-keyed whenever 2^level <= cap
+        and both runs split the PRNG key identically per level — is
+        that every heap level strictly below the first compressed level
+        matches exactly. Checked across many seeds."""
+        import jax
+        import jax.numpy as jnp
+        from transmogrifai_tpu.models.trees import (
+            _PackedDesign, _gini_gain, _grow_tree)
+        X, y = binary_data
+        design = _PackedDesign(X, max_bins=32)
+        onehot = jax.nn.one_hot(jnp.asarray(y, jnp.int32), 2)
+        args = (jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+                jnp.asarray(design.block_start),
+                jnp.asarray(design.packed_thr), onehot)
+        # node_cap=7, depth=4: levels 0-1 identity in both runs, level 2
+        # is the first compressed level (2^3 > 7) -> heap[:3] must agree
+        first_compressed = 2
+        n_exact = 2 ** first_compressed - 1
+        for seed in range(16):
+            kw = dict(depth=4, gain_fn=_gini_gain(1.0),
+                      min_info_gain=1e-3,
+                      feat_key=jax.random.PRNGKey(seed), max_features=3)
+            out_id = _grow_tree(*args, **kw)
+            out_cmp = _grow_tree(*args, **kw, node_cap=7)
+            for a, b in zip(out_id[:2], out_cmp[:2]):
+                np.testing.assert_array_equal(
+                    np.asarray(a)[:n_exact], np.asarray(b)[:n_exact],
+                    err_msg=f"seed {seed}")
+
+    def test_negative_gamma_empty_nodes_stay_leaves(self):
+        """gamma < 0 with min_child_weight 0 must not fabricate splits
+        on EMPTY nodes (identity slots materialize them)."""
+        import jax.numpy as jnp
+        from transmogrifai_tpu.models.trees import (
+            _PackedDesign, _grow_tree, _xgb_gain)
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(64, 3))
+        g = np.where(X[:, 0] > 0, 1.0, -1.0)
+        h = np.ones(64)
+        design = _PackedDesign(X, max_bins=8)
+        stats = jnp.stack([jnp.asarray(g), jnp.asarray(h)], axis=1)
+        feat, thr, _, _ = _grow_tree(
+            jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+            jnp.asarray(design.block_start),
+            jnp.asarray(design.packed_thr), stats, depth=3,
+            gain_fn=_xgb_gain(reg_lambda=1.0, gamma=-0.1,
+                              min_child_weight=0.0),
+            min_info_gain=0.0)
+        thr = np.asarray(thr)
+        feat = np.asarray(feat)
+        # heap positions whose PARENT did not split must stay route-left
+        # leaves ((0, inf)); a spurious empty-node split writes a finite
+        # threshold there
+        parent = lambda i: (i - 1) // 2
+        for i in range(3, 7):          # level-2 heap slots
+            if not np.isfinite(thr[parent(i)]):
+                assert not np.isfinite(thr[i]), (
+                    f"empty node at heap {i} fabricated a split "
+                    f"(feat={feat[i]}, thr={thr[i]})")

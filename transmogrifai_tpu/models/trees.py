@@ -279,8 +279,25 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
               if _hist_mode(n, TB) == "matmul" else None)
     key = feat_key
     for level in range(depth):
-        C = min(2 ** level, cap)                   # static slots this level
-        slot, node_of_slot, active = _compress_nodes(node, C)
+        # identity fast path: while every within-level node id fits the
+        # slot cap AND the next level's budget mask cannot bind
+        # (2^(level+1) <= cap, or this is the last level), slots ARE
+        # node ids — the O(n log n) rank-compression sort is skipped
+        # entirely. Empty nodes produce all-zero histograms -> -inf
+        # gains -> they write the already-initialized (0, inf) heap
+        # entries, so results are bit-identical to the compressed path.
+        # With the default cap (256) this covers every level of trees up
+        # to depth 9; only deeper trees pay for compression.
+        identity = 2 ** level <= cap and (
+            level + 1 == depth or 2 ** (level + 1) <= cap)
+        if identity:
+            C = 2 ** level
+            slot = node
+            node_of_slot = jnp.arange(C, dtype=jnp.int32)
+            active = None
+        else:
+            C = min(2 ** level, cap)               # static slots this level
+            slot, node_of_slot, active = _compress_nodes(node, C)
         hist = _level_histograms(packed, slot, stats, C, TB, bin_oh)
         cs = jnp.cumsum(hist, axis=1)              # packed-axis running sum
         # per-feature segmented cumsum: subtract the running sum at the
@@ -288,22 +305,52 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
         base = jnp.where((block_start > 0)[None, :, None],
                          cs[:, jnp.maximum(block_start - 1, 0), :], 0.0)
         left = cs - base
-        total = jax.ops.segment_sum(stats, slot, num_segments=C)[:, None, :]
+        if identity:
+            # unlike compression (which only materializes non-empty
+            # slots), identity slots include empty nodes; their all-zero
+            # histograms yield -inf/zero gains under every default gain,
+            # but a user-set gamma<0 with min_child_weight<=0 could make
+            # an empty node's XGB gain positive — so count rows per slot
+            # (folded into the total reduction as an extra ones column)
+            # and mask empty slots out of split_ok below
+            aug = jax.ops.segment_sum(
+                jnp.concatenate(
+                    [stats, jnp.ones((n, 1), stats.dtype)], axis=1),
+                slot, num_segments=C)
+            total = aug[:, None, :-1]
+            nonempty = aug[:, -1] > 0
+        else:
+            total = jax.ops.segment_sum(stats, slot,
+                                        num_segments=C)[:, None, :]
         right = total - left
         gain = gain_fn(left, right, total)         # (C, TB)
         gain = jnp.where(not_a_split[None, :], -jnp.inf, gain)
         if max_features is not None and max_features < d:
             key, sub = jax.random.split(key)
-            u = jax.random.uniform(sub, (C, d))
+            if 2 ** level <= cap:
+                # node-keyed draw: invariant to slot numbering, so the
+                # identity and compressed paths pick identical per-node
+                # feature subsets. A sentinel (empty) slot clamps onto
+                # the last node's row — safe not because that row is
+                # unused but because sentinel-slot outputs never reach
+                # the heap (mode="drop") or routing
+                u = jax.random.uniform(sub, (2 ** level, d))[
+                    jnp.clip(node_of_slot, 0, 2 ** level - 1)]
+            else:
+                u = jax.random.uniform(sub, (C, d))
             kth = jnp.sort(u, axis=1)[:, max_features - 1:max_features]
             gain = jnp.where((u <= kth)[:, feat_of], gain, -jnp.inf)
         best = jnp.argmax(gain, axis=1)            # (C,) packed bin index
         best_gain = jnp.take_along_axis(gain, best[:, None], axis=1)[:, 0]
         split_ok = best_gain >= jnp.maximum(min_info_gain, 1e-12)
-        if level + 1 < depth:
+        if identity:
+            split_ok &= nonempty
+        if level + 1 < depth and not identity:
             # budget mask: next level holds at most min(2^(level+1), cap)
             # slots; each split adds one net node, so only the first
-            # (budget - active) slots may split. Binds only near capacity.
+            # (budget - active) slots may split. Binds only near capacity
+            # (the identity fast path above is taken exactly when it
+            # cannot bind).
             budget = min(2 ** (level + 1), cap)
             split_ok &= jnp.arange(C) < (budget - active)
         bfeat = jnp.where(split_ok, feat_of[best], 0)
