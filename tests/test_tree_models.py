@@ -153,8 +153,11 @@ class TestGridSupport:
 
 
 class TestHistogramModes:
-    """scatter vs matmul histogram strategies must produce IDENTICAL
-    trees (models/trees._hist_mode; matmul rides the MXU on TPU)."""
+    """scatter / matmul / pallas histogram strategies must produce
+    IDENTICAL trees (models/trees._hist_mode; matmul and pallas ride
+    the MXU on TPU). The mode is threaded as a STATIC jit argument —
+    switching TX_TREE_HIST between fits in one process must retrace,
+    not silently reuse the previous mode's program."""
 
     def test_modes_agree(self, rng, monkeypatch):
         import numpy as np
@@ -164,17 +167,65 @@ class TestHistogramModes:
         X[:, 6:] = (X[:, 6:] > 0).astype(float)   # binary block
         y = (X[:, 0] + X[:, 6] > 0.3).astype(float)
         fits = {}
-        for mode in ("scatter", "matmul"):
+        for mode in ("scatter", "matmul", "pallas"):
             monkeypatch.setenv("TX_TREE_HIST", mode)
             fits[mode] = (
                 GBTClassifier(num_rounds=8, max_depth=4).fit_arrays(X, y),
                 RandomForestClassifier(num_trees=4, max_depth=6,
                                        min_instances_per_node=5
                                        ).fit_arrays(X, y))
-        for a, b in zip(fits["scatter"], fits["matmul"]):
-            np.testing.assert_allclose(a.thrs, b.thrs, rtol=1e-6)
-            np.testing.assert_allclose(a.feats, b.feats)
-            np.testing.assert_allclose(a.leaves, b.leaves, rtol=1e-5)
+        for other in ("matmul", "pallas"):
+            for a, b in zip(fits["scatter"], fits[other]):
+                np.testing.assert_allclose(a.thrs, b.thrs, rtol=1e-6,
+                                           err_msg=other)
+                np.testing.assert_allclose(a.feats, b.feats,
+                                           err_msg=other)
+                np.testing.assert_allclose(a.leaves, b.leaves, rtol=1e-5,
+                                           err_msg=other)
+
+    def test_mode_switch_retraces(self, rng, monkeypatch):
+        """Regression test: TX_TREE_HIST used to be read at trace time
+        only, so the second fit in a process silently reused the first
+        mode's compiled program (making in-process comparisons vacuous)."""
+        import transmogrifai_tpu.models.trees as T
+        seen = []
+        orig = T._hist_mode
+        monkeypatch.setattr(
+            T, "_hist_mode",
+            lambda n=0, tb=0: seen.append(orig(n, tb)) or seen[-1])
+        X = rng.normal(size=(80, 4))
+        y = (X[:, 0] > 0).astype(float)
+        monkeypatch.setenv("TX_TREE_HIST", "scatter")
+        T.GBTClassifier(num_rounds=2, max_depth=2).fit_arrays(X, y)
+        monkeypatch.setenv("TX_TREE_HIST", "matmul")
+        T.GBTClassifier(num_rounds=2, max_depth=2).fit_arrays(X, y)
+        assert "scatter" in seen and "matmul" in seen
+
+    def test_fold_grid_kernel_modes_agree(self, rng, monkeypatch):
+        """The batched fold x grid kernels pin the mode into their
+        static key too."""
+        import numpy as np
+        from transmogrifai_tpu.models.trees import GBTClassifier
+        X = rng.normal(size=(200, 8))
+        y = (X[:, 0] > 0).astype(float)
+        masks = np.ones((2, 200))
+        masks[0, :100] = 0.0
+        masks[1, 100:] = 0.0
+        grid = [{"max_depth": 3}, {"max_depth": 3, "step_size": 0.3}]
+        outs = {}
+        for mode in ("scatter", "matmul", "pallas"):
+            monkeypatch.setenv("TX_TREE_HIST", mode)
+            models = GBTClassifier(num_rounds=4).fit_fold_grid_arrays(
+                X, y, masks, grid)
+            outs[mode] = models
+        for other in ("matmul", "pallas"):
+            for f in range(2):
+                for g in range(2):
+                    a, b = outs["scatter"][f][g], outs[other][f][g]
+                    np.testing.assert_allclose(a.thrs, b.thrs, rtol=1e-6)
+                    np.testing.assert_allclose(a.feats, b.feats)
+                    np.testing.assert_allclose(a.leaves, b.leaves,
+                                               rtol=1e-5)
 
 
 class TestPoolPlan:

@@ -174,16 +174,17 @@ _HIST_CHUNK_ELEMS = 32_000_000
 
 
 def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
-    """Histogram strategy: "scatter" (fused segment_sum) or "matmul"
-    (one-hot contractions that ride the MXU). Auto: matmul on
-    accelerators (XLA scatters serialize there) and for small problems
-    on CPU (dense BLAS beats the scatter for n*TB up to a few million);
-    scatter for large problems on CPU where the contraction FLOPs
-    explode. TX_TREE_HIST overrides. Decided at trace time from static
-    shapes, so both modes stay available side by side."""
+    """Histogram strategy: "scatter" (fused segment_sum), "matmul"
+    (one-hot contractions that ride the MXU), or "pallas" (fused VMEM-
+    resident accumulation kernel, models/pallas_hist.py). Auto: matmul
+    on accelerators (XLA scatters serialize there) and for small
+    problems on CPU (dense BLAS beats the scatter for n*TB up to a few
+    million); scatter for large problems on CPU where the contraction
+    FLOPs explode. TX_TREE_HIST overrides. Decided at trace time from
+    static shapes, so all modes stay available side by side."""
     import os
     mode = os.environ.get("TX_TREE_HIST")
-    if mode in ("scatter", "matmul"):
+    if mode in ("scatter", "matmul", "pallas"):
         return mode
     try:
         platform = jax.default_backend()
@@ -209,8 +210,9 @@ def _bin_indicator(packed: jnp.ndarray, total_bins: int,
 def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
                       stats: jnp.ndarray, num_slots: int,
                       total_bins: int,
-                      bin_oh: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """(num_slots, total_bins, S) histograms. Two mathematically
+                      bin_oh: Optional[jnp.ndarray] = None,
+                      mode: str = "scatter") -> jnp.ndarray:
+    """(num_slots, total_bins, S) histograms. Three mathematically
     identical strategies (see _hist_mode):
 
     - scatter (bin_oh None): fused segment_sum per feature block
@@ -219,11 +221,17 @@ def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
     - matmul (bin_oh given): hist[c,b,s] = sum_i 1[slot_i=c] *
       binOH[i,b] * stats[i,s] — S dense contractions on the MXU, no
       per-level scatters. Peak memory is the (n, TB) indicator built
-      once per tree.
+      once per tree;
+    - pallas (bin_oh given): same contraction as one fused Pallas
+      kernel with the accumulator VMEM-resident (models/pallas_hist.py).
     """
     n, d = packed.shape
     s_dim = stats.shape[1]
     if bin_oh is not None:
+        if mode == "pallas":
+            from transmogrifai_tpu.models.pallas_hist import (
+                pallas_level_hist)
+            return pallas_level_hist(bin_oh, slot, stats, num_slots)
         slot_oh = jax.nn.one_hot(slot, num_slots, dtype=stats.dtype)
         return jnp.einsum("nc,ns,nb->cbs", slot_oh, stats, bin_oh)
     n_chunks = max(1, -(- (n * d * s_dim) // _HIST_CHUNK_ELEMS))
@@ -249,7 +257,8 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
                feat_key: Optional[jnp.ndarray] = None,
                max_features: Optional[int] = None,
                node_cap: Optional[int] = None,
-               feat_map: Optional[jnp.ndarray] = None):
+               feat_map: Optional[jnp.ndarray] = None,
+               hist_mode: Optional[str] = None):
     """Grow one complete tree of static ``depth`` over a packed binned
     design (see :class:`_PackedDesign`).
 
@@ -275,8 +284,11 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     feat_heap = jnp.zeros((heap_len,), jnp.int32)[:2 ** depth - 1]
     thr_heap = jnp.full((heap_len,), jnp.inf, stats.dtype)[:2 ** depth - 1]
     not_a_split = ~jnp.isfinite(packed_thr)     # last + padded bins
+    # resolved here only when the caller did not pin it; jitted entry
+    # points MUST pin it (static arg) or mode switches won't retrace
+    hist_mode = hist_mode or _hist_mode(n, TB)
     bin_oh = (_bin_indicator(packed, TB, stats.dtype)
-              if _hist_mode(n, TB) == "matmul" else None)
+              if hist_mode in ("matmul", "pallas") else None)
     key = feat_key
     for level in range(depth):
         # identity fast path: while every within-level node id fits the
@@ -298,7 +310,8 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
         else:
             C = min(2 ** level, cap)               # static slots this level
             slot, node_of_slot, active = _compress_nodes(node, C)
-        hist = _level_histograms(packed, slot, stats, C, TB, bin_oh)
+        hist = _level_histograms(packed, slot, stats, C, TB, bin_oh,
+                                 mode=hist_mode)
         cs = jnp.cumsum(hist, axis=1)              # packed-axis running sum
         # per-feature segmented cumsum: subtract the running sum at the
         # owning block's start; splitting at bin b sends bins<=b left
@@ -327,7 +340,11 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
         gain = jnp.where(not_a_split[None, :], -jnp.inf, gain)
         if max_features is not None and max_features < d:
             key, sub = jax.random.split(key)
-            if 2 ** level <= cap:
+            if identity:
+                # node_of_slot is arange(C) here — the node-keyed
+                # gather below would be a no-op
+                u = jax.random.uniform(sub, (C, d))
+            elif 2 ** level <= cap:
                 # node-keyed draw: invariant to slot numbering, so the
                 # identity and compressed paths pick identical per-node
                 # feature subsets. A sentinel (empty) slot clamps onto
@@ -525,7 +542,8 @@ def _forest_body(packed, feat_of, block_start, packed_thr,
                  min_instances, min_info_gain, subsample, *, kind: str,
                  depth: int, num_classes: int, num_trees: int,
                  max_features: Optional[int], pool_cfg: Optional[tuple],
-                 impurity: str, bootstrap: bool):
+                 impurity: str, bootstrap: bool,
+                 hist_mode: Optional[str]):
     """Shared forest program: ``mask`` (n,) row weights let one body
     serve the single fit (mask=ones), the fold x grid batched kernel
     (mask = fold membership, traced per-candidate hyperparams), and the
@@ -556,13 +574,14 @@ def _forest_body(packed, feat_of, block_start, packed_thr,
             feat, thr, leaf_stats, _ = _grow_tree(
                 p_sub, fo_sub, bs_sub, thr_sub, stats, depth=depth,
                 gain_fn=gain_fn, min_info_gain=min_info_gain,
-                feat_key=fkey, max_features=max_features, feat_map=pool)
+                feat_key=fkey, max_features=max_features, feat_map=pool,
+                hist_mode=hist_mode)
         else:
             feat, thr, leaf_stats, _ = _grow_tree(
                 packed, feat_of, block_start, packed_thr, stats,
                 depth=depth, gain_fn=gain_fn,
                 min_info_gain=min_info_gain, feat_key=fkey,
-                max_features=max_features)
+                max_features=max_features, hist_mode=hist_mode)
         if kind == "cls":
             lw = jnp.sum(leaf_stats, axis=-1, keepdims=True)
             leaf = jnp.where(lw > 0, leaf_stats / jnp.maximum(lw, 1e-12),
@@ -578,44 +597,47 @@ def _forest_body(packed, feat_of, block_start, packed_thr,
 @functools.partial(
     jax.jit, static_argnames=("depth", "num_classes", "num_trees",
                               "max_features", "pool_cfg", "impurity",
-                              "bootstrap"))
+                              "bootstrap", "hist_mode"))
 def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
                            binned, col_thr, narrow_idx, wide_idx, y, key,
                            *, depth: int, num_classes: int, num_trees: int,
                            max_features: Optional[int],
                            pool_cfg: Optional[tuple], impurity: str,
                            min_instances: float, min_info_gain: float,
-                           subsample: float, bootstrap: bool):
+                           subsample: float, bootstrap: bool,
+                           hist_mode: Optional[str]):
     return _forest_body(
         packed, feat_of, block_start, packed_thr, binned, col_thr,
         narrow_idx, wide_idx, y, key, jnp.ones_like(y), min_instances,
         min_info_gain, subsample, kind="cls", depth=depth,
         num_classes=num_classes, num_trees=num_trees,
         max_features=max_features, pool_cfg=pool_cfg, impurity=impurity,
-        bootstrap=bootstrap)
+        bootstrap=bootstrap, hist_mode=hist_mode)
 
 
 @functools.partial(
     jax.jit, static_argnames=("depth", "num_trees", "max_features",
-                              "pool_cfg", "bootstrap"))
+                              "pool_cfg", "bootstrap", "hist_mode"))
 def _fit_forest_regressor(packed, feat_of, block_start, packed_thr,
                           binned, col_thr, narrow_idx, wide_idx, y, key,
                           *, depth: int, num_trees: int,
                           max_features: Optional[int],
                           pool_cfg: Optional[tuple],
                           min_instances: float, min_info_gain: float,
-                          subsample: float, bootstrap: bool):
+                          subsample: float, bootstrap: bool,
+                          hist_mode: Optional[str]):
     return _forest_body(
         packed, feat_of, block_start, packed_thr, binned, col_thr,
         narrow_idx, wide_idx, y, key, jnp.ones_like(y), min_instances,
         min_info_gain, subsample, kind="reg", depth=depth, num_classes=0,
         num_trees=num_trees, max_features=max_features, pool_cfg=pool_cfg,
-        impurity="", bootstrap=bootstrap)
+        impurity="", bootstrap=bootstrap, hist_mode=hist_mode)
 
 
 def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
               step_size, reg_lambda, gamma, min_child_weight, subsample,
-              *, depth: int, num_rounds: int, objective: str):
+              *, depth: int, num_rounds: int, objective: str,
+              hist_mode: Optional[str]):
     """Shared boosting program with row-mask semantics (see
     _forest_body): masked rows get zero grad/hess weight; the base
     margin is the mask-weighted mean."""
@@ -643,7 +665,7 @@ def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
         feat, thr, leaf_stats, node = _grow_tree(
             packed, feat_of, block_start, packed_thr,
             jnp.stack([g, h], axis=1), depth=depth,
-            gain_fn=gain_fn, min_info_gain=0.0)
+            gain_fn=gain_fn, min_info_gain=0.0, hist_mode=hist_mode)
         vals = -step_size * leaf_stats[:, 0] / (leaf_stats[:, 1] + reg_lambda)
         vals = jnp.where(jnp.sum(jnp.abs(leaf_stats), axis=1) > 0, vals, 0.0)
         margins = margins + vals[node]
@@ -654,15 +676,17 @@ def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("depth", "num_rounds", "objective"))
+    jax.jit, static_argnames=("depth", "num_rounds", "objective",
+                              "hist_mode"))
 def _fit_gbt(packed, feat_of, block_start, packed_thr, y, key, *, depth: int,
              num_rounds: int, step_size: float, reg_lambda: float,
              gamma: float, min_child_weight: float, subsample: float,
-             objective: str):
+             objective: str, hist_mode: Optional[str]):
     return _gbt_body(packed, feat_of, block_start, packed_thr, y, key,
                      jnp.ones_like(y), step_size, reg_lambda, gamma,
                      min_child_weight, subsample, depth=depth,
-                     num_rounds=num_rounds, objective=objective)
+                     num_rounds=num_rounds, objective=objective,
+                     hist_mode=hist_mode)
 
 
 @functools.partial(jax.jit, static_argnames=("depth",))
@@ -688,7 +712,7 @@ def _predict_leaves(X, feats, thrs, depth: int):
 @functools.lru_cache(maxsize=None)
 def _forest_fg_kernel(statics: tuple, mesh=None):
     (kind, depth, num_classes, num_trees, max_features, pool_cfg,
-     impurity, bootstrap) = statics
+     impurity, bootstrap, hist_mode) = statics
 
     def one(mask, mi, mg, sr, packed, feat_of, block_start, packed_thr,
             binned, col_thr, narrow, wide, y, key):
@@ -697,7 +721,7 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
             narrow, wide, y, key, mask, mi, mg, sr, kind=kind,
             depth=depth, num_classes=num_classes, num_trees=num_trees,
             max_features=max_features, pool_cfg=pool_cfg,
-            impurity=impurity, bootstrap=bootstrap)
+            impurity=impurity, bootstrap=bootstrap, hist_mode=hist_mode)
 
     def batched(masks, mi, mg, sr, *rest):
         return jax.vmap(one, in_axes=(0, 0, 0, 0) + (None,) * 10
@@ -718,13 +742,14 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
 
 @functools.lru_cache(maxsize=None)
 def _gbt_fg_kernel(statics: tuple, mesh=None):
-    depth, num_rounds, objective = statics
+    depth, num_rounds, objective, hist_mode = statics
 
     def one(mask, ss, rl, ga, mcw, sub, packed, feat_of, block_start,
             packed_thr, y, key):
         return _gbt_body(packed, feat_of, block_start, packed_thr, y,
                          key, mask, ss, rl, ga, mcw, sub, depth=depth,
-                         num_rounds=num_rounds, objective=objective)
+                         num_rounds=num_rounds, objective=objective,
+                         hist_mode=hist_mode)
 
     def batched(masks, ss, rl, ga, mcw, sub, *rest):
         return jax.vmap(one, in_axes=(0,) * 6 + (None,) * 6
@@ -1008,7 +1033,8 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
         statics = ("cls" if classification else "reg", cand0.max_depth,
                    k if classification else 0, cand0.num_trees, mf,
                    pool_cfg, getattr(cand0, "impurity", ""),
-                   cand0.bootstrap)
+                   cand0.bootstrap,
+                   _hist_mode(n, int(design[1].shape[0])))
         fn = _forest_fg_kernel(statics, mesh)
         feats, thrs, leaves = fn(
             jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
@@ -1065,7 +1091,9 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
         (masks_p, ss, rl, ga, mcw, sub), count = _pad_candidates(
             mesh, [masks_c, ss, rl, ga, mcw, sub], n)
         fn = _gbt_fg_kernel((cand0.max_depth, cand0.num_rounds,
-                             objective), mesh)
+                             objective,
+                             _hist_mode(n, int(design[1].shape[0]))),
+                            mesh)
         feats, thrs, leaves, base = fn(
             jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
             jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
@@ -1108,7 +1136,8 @@ class _ForestClassifierBase(Predictor):
             pool_cfg=pool_cfg, impurity=self.impurity,
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
-            subsample=self.subsampling_rate, bootstrap=self.bootstrap)
+            subsample=self.subsampling_rate, bootstrap=self.bootstrap,
+            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])))
         return TreeEnsembleClassifierModel(feats, thrs, leaves,
                                            depth=self.max_depth,
                                            n_features=d)
@@ -1136,7 +1165,8 @@ class _ForestRegressorBase(Predictor):
             pool_cfg=pool_cfg,
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
-            subsample=self.subsampling_rate, bootstrap=self.bootstrap)
+            subsample=self.subsampling_rate, bootstrap=self.bootstrap,
+            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])))
         return TreeEnsembleRegressorModel(feats, thrs, leaves,
                                           depth=self.max_depth,
                                           n_features=d)
@@ -1267,13 +1297,15 @@ class GBTClassifier(Predictor):
                 f"(as MLlib GBTClassifier does); got extra labels "
                 f"{bad.tolist()} — use RandomForestClassifier or "
                 f"LogisticRegression for multiclass")
+        design, _ = _design_args(X, self.max_bins)
         feats, thrs, leaves, base = _fit_gbt(
-            *_design_args(X, self.max_bins)[0][:4], jnp.asarray(y),
+            *design[:4], jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
             num_rounds=self.num_rounds,
             step_size=self.step_size, reg_lambda=self.reg_lambda,
             gamma=self.gamma, min_child_weight=self.min_child_weight,
-            subsample=self.subsample, objective="logistic")
+            subsample=self.subsample, objective="logistic",
+            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])))
         return GBTClassifierModel(feats, thrs, leaves, depth=self.max_depth,
                                   base=float(base), n_features=X.shape[1])
 
@@ -1303,13 +1335,15 @@ class GBTRegressor(Predictor):
         return _gbt_fold_grid(self, X, y, masks, grid, mesh, "squared")
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTRegressorModel:
+        design, _ = _design_args(X, self.max_bins)
         feats, thrs, leaves, base = _fit_gbt(
-            *_design_args(X, self.max_bins)[0][:4], jnp.asarray(y),
+            *design[:4], jnp.asarray(y),
             jax.random.PRNGKey(self.seed), depth=self.max_depth,
             num_rounds=self.num_rounds,
             step_size=self.step_size, reg_lambda=self.reg_lambda,
             gamma=self.gamma, min_child_weight=self.min_child_weight,
-            subsample=self.subsample, objective="squared")
+            subsample=self.subsample, objective="squared",
+            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])))
         return GBTRegressorModel(feats, thrs, leaves, depth=self.max_depth,
                                  base=float(base), n_features=X.shape[1])
 
