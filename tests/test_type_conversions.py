@@ -1,0 +1,75 @@
+"""Value -> feature-type conversion syntax (types/conversions.py;
+reference features/.../types/package.scala:42-152 implicit enrichments
+used inside extract functions)."""
+import pytest
+
+from transmogrifai_tpu.types import (
+    Binary, FeatureTypeError, PickList, Real, RealNN, Text,
+    to_binary, to_date, to_date_list, to_email, to_geolocation,
+    to_integral, to_multi_pick_list, to_op_vector, to_pick_list,
+    to_real, to_real_nn, to_text,
+)
+
+
+class TestTextFamily:
+    def test_to_text(self):
+        assert isinstance(to_text("abc"), Text)
+        assert to_text("abc").value == "abc"
+        assert to_text(None).is_empty
+
+    def test_to_email_pick_list(self):
+        assert to_email("a@b.co").value == "a@b.co"
+        assert isinstance(to_pick_list("m"), PickList)
+
+
+class TestNumerics:
+    def test_to_real(self):
+        r = to_real(2)
+        assert isinstance(r, Real) and r.value == 2.0
+        assert to_real(None).is_empty
+
+    def test_to_real_unwraps_features(self):
+        assert to_real(Real(2.5)).value == 2.5
+        assert to_real(RealNN(1.0)).value == 1.0
+
+    def test_to_real_nn_default(self):
+        assert to_real_nn(None, default=7.0).value == 7.0
+        assert to_real_nn(3.0).value == 3.0
+
+    def test_to_real_nn_empty_raises(self):
+        with pytest.raises(FeatureTypeError):
+            to_real_nn(None)
+
+    def test_to_integral_date(self):
+        assert to_integral(5).value == 5
+        assert to_date(1234).value == 1234
+
+    def test_to_binary_numeric_semantics(self):
+        # JDoubleConversions.toBinary: v != 0 (package.scala:106)
+        assert to_binary(2.0).value is True
+        assert to_binary(0).value is False
+        assert to_binary(True).value is True
+        assert to_binary(None).is_empty
+        assert isinstance(to_binary(1), Binary)
+
+
+class TestCollections:
+    def test_lists_sets_vectors(self):
+        assert to_multi_pick_list({"a", "b"}).value == frozenset({"a", "b"})
+        assert list(to_date_list([1, 2]).value) == [1, 2]
+        assert to_geolocation([37.7, -122.4, 5.0]).value[0] == 37.7
+        assert to_op_vector([1.0, 0.0]).value.shape == (2,)
+
+
+class TestNumpyScalars:
+    def test_numpy_scalars_convert(self):
+        import numpy as np
+        assert to_binary(np.int64(2)).value is True
+        assert to_binary(np.bool_(True)).value is True
+        assert to_binary(np.float64(0.0)).value is False
+        assert to_real(np.float32(1.5)).value == pytest.approx(1.5)
+
+    def test_function_names_match_exports(self):
+        from transmogrifai_tpu.types import conversions as c
+        for name in c.__all__:
+            assert getattr(c, name).__name__ == name

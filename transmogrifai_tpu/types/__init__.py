@@ -19,7 +19,10 @@ from .maps import (Base64Map, BinaryMap, CityMap, ComboBoxMap, CountryMap,
                    PostalCodeMap, Prediction, RealMap, StateMap, StreetMap,
                    TextAreaMap, TextMap, URLMap)
 
-__all__ = [  # noqa: F405
+from .conversions import *  # noqa: F401,F403
+from . import conversions as _conv
+
+__all__ = _conv.__all__ + [  # noqa: F405
     # kernel
     "FeatureType", "FeatureTypeError", "NonNullable", "SingleResponse",
     "MultiResponse", "Categorical", "Location", "register_feature_type",
