@@ -235,3 +235,62 @@ def test_gbt_drops_out_of_multilabel_search(rng):
     gbt_res = [r for r in best.results
                if r.model_name == "GBTClassifier"][0]
     assert all(np.isnan(v) for v in gbt_res.metric_values)
+
+
+class TestBatchedEvaluation:
+    """The batched tree evaluation path (models/trees.batch_predict_raw
+    via validator._batched_fold_raw) must select identically to the
+    per-candidate predict path."""
+
+    def _data(self):
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(240, 8))
+        y = ((X[:, 0] > 0) | (X[:, 3] > 1)).astype(float)
+        return X, y
+
+    def test_batch_predict_raw_matches_per_model(self):
+        import numpy as np
+        from transmogrifai_tpu.models import (GBTClassifier,
+                                              LogisticRegression,
+                                              RandomForestClassifier)
+        from transmogrifai_tpu.models.trees import batch_predict_raw
+        X, y = self._data()
+        models = [
+            GBTClassifier(num_rounds=5, max_depth=3).fit_arrays(X, y),
+            RandomForestClassifier(num_trees=4, max_depth=4,
+                                   seed=3).fit_arrays(X, y),
+            LogisticRegression().fit_arrays(X, y),      # skipped family
+            GBTClassifier(num_rounds=5, max_depth=3,
+                          step_size=0.3).fit_arrays(X, y),
+        ]
+        out = batch_predict_raw(models, X)
+        assert set(out) == {0, 1, 3}        # linear model not batched
+        for i in out:
+            np.testing.assert_allclose(out[i], models[i].predict_raw(X),
+                                       rtol=1e-6, atol=1e-8)
+            # wrapper funnel gives the same Prediction column
+            a = models[i].prediction_from_raw(out[i])
+            b = models[i].predict_arrays(X)
+            np.testing.assert_allclose(a.data, b.data)
+            np.testing.assert_allclose(a.probability, b.probability,
+                                       rtol=1e-6)
+
+    def test_validator_batched_equals_fallback(self, monkeypatch):
+        import numpy as np
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import GBTClassifier
+        from transmogrifai_tpu.selector import CrossValidation
+        from transmogrifai_tpu.selector import validator as V
+        X, y = self._data()
+        pool = [(GBTClassifier(num_rounds=5),
+                 [{"max_depth": 2}, {"max_depth": 3}])]
+        cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=3,
+                             seed=5)
+        best_batched = cv.validate(pool, X, y)
+        monkeypatch.setattr(V, "_batched_fold_raw", lambda *a: {})
+        best_seq = cv.validate(pool, X, y)
+        assert best_batched.params == best_seq.params
+        for rb, rs in zip(best_batched.results, best_seq.results):
+            np.testing.assert_allclose(rb.metric_values, rs.metric_values,
+                                       rtol=1e-9)

@@ -117,12 +117,19 @@ class ClassifierModel(PredictionModel):
         e = np.exp(raw)
         return e / np.sum(e, axis=1, keepdims=True)
 
-    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
-        raw = np.asarray(self.predict_raw(X), dtype=np.float64)
+    def prediction_from_raw(self, raw: np.ndarray) -> PredictionColumn:
+        """Assemble the Prediction column from precomputed raw margins
+        (the batched validator evaluation path computes raw for many
+        candidates in one device program, then funnels each through
+        here so wrapper semantics stay the model's own)."""
+        raw = np.asarray(raw, dtype=np.float64)
         prob = np.asarray(self.raw_to_probability(raw), dtype=np.float64)
         pred = np.argmax(prob, axis=1).astype(np.float64)
         return PredictionColumn.from_arrays(pred, probability=prob,
                                             raw_prediction=raw)
+
+    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
+        return self.prediction_from_raw(self.predict_raw(X))
 
 
 class RegressionModel(PredictionModel):
@@ -131,6 +138,11 @@ class RegressionModel(PredictionModel):
     def predict_values(self, X: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    def prediction_from_raw(self, raw: np.ndarray) -> PredictionColumn:
+        """See ClassifierModel.prediction_from_raw — here ``raw`` is the
+        predicted values vector."""
+        return PredictionColumn.from_arrays(np.asarray(raw,
+                                                       dtype=np.float64))
+
     def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
-        pred = np.asarray(self.predict_values(X), dtype=np.float64)
-        return PredictionColumn.from_arrays(pred)
+        return self.prediction_from_raw(self.predict_values(X))

@@ -457,7 +457,10 @@ class LinearSVCModel(ClassifierModel):
         m = X @ self.coefficients + self.intercept
         return np.stack([-m, m], axis=1)
 
-    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
-        raw = self.predict_raw(X)
+    def prediction_from_raw(self, raw: np.ndarray) -> PredictionColumn:
+        raw = np.asarray(raw, dtype=np.float64)
         pred = (raw[:, 1] > 0).astype(np.float64)
         return PredictionColumn.from_arrays(pred, raw_prediction=raw)
+
+    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
+        return self.prediction_from_raw(self.predict_raw(X))

@@ -60,6 +60,19 @@ class BestEstimator:
     results: List[ValidationResult] = field(default_factory=list)
 
 
+def _batched_fold_raw(fitted_fold_models, X_val):
+    """Raw predictions for every tree-family candidate of one fold in
+    one device program (models/trees.batch_predict_raw); {} on any
+    failure so the per-candidate path silently takes over."""
+    try:
+        from ..models.trees import batch_predict_raw
+        return batch_predict_raw(fitted_fold_models, X_val)
+    except Exception:                      # pragma: no cover - defensive
+        _log.warning("batched fold evaluation failed; falling back to "
+                     "per-candidate predicts", exc_info=True)
+        return {}
+
+
 class _ValidatorBase:
     def __init__(self, evaluator: Evaluator, seed: int = 42,
                  stratify: bool = False, mesh=None):
@@ -124,6 +137,12 @@ class _ValidatorBase:
                         X, y, masks, grid, mesh=self.mesh)
                 except NotImplementedError:
                     fitted = None   # grid not traceable -> sequential
+            # batched evaluation: all tree-family candidates of a fold
+            # predict in ONE device program (others fall through to the
+            # per-candidate path)
+            fold_raw = ([_batched_fold_raw(fitted[f], fold_data[f][2])
+                         for f in range(len(fold_data))]
+                        if fitted is not None else None)
             for gi, params in enumerate(grid):
                 candidate = (None if fitted is not None
                              else estimator.with_params(**params))
@@ -135,9 +154,13 @@ class _ValidatorBase:
                     try:
                         if fitted is not None:
                             model: PredictionModel = fitted[f][gi]
+                            raw = fold_raw[f].get(gi)
+                            pred = (model.prediction_from_raw(raw)
+                                    if raw is not None
+                                    else model.predict_arrays(X_val))
                         else:
                             model = candidate.fit_arrays(X_tr, y_tr)
-                        pred = model.predict_arrays(X_val)
+                            pred = model.predict_arrays(X_val)
                         metrics = self.evaluator.evaluate_arrays(
                             y_val, pred)
                         res.metric_values.append(
@@ -177,6 +200,9 @@ class _ValidatorBase:
                         for X_tr, y_tr, _, _ in folds]
                 except NotImplementedError:
                     fitted = None
+            fold_raw = ([_batched_fold_raw(fitted[f], folds[f][2])
+                         for f in range(len(folds))]
+                        if fitted is not None else None)
             for gi, params in enumerate(grid):
                 candidate = (None if fitted is not None
                              else estimator.with_params(**params))
@@ -188,7 +214,11 @@ class _ValidatorBase:
                     try:
                         model = (fitted[f][gi] if fitted is not None
                                  else candidate.fit_arrays(X_tr, y_tr))
-                        pred = model.predict_arrays(X_val)
+                        raw = (fold_raw[f].get(gi)
+                               if fitted is not None else None)
+                        pred = (model.prediction_from_raw(raw)
+                                if raw is not None
+                                else model.predict_arrays(X_val))
                         metrics = self.evaluator.evaluate_arrays(y_val, pred)
                         res.metric_values.append(
                             self.evaluator.metric_from(metrics))
