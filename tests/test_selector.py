@@ -294,3 +294,45 @@ class TestBatchedEvaluation:
         for rb, rs in zip(best_batched.results, best_seq.results):
             np.testing.assert_allclose(rb.metric_values, rs.metric_values,
                                        rtol=1e-9)
+
+    def test_mlp_fold_batched_equals_sequential(self, monkeypatch):
+        """MLP's vmapped masked-loss fold kernel must reproduce the
+        per-fold subset fits (same init per fold, same loss function up
+        to summation order)."""
+        import numpy as np
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import MultilayerPerceptronClassifier
+        from transmogrifai_tpu.selector import CrossValidation
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(300, 8))
+        y = ((X[:, 0] + X[:, 1] ** 2) > 0.8).astype(float)
+        pool = [(MultilayerPerceptronClassifier(max_iter=40),
+                 [{"hidden_layers": (8,)}, {"hidden_layers": (12, 6)}])]
+        cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=3,
+                             seed=5)
+        best_batched = cv.validate(pool, X, y)
+        monkeypatch.setattr(
+            MultilayerPerceptronClassifier, "fit_fold_grid_arrays",
+            lambda *a, **k: (_ for _ in ()).throw(NotImplementedError()))
+        best_seq = cv.validate(pool, X, y)
+        assert best_batched.params == best_seq.params
+        for rb, rs in zip(best_batched.results, best_seq.results):
+            np.testing.assert_allclose(rb.metric_values, rs.metric_values,
+                                       atol=2e-3)
+
+    def test_mlp_fold_batch_falls_back_on_missing_class(self):
+        """A fold missing a class must route to the sequential path
+        (architectures would differ), not crash or silently diverge."""
+        import numpy as np
+        import pytest as _pytest
+        from transmogrifai_tpu.models import MultilayerPerceptronClassifier
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(60, 4))
+        y = np.zeros(60)
+        y[:2] = 2.0         # rare class present in only two rows
+        masks = np.ones((2, 60))
+        masks[0, :2] = 0.0  # fold 0 train set misses class 2
+        with _pytest.raises(NotImplementedError):
+            MultilayerPerceptronClassifier(max_iter=5).fit_fold_grid_arrays(
+                X, y, masks, [{}])

@@ -42,6 +42,13 @@ def check_is_response_values(label, features) -> None:
             f"Feature-vector input {features.name!r} must not be a response")
 
 
+def num_classes(y) -> int:
+    """Class count for integer-coded labels: max+1 with a floor of 2
+    (binary) — the single definition of the idiom every classifier
+    family uses."""
+    return max(2, int(np.max(y)) + 1 if len(y) else 2)
+
+
 class Predictor(BinaryEstimator):
     """Estimator over (RealNN label, OPVector features) -> Prediction."""
 

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import ClassifierModel, Predictor
+from .base import ClassifierModel, Predictor, num_classes
 
 __all__ = ["NaiveBayes", "NaiveBayesModel"]
 
@@ -52,7 +52,7 @@ class NaiveBayes(Predictor):
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> "NaiveBayesModel":
         if (X < 0).any():
             raise ValueError("NaiveBayes requires non-negative features")
-        k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+        k = num_classes(y)
         pi, theta = _fit_nb(jnp.asarray(X), jnp.asarray(y),
                             jnp.asarray(self.smoothing, dtype=jnp.float64),
                             num_classes=k, model_type=self.model_type)

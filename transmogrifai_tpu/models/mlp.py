@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import ClassifierModel, Predictor
+from .base import ClassifierModel, Predictor, num_classes
 from .solvers import lbfgs_minimize
 
 __all__ = ["MultilayerPerceptronClassifier",
@@ -59,6 +59,30 @@ def _fit_mlp(X, y, key, *, sizes: Tuple[int, ...], max_iter: int,
     return lbfgs_minimize(loss, params0, max_iter=max_iter, tol=tol)
 
 
+@functools.partial(jax.jit, static_argnames=("sizes", "max_iter", "tol"))
+def _fit_mlp_folds(X, y, masks, key, *, sizes: Tuple[int, ...],
+                   max_iter: int, tol: float):
+    """All folds of one MLP config as ONE vmapped L-BFGS program: the
+    mask-weighted mean cross-entropy over the full matrix equals the
+    plain mean over that fold's train rows, so each vmap lane IS the
+    per-fold sequential fit (same init — the sequential path seeds every
+    fold identically too) up to summation order."""
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), sizes[-1], dtype=X.dtype)
+
+    def one_fold(mask):
+        wsum = jnp.maximum(jnp.sum(mask), 1.0)
+
+        def loss(params):
+            logits = _forward(params, X)
+            ll = jnp.sum(onehot * jax.nn.log_softmax(logits), axis=1)
+            return -jnp.sum(mask * ll) / wsum
+
+        params0 = _init_params(key, sizes, X.dtype)
+        return lbfgs_minimize(loss, params0, max_iter=max_iter, tol=tol)
+
+    return jax.vmap(one_fold)(masks)
+
+
 class MultilayerPerceptronClassifier(Predictor):
     """Feed-forward classifier (reference
     OpMultilayerPerceptronClassifier.scala:48). ``hidden_layers`` are the
@@ -73,9 +97,60 @@ class MultilayerPerceptronClassifier(Predictor):
         self.tol = tol
         self.seed = seed
 
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """Validator fast path (see _ValidatorBase.validate): grid
+        points group by their (all static) params, and each group's
+        folds train as one vmapped program. ``mesh`` is accepted for
+        call symmetry with the tree/linear kernels; MLP candidate
+        counts are small, so they run on the local device."""
+        grid = [dict(p) for p in (list(grid) or [{}])]
+        allowed = {"hidden_layers", "max_iter", "tol", "seed"}
+        for p in grid:
+            extra = set(p) - allowed
+            if extra:
+                raise NotImplementedError(
+                    f"batched MLP kernel cannot vary {sorted(extra)}")
+        k = num_classes(y)
+        masks = np.asarray(masks, dtype=np.float64)
+        # parity precondition: the sequential fallback sizes its output
+        # layer from each fold's OWN train labels, so if any fold's
+        # train mask is missing a class the two paths would build
+        # different architectures — hand those datasets to the
+        # sequential path (same approach as the batched GBT label
+        # precondition)
+        all_classes = np.unique(np.asarray(y))
+        for row in masks:
+            if len(np.unique(np.asarray(y)[row > 0])) != len(all_classes):
+                raise NotImplementedError(
+                    "a fold's train split lacks a label class; "
+                    "per-fold architectures would differ")
+        F = masks.shape[0]
+        models = [[None] * len(grid) for _ in range(F)]
+        groups = {}
+        for gi, p in enumerate(grid):
+            cand = self.with_params(**p)
+            key = (cand.hidden_layers, cand.max_iter, cand.tol, cand.seed)
+            groups.setdefault(key, []).append(gi)
+        X_j = jnp.asarray(X)
+        y_j = jnp.asarray(y)
+        m_j = jnp.asarray(masks).astype(X_j.dtype)
+        for (hidden, mi, tol, seed), gis in groups.items():
+            sizes = (X.shape[1],) + tuple(hidden) + (k,)
+            params = _fit_mlp_folds(X_j, y_j, m_j,
+                                    jax.random.PRNGKey(seed), sizes=sizes,
+                                    max_iter=mi, tol=tol)
+            for f in range(F):
+                ws = [np.asarray(W[f]) for W, _ in params]
+                bs = [np.asarray(b[f]) for _, b in params]
+                mdl = MultilayerPerceptronClassifierModel(weights=ws,
+                                                          biases=bs)
+                for gi in gis:      # identical configs share the fit
+                    models[f][gi] = mdl
+        return models
+
     def fit_arrays(self, X: np.ndarray, y: np.ndarray
                    ) -> "MultilayerPerceptronClassifierModel":
-        k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+        k = num_classes(y)
         sizes = (X.shape[1],) + self.hidden_layers + (k,)
         params = _fit_mlp(jnp.asarray(X), jnp.asarray(y),
                           jax.random.PRNGKey(self.seed), sizes=sizes,

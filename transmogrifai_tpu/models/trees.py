@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..features.columns import PredictionColumn
-from .base import ClassifierModel, Predictor, RegressionModel
+from .base import ClassifierModel, Predictor, RegressionModel, num_classes
 
 __all__ = [
     "DecisionTreeClassifier", "DecisionTreeRegressor",
@@ -1066,7 +1066,7 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
     F, n = masks.shape
     G = len(grid)
     d = X.shape[1]
-    k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+    k = num_classes(y)
     y_j = jnp.asarray(y)
     models = [[None] * G for _ in range(F)]
     groups: Dict[tuple, list] = {}
@@ -1184,7 +1184,7 @@ class _ForestClassifierBase(Predictor):
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray
                    ) -> TreeEnsembleClassifierModel:
-        k = max(2, int(np.max(y)) + 1 if len(y) else 2)
+        k = num_classes(y)
         d = X.shape[1]
         mf = _resolve_max_features(self.feature_subset_strategy, d, True) \
             if self.bootstrap else None
