@@ -336,3 +336,51 @@ class TestBatchedEvaluation:
         with _pytest.raises(NotImplementedError):
             MultilayerPerceptronClassifier(max_iter=5).fit_fold_grid_arrays(
                 X, y, masks, [{}])
+
+    def test_nb_fold_batched_equals_sequential(self, monkeypatch):
+        """NaiveBayes' vmapped masked-count kernel must reproduce the
+        per-fold subset fits (closed-form counts; exact up to summation
+        order), including a traced smoothing grid."""
+        import numpy as np
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import NaiveBayes
+        from transmogrifai_tpu.selector import CrossValidation
+        rng = np.random.default_rng(8)
+        X = np.abs(rng.normal(size=(300, 10)))
+        y = (X[:, 0] + X[:, 1] > 1.6).astype(float)
+        pool = [(NaiveBayes(),
+                 [{"smoothing": 0.5}, {"smoothing": 2.0},
+                  {"model_type": "bernoulli"}])]
+        cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=3,
+                             seed=5)
+        best_batched = cv.validate(pool, X, y)
+        monkeypatch.setattr(
+            NaiveBayes, "fit_fold_grid_arrays",
+            lambda *a, **k: (_ for _ in ()).throw(NotImplementedError()))
+        best_seq = cv.validate(pool, X, y)
+        assert best_batched.params == best_seq.params
+        for rb, rs in zip(best_batched.results, best_seq.results):
+            np.testing.assert_allclose(rb.metric_values, rs.metric_values,
+                                       atol=1e-9)
+
+    def test_nb_negative_features_drop_out_not_crash(self):
+        """A pool containing NaiveBayes on data with negative values
+        must still complete (NB scores NaN and loses), exactly as the
+        sequential path always behaved."""
+        import numpy as np
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import LogisticRegression, NaiveBayes
+        from transmogrifai_tpu.selector import CrossValidation
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(200, 6))          # has negatives
+        y = (X[:, 0] > 0).astype(float)
+        pool = [(NaiveBayes(), [{}]),
+                (LogisticRegression(), [{}])]
+        cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=3,
+                             seed=2)
+        best = cv.validate(pool, X, y)
+        assert best.name == "LogisticRegression"
+        nb = [r for r in best.results if r.model_name == "NaiveBayes"]
+        assert nb and all(np.isnan(v) for v in nb[0].metric_values)

@@ -49,6 +49,21 @@ def num_classes(y) -> int:
     return max(2, int(np.max(y)) + 1 if len(y) else 2)
 
 
+def check_fold_classes(y, masks) -> None:
+    """Batched-CV parity precondition: the sequential fallback sizes
+    class-dependent parameters from each fold's OWN train labels, so a
+    fold whose train mask misses a class would get a different
+    architecture than the batched lane. Raise NotImplementedError (the
+    validator then falls back to sequential fits) in that case."""
+    y = np.asarray(y)
+    n_all = len(np.unique(y))
+    for row in np.asarray(masks):
+        if len(np.unique(y[row > 0])) != n_all:
+            raise NotImplementedError(
+                "a fold's train split lacks a label class; per-fold "
+                "architectures would differ")
+
+
 class Predictor(BinaryEstimator):
     """Estimator over (RealNN label, OPVector features) -> Prediction."""
 

@@ -14,28 +14,57 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import ClassifierModel, Predictor, num_classes
+from .base import (ClassifierModel, Predictor,
+                   check_fold_classes, num_classes)
 
 __all__ = ["NaiveBayes", "NaiveBayesModel"]
+
+
+def _nb_closed_form(X, labels, mask, sm, num_classes: int,
+                    model_type: str):
+    """The one definition of the NB closed form (MLlib formulas):
+    mask-weighted class counts + feature sums; ``mask`` of ones is the
+    plain (sequential) fit. ``X`` must already be binarized for the
+    bernoulli model type."""
+    counts = jax.ops.segment_sum(mask, labels, num_segments=num_classes)
+    pi = jnp.log(counts) - jnp.log(jnp.sum(counts))
+    feat = jax.ops.segment_sum(X * mask[:, None], labels,
+                               num_segments=num_classes)       # (K, d)
+    if model_type == "bernoulli":
+        theta = (jnp.log(feat + sm)
+                 - jnp.log(counts[:, None] + 2.0 * sm))
+    else:  # multinomial
+        theta = (jnp.log(feat + sm)
+                 - jnp.log(jnp.sum(feat, axis=1, keepdims=True)
+                           + sm * X.shape[1]))
+    return pi, theta
 
 
 @functools.partial(jax.jit, static_argnames=("num_classes", "model_type"))
 def _fit_nb(X, y, smoothing, *, num_classes: int, model_type: str):
     labels = y.astype(jnp.int32)
-    counts = jax.ops.segment_sum(jnp.ones_like(y), labels,
-                                 num_segments=num_classes)
-    pi = jnp.log(counts) - jnp.log(jnp.sum(counts))
     if model_type == "bernoulli":
         X = (X != 0).astype(X.dtype)
-    feat = jax.ops.segment_sum(X, labels, num_segments=num_classes)  # (K, d)
+    return _nb_closed_form(X, labels, jnp.ones_like(y), smoothing,
+                           num_classes, model_type)
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes", "model_type"))
+def _fit_nb_masked(X, y, masks, smoothing, *, num_classes: int,
+                   model_type: str):
+    """Fold x grid candidates as one vmapped program: candidate =
+    (fold mask, traced smoothing); mask-weighted class/feature sums
+    equal the per-fold subset sums, so each lane reproduces the
+    sequential fit up to summation order."""
+    labels = y.astype(jnp.int32)
     if model_type == "bernoulli":
-        theta = (jnp.log(feat + smoothing)
-                 - jnp.log(counts[:, None] + 2.0 * smoothing))
-    else:  # multinomial
-        theta = (jnp.log(feat + smoothing)
-                 - jnp.log(jnp.sum(feat, axis=1, keepdims=True)
-                           + smoothing * X.shape[1]))
-    return pi, theta
+        X = (X != 0).astype(X.dtype)
+
+    def one(mask, sm):
+        return _nb_closed_form(X, labels, mask, sm, num_classes,
+                               model_type)
+
+    return jax.vmap(one)(masks, smoothing)
 
 
 class NaiveBayes(Predictor):
@@ -48,6 +77,44 @@ class NaiveBayes(Predictor):
         super().__init__(uid=uid)
         self.smoothing = smoothing
         self.model_type = model_type
+
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """Validator fast path (see _ValidatorBase.validate): smoothing
+        is traced, model_type groups statically. ``mesh`` accepted for
+        call symmetry; NB candidate counts are tiny."""
+        if (np.asarray(X) < 0).any():
+            raise ValueError("NaiveBayes requires non-negative features")
+        grid = [dict(p) for p in (list(grid) or [{}])]
+        allowed = {"smoothing", "model_type"}
+        for p in grid:
+            extra = set(p) - allowed
+            if extra:
+                raise NotImplementedError(
+                    f"batched NaiveBayes kernel cannot vary {sorted(extra)}")
+        masks = np.asarray(masks, dtype=np.float64)
+        check_fold_classes(y, masks)
+        k = num_classes(y)
+        F = masks.shape[0]
+        models = [[None] * len(grid) for _ in range(F)]
+        groups = {}
+        for gi, p in enumerate(grid):
+            cand = self.with_params(**p)
+            groups.setdefault(cand.model_type, []).append((gi, cand))
+        X_j, y_j = jnp.asarray(X), jnp.asarray(y)
+        for model_type, members in groups.items():
+            gk = len(members)
+            sm = np.tile([float(c.smoothing) for _, c in members], F)
+            masks_c = np.repeat(masks, gk, axis=0)   # fold-major
+            pi, theta = _fit_nb_masked(
+                X_j, y_j, jnp.asarray(masks_c), jnp.asarray(sm),
+                num_classes=k, model_type=model_type)
+            pi, theta = np.asarray(pi), np.asarray(theta)
+            for f in range(F):
+                for j, (gi, _) in enumerate(members):
+                    c = f * gk + j
+                    models[f][gi] = NaiveBayesModel(
+                        pi=pi[c], theta=theta[c], model_type=model_type)
+        return models
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> "NaiveBayesModel":
         if (X < 0).any():

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import ClassifierModel, Predictor, num_classes
+from .base import (ClassifierModel, Predictor,
+                   check_fold_classes, num_classes)
 from .solvers import lbfgs_minimize
 
 __all__ = ["MultilayerPerceptronClassifier",
@@ -112,18 +113,7 @@ class MultilayerPerceptronClassifier(Predictor):
                     f"batched MLP kernel cannot vary {sorted(extra)}")
         k = num_classes(y)
         masks = np.asarray(masks, dtype=np.float64)
-        # parity precondition: the sequential fallback sizes its output
-        # layer from each fold's OWN train labels, so if any fold's
-        # train mask is missing a class the two paths would build
-        # different architectures — hand those datasets to the
-        # sequential path (same approach as the batched GBT label
-        # precondition)
-        all_classes = np.unique(np.asarray(y))
-        for row in masks:
-            if len(np.unique(np.asarray(y)[row > 0])) != len(all_classes):
-                raise NotImplementedError(
-                    "a fold's train split lacks a label class; "
-                    "per-fold architectures would differ")
+        check_fold_classes(y, masks)
         F = masks.shape[0]
         models = [[None] * len(grid) for _ in range(F)]
         groups = {}

@@ -137,6 +137,14 @@ class _ValidatorBase:
                         X, y, masks, grid, mesh=self.mesh)
                 except NotImplementedError:
                     fitted = None   # grid not traceable -> sequential
+                except ValueError as e:
+                    # family precondition violated (e.g. NaiveBayes on
+                    # negative features): the sequential path raises it
+                    # per fold below, dropping the family out of the
+                    # race with NaN metrics instead of failing the search
+                    _log.warning("batched kernel for %s rejected the "
+                                 "data: %s", type(estimator).__name__, e)
+                    fitted = None
             # batched evaluation: all tree-family candidates of a fold
             # predict in ONE device program (others fall through to the
             # per-candidate path)
@@ -199,6 +207,10 @@ class _ValidatorBase:
                             mesh=self.mesh)[0]
                         for X_tr, y_tr, _, _ in folds]
                 except NotImplementedError:
+                    fitted = None
+                except ValueError as e:
+                    _log.warning("batched kernel for %s rejected the "
+                                 "data: %s", type(estimator).__name__, e)
                     fitted = None
             fold_raw = ([_batched_fold_raw(fitted[f], folds[f][2])
                          for f in range(len(folds))]
