@@ -96,9 +96,12 @@ class TestAggregateReader:
             timestamp_fn=lambda r: r["t"],
             cutoff_time=CutOffTime.unix_ms(250))
         ds = reader.generate_dataset([amount])
-        # u1: only t=200 within (150, 250]; u2: none in window
+        # reference-exact predictor window [cutoff - window, cutoff)
+        # (FeatureAggregator.scala:122 uses >= on the lower bound):
+        # u1: t=200 in [150, 250); u2: t=150 sits exactly ON the lower
+        # bound and is included
         assert ds["amount"].data[0] == 5.0
-        assert np.isnan(ds["amount"].data[1])
+        assert ds["amount"].data[1] == 7.0
 
     def test_in_workflow(self):
         from transmogrifai_tpu.models import LogisticRegression
@@ -311,3 +314,96 @@ class TestStreamingReader:
         with open(res.write_location) as fh:
             lines = [_json.loads(l) for l in fh]
         assert len(lines) == 30 and pred.name in lines[0]
+
+
+class TestJoinedAggregateReaders:
+    """Dataset-level key join of two keyed readers (the reference's
+    actual join semantics — JoinedDataReader.scala:119 joins the sides'
+    PREPARED dataframes; features bind to a side via from_source)."""
+
+    def _readers(self):
+        from transmogrifai_tpu.readers import (AggregateDataReader,
+                                               JoinedAggregateReaders)
+        left = [{"user": "a", "t": 1, "x": 1.0},
+                {"user": "b", "t": 1, "x": 2.0}]
+        right = [{"user": "a", "t": 1, "y": 10.0},
+                 {"user": "c", "t": 1, "y": 30.0}]
+        mk = lambda recs: AggregateDataReader(
+            recs, key_fn=lambda r: r["user"], timestamp_fn=lambda r: r["t"])
+        return JoinedAggregateReaders(mk(left), mk(right),
+                                      left_name="l", right_name="r"), mk
+
+    def _features(self):
+        from transmogrifai_tpu.features.aggregators import SumNumeric
+        fx = (FeatureBuilder.of("x", Real)
+              .extract(lambda r: r.get("x")).aggregate(SumNumeric())
+              .from_source("l").as_predictor())
+        fy = (FeatureBuilder.of("y", Real)
+              .extract(lambda r: r.get("y")).aggregate(SumNumeric())
+              .from_source("r").as_predictor())
+        return fx, fy
+
+    def test_left_outer(self):
+        reader, _ = self._readers()
+        fx, fy = self._features()
+        ds = reader.generate_dataset([fx, fy])
+        assert ds.keys == ["a", "b"]          # left keys only
+        np.testing.assert_allclose(ds["x"].data, [1.0, 2.0])
+        assert ds["y"].boxed(0).value == 10.0
+        assert ds["y"].boxed(1).is_empty      # b absent from right
+
+    def test_inner(self):
+        from transmogrifai_tpu.readers import JoinedAggregateReaders
+        reader, _ = self._readers()
+        inner = JoinedAggregateReaders(reader.left, reader.right,
+                                       left_name="l", right_name="r",
+                                       join_type="inner")
+        fx, fy = self._features()
+        ds = inner.generate_dataset([fx, fy])
+        assert ds.keys == ["a"]
+
+    def test_left_outer_nonnullable_gets_monoid_zero(self):
+        from transmogrifai_tpu.features.aggregators import SumNumeric
+        reader, _ = self._readers()
+        fy = (FeatureBuilder.of("y", RealNN)
+              .extract(lambda r: r.get("y")).aggregate(SumNumeric())
+              .from_source("r").as_predictor())
+        ds = reader.generate_dataset([fy])
+        # key 'b' is absent from the right side; RealNN cannot hold
+        # null, so it gets the monoid zero
+        np.testing.assert_allclose(ds["y"].data, [10.0, 0.0])
+
+    def test_duplicate_names_across_sides_rejected(self):
+        import pytest as _pytest
+        from transmogrifai_tpu.features.aggregators import SumNumeric
+        reader, _ = self._readers()
+        fl = (FeatureBuilder.of("count", Real)
+              .extract(lambda r: 1.0).aggregate(SumNumeric())
+              .from_source("l").as_predictor())
+        fr = (FeatureBuilder.of("count", Real)
+              .extract(lambda r: 1.0).aggregate(SumNumeric())
+              .from_source("r").as_predictor())
+        with _pytest.raises(ValueError):
+            reader.generate_dataset([fl, fr])
+
+    def test_unknown_source_rejected(self):
+        import pytest as _pytest
+        reader, _ = self._readers()
+        bad = (FeatureBuilder.of("z", Real)
+               .extract(lambda r: r.get("z"))
+               .from_source("nope").as_predictor())
+        with _pytest.raises(ValueError):
+            reader.generate_dataset([bad])
+
+
+class TestDataprepExamples:
+    """The reference helloworld dataprep flows reproduce end-to-end
+    (examples/dataprep.py asserts the expected per-key outputs)."""
+
+    def test_joins_and_aggregates(self):
+        from examples.dataprep import joins_and_aggregates
+        joins_and_aggregates()
+
+    def test_conditional_aggregation(self):
+        from examples.dataprep import conditional_aggregation
+        conditional_aggregation()

@@ -30,12 +30,14 @@ class FeatureBuilderWithExtract:
 
     def __init__(self, name: str, ftype: Type[FeatureType],
                  extract_fn: Callable[[Any], Any],
-                 aggregator=None, window_ms: Optional[int] = None):
+                 aggregator=None, window_ms: Optional[int] = None,
+                 source_name: Optional[str] = None):
         self.name = name
         self.ftype = ftype
         self.extract_fn = extract_fn
         self.aggregator = aggregator
         self.window_ms = window_ms
+        self.source_name = source_name
 
     def aggregate(self, aggregator) -> "FeatureBuilderWithExtract":
         """Set the monoid aggregator used by aggregate readers
@@ -47,11 +49,19 @@ class FeatureBuilderWithExtract:
         self.window_ms = window_ms
         return self
 
+    def from_source(self, source_name: str) -> "FeatureBuilderWithExtract":
+        """Bind this feature to one side of a joined reader by name
+        (the reference encodes this in FeatureBuilder[T]'s reader type
+        parameter; see readers.joined.JoinedAggregateReaders)."""
+        self.source_name = source_name
+        return self
+
     def _build(self, is_response: bool) -> Feature:
         stage = FeatureGeneratorStage(
             name=self.name, ftype=self.ftype, extract_fn=self.extract_fn,
             is_response=is_response, aggregator=self.aggregator,
-            aggregate_window_ms=self.window_ms)
+            aggregate_window_ms=self.window_ms,
+            source_name=self.source_name)
         return stage.get_output()
 
     def as_predictor(self) -> Feature:

@@ -25,6 +25,7 @@ class FeatureGeneratorStage(PipelineStage, _ZeroInput):
                  is_response: bool = False,
                  aggregator: Optional[object] = None,
                  aggregate_window_ms: Optional[int] = None,
+                 source_name: Optional[str] = None,
                  uid: Optional[str] = None):
         super().__init__(operation_name=f"generate_{name}", uid=uid)
         self.name = name
@@ -35,6 +36,10 @@ class FeatureGeneratorStage(PipelineStage, _ZeroInput):
         #: (reference aggregators/MonoidAggregatorDefaults.scala:41)
         self.aggregator = aggregator
         self.aggregate_window_ms = aggregate_window_ms
+        #: which side of a joined reader this feature extracts from
+        #: (reference: the reader type parameter of FeatureBuilder[T];
+        #: used by readers.joined.JoinedAggregateReaders)
+        self.source_name = source_name
 
     def get_output(self) -> Feature:
         if self._output_feature is None:

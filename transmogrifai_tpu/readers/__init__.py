@@ -4,10 +4,12 @@ from .data_readers import (AggregateDataReader, AvroProductReader,
                            ConditionalDataReader, CSVAutoReader,
                            CSVProductReader, DataReader, DataReaders,
                            ParquetProductReader)
-from .joined import JoinedDataReader, JoinKeys
+from .joined import (JoinedAggregateReaders, JoinedDataReader,
+                     JoinKeys)
 from .streaming import StreamingReader, StreamingReaders
 
 __all__ = ["DataReader", "AggregateDataReader", "ConditionalDataReader",
            "CSVProductReader", "CSVAutoReader", "AvroProductReader",
            "ParquetProductReader", "DataReaders", "JoinedDataReader",
+           "JoinedAggregateReaders",
            "JoinKeys", "StreamingReader", "StreamingReaders"]

@@ -11,8 +11,10 @@ here extraction is a host-side columnar pass feeding device arrays.
 
 - :class:`DataReader` — one record = one row (simple readers).
 - :class:`AggregateDataReader` — groupBy(key); predictors aggregate
-  events at/before the cutoff, responses after it (leakage-safe
-  feature/label windows, DataReader.scala:206-330).
+  events strictly before the cutoff, responses at/after it
+  (leakage-safe feature/label windows with the reference's exact
+  boundaries, DataReader.scala:206-330 +
+  FeatureAggregator.scala:114-122).
 - :class:`ConditionalDataReader` — per-key cutoff from a target
   condition (e.g. "first purchase"); predictors aggregate before the
   key's own event, responses within a window after
@@ -87,10 +89,11 @@ class AggregateDataReader(DataReader):
     (reference AggregateDataReader, DataReader.scala:252).
 
     ``timestamp_fn`` extracts each record's event time (ms). Predictor
-    features aggregate events with ``time <= cutoff`` (within
-    ``predictor_window_ms`` when set on the feature builder); response
-    features aggregate events with ``time > cutoff`` (within
-    ``response_window_ms``) — the reference's leakage-safe windows.
+    features aggregate events with ``cutoff - window <= time < cutoff``
+    (window set per feature on the builder); response features
+    aggregate events with ``cutoff <= time <= cutoff + window`` — the
+    reference's exact leakage-safe boundaries
+    (FeatureAggregator.scala:114-122).
     """
 
     def __init__(self, records: Optional[Iterable[Any]] = None,
@@ -139,17 +142,22 @@ class AggregateDataReader(DataReader):
     def _filter(self, events: List[Event], is_response: bool,
                 cutoff: Optional[int], window: Optional[int]
                 ) -> List[Event]:
+        """Reference boundary semantics exactly
+        (FeatureAggregator.filterByDateWithCutoff, features/.../
+        aggregators/FeatureAggregator.scala:114-122): responses take
+        ``cutoff <= t <= cutoff + window``; predictors take
+        ``cutoff - window <= t < cutoff``."""
         if cutoff is None:
             return events
         if is_response:
-            kept = [e for e in events if e.date_ms > cutoff]
+            kept = [e for e in events if e.date_ms >= cutoff]
             if self.response_window_ms is not None:
                 kept = [e for e in kept
                         if e.date_ms <= cutoff + self.response_window_ms]
         else:
-            kept = [e for e in events if e.date_ms <= cutoff]
+            kept = [e for e in events if e.date_ms < cutoff]
             if window is not None:
-                kept = [e for e in kept if e.date_ms > cutoff - window]
+                kept = [e for e in kept if e.date_ms >= cutoff - window]
         return kept
 
 
@@ -222,13 +230,15 @@ class ConditionalDataReader(AggregateDataReader):
         return ds
 
     def _filter_conditional(self, events, is_response, cutoff, window):
-        """Predictors strictly before the target event; responses at/after
-        it (the target row itself carries the response)."""
+        """Predictors strictly before the target event; responses at or
+        after it, up to and INCLUDING cutoff + window — the same
+        boundaries as the aggregate filter (FeatureAggregator.scala:
+        114-122), with the per-key target time as the cutoff."""
         if is_response:
             kept = [e for e in events if e.date_ms >= cutoff]
             if self.response_window_ms is not None:
                 kept = [e for e in kept
-                        if e.date_ms < cutoff + self.response_window_ms]
+                        if e.date_ms <= cutoff + self.response_window_ms]
         else:
             kept = [e for e in events if e.date_ms < cutoff]
             if window is not None:

@@ -14,7 +14,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from ..features.feature import Feature
 from .data_readers import AggregateDataReader, DataReader
 
-__all__ = ["JoinedDataReader", "JoinKeys"]
+__all__ = ["JoinedDataReader", "JoinedAggregateReaders", "JoinKeys"]
 
 
 class JoinKeys:
@@ -98,4 +98,89 @@ class JoinedDataReader(DataReader):
                                for k, v in merged.items()})
                 merged.update(fields(l))  # left wins on collision
                 out.append(merged)
+        return out
+
+
+class JoinedAggregateReaders(DataReader):
+    """Key-join of two KEYED readers' PREPARED datasets — the
+    reference's actual join semantics (JoinedDataReader.scala:119 joins
+    the sides' generated dataframes on their key columns, after each
+    side aggregated its own features).
+
+    Features bind to a side with ``FeatureBuilder...from_source(name)``
+    (the reference encodes the side in FeatureBuilder[T]'s reader type
+    parameter); untagged features default to the left side. For
+    "leftOuter" the row keys are the left side's keys and right-side
+    columns are empty (None) for keys absent from the right DATA —
+    distinct from the monoid zero a present-but-filtered key aggregates
+    to, matching the reference's null-vs-0.0 output. "inner" keeps the
+    key intersection (left order).
+    """
+
+    def __init__(self, left: DataReader, right: DataReader,
+                 left_name: str = "left", right_name: str = "right",
+                 join_type: str = "leftOuter"):
+        super().__init__(records=None, key_fn=None)
+        if join_type not in ("leftOuter", "inner"):
+            raise ValueError("join_type must be 'leftOuter' or 'inner'")
+        self.left = left
+        self.right = right
+        self.left_name = left_name
+        self.right_name = right_name
+        self.join_type = join_type
+
+    def _split(self, raw_features: Sequence[Feature]):
+        lf, rf = [], []
+        for f in raw_features:
+            src = getattr(f.origin_stage, "source_name", None)
+            if src == self.right_name:
+                rf.append(f)
+            elif src in (None, self.left_name):
+                lf.append(f)
+            else:
+                raise ValueError(
+                    f"feature {f.name!r} is bound to unknown source "
+                    f"{src!r}; sides are {self.left_name!r} / "
+                    f"{self.right_name!r}")
+        dup = {f.name for f in lf} & {f.name for f in rf}
+        if dup:
+            raise ValueError(
+                f"feature names {sorted(dup)} appear on both join "
+                f"sides; rename one side's features")
+        return lf, rf
+
+    def generate_dataset(self, raw_features: Sequence[Feature]):
+        from ..features.columns import Dataset, FeatureColumn
+        lf, rf = self._split(raw_features)
+        lds = self.left.generate_dataset(lf)
+        rds = self.right.generate_dataset(rf)
+        lkeys = getattr(lds, "keys", None)
+        rkeys = getattr(rds, "keys", None)
+        if lkeys is None or rkeys is None:
+            raise ValueError(
+                "JoinedAggregateReaders requires keyed sides (readers "
+                "whose datasets carry per-row keys, e.g. aggregate/"
+                "conditional readers)")
+        if self.join_type == "inner":
+            rset = set(rkeys)
+            keys = [k for k in lkeys if k in rset]
+        else:
+            keys = list(lkeys)
+        lpos = {k: i for i, k in enumerate(lkeys)}
+        rpos = {k: i for i, k in enumerate(rkeys)}
+        from .data_readers import _box_aggregated
+        cols = {}
+        for f, ds, pos in ([(f, lds, lpos) for f in lf]
+                           + [(f, rds, rpos) for f in rf]):
+            side_col = ds[f.name]
+            values = [side_col.boxed(pos[k]).value if k in pos else None
+                      for k in keys]
+            # keys absent from a side get null for nullable types; the
+            # monoid zero for NonNullable numerics (RealNN cannot hold
+            # null — same rule _box_aggregated applies to empty
+            # aggregations)
+            cols[f.name] = FeatureColumn.from_values(
+                f.ftype, _box_aggregated(f.ftype, values))
+        out = Dataset(cols)
+        out.keys = keys
         return out
