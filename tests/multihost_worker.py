@@ -1,0 +1,77 @@
+"""Worker process for the multi-host (jax.distributed) tests.
+
+Each worker contributes 2 virtual CPU devices to a 2-process,
+4-device global mesh and runs the PRODUCTION fold x grid kernels on a
+("models", "data") mesh whose collectives cross the process boundary —
+the single-controller SPMD bring-up of SURVEY §5.8 (every process runs
+this same program; reference analogue: Spark driver/executor).
+
+Invoked by tests/test_multihost.py as:
+    python multihost_worker.py <process_id> <num_processes> <port>
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+os.environ["JAX_ENABLE_X64"] = "1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from transmogrifai_tpu.parallel import initialize_distributed, make_mesh
+    count = initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n, process_id=pid)
+    assert count == 2 * n, f"expected {2 * n} global devices, got {count}"
+
+    import numpy as np
+    from transmogrifai_tpu.parallel.cv import fit_linear_fold_grid
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(240, 6))
+    w = rng.normal(size=6)
+    y = (X @ w > 0).astype(float)
+    masks = np.zeros((2, 240))
+    masks[0, :160] = 1
+    masks[1, 80:] = 1
+    grid = np.array([[0.0, 0.0], [0.1, 0.0], [0.1, 0.5], [1.0, 0.0]])
+    mesh = make_mesh({"models": 2, "data": 2})
+
+    params_mesh = fit_linear_fold_grid("logistic", X, y, masks, grid,
+                                       mesh=mesh)
+    params_local = fit_linear_fold_grid("logistic", X, y, masks, grid,
+                                        mesh=None)
+    err = float(np.abs(params_mesh - params_local).max())
+    assert err < 1e-6, f"linear mesh/local diverged: {err}"
+
+    # tree family: candidates shard over the cross-process models axis
+    from transmogrifai_tpu.models import GBTClassifier
+    tree_mesh = make_mesh({"models": 4})
+    est = GBTClassifier(num_rounds=4, max_depth=3)
+    tgrid = [{"step_size": 0.1}, {"step_size": 0.3}]
+    models_mesh = est.fit_fold_grid_arrays(X, y, masks, tgrid,
+                                           mesh=tree_mesh)
+    models_local = est.fit_fold_grid_arrays(X, y, masks, tgrid)
+    for f in range(2):
+        for g in range(2):
+            np.testing.assert_allclose(models_mesh[f][g].thrs,
+                                       models_local[f][g].thrs, rtol=1e-6)
+            np.testing.assert_allclose(models_mesh[f][g].leaves,
+                                       models_local[f][g].leaves,
+                                       rtol=1e-5)
+    print(f"proc {pid}: multihost kernels OK (linear diff {err:.2e})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Multi-host distributed execution (SURVEY §5.8).
+
+Spawns 2 real OS processes that join one jax.distributed cluster
+(coordinator on localhost — the DCN analogue), each contributing 2
+virtual CPU devices, and runs the PRODUCTION fold x grid kernels on the
+resulting 4-device global mesh. Collectives cross the process boundary;
+results must match the single-process path. This is the "cluster
+without a cluster" for the multi-host story, one level up from the
+in-process 8-device mesh the rest of the suite uses.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_runs_production_kernels():
+    # subprocess communicate() carries its own 280s timeout
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(i), "2", port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-3000:]}"
+        assert "multihost kernels OK" in out

@@ -51,6 +51,7 @@ import numpy as np
 
 from ..features.columns import PredictionColumn
 from .base import ClassifierModel, Predictor, RegressionModel, num_classes
+from ..parallel.mesh import to_host
 
 __all__ = [
     "DecisionTreeClassifier", "DecisionTreeRegressor",
@@ -1101,9 +1102,9 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
             jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
             jnp.asarray(sr), *design, narrow, wide, y_j,
             jax.random.PRNGKey(cand0.seed))
-        feats = np.asarray(feats)[:count]
-        thrs = np.asarray(thrs)[:count]
-        leaves = np.asarray(leaves)[:count]
+        feats = to_host(feats)[:count]
+        thrs = to_host(thrs)[:count]
+        leaves = to_host(leaves)[:count]
         model_cls = (TreeEnsembleClassifierModel if classification
                      else TreeEnsembleRegressorModel)
         for f in range(F):
@@ -1159,10 +1160,10 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
             jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
             jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
             *design[:4], y_j, jax.random.PRNGKey(cand0.seed))
-        feats = np.asarray(feats)[:count]
-        thrs = np.asarray(thrs)[:count]
-        leaves = np.asarray(leaves)[:count]
-        base = np.asarray(base)[:count]
+        feats = to_host(feats)[:count]
+        thrs = to_host(thrs)[:count]
+        leaves = to_host(leaves)[:count]
+        base = to_host(base)[:count]
         for f in range(F):
             for j, (gi, cand) in enumerate(members):
                 c = f * gk + j

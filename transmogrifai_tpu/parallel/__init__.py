@@ -4,9 +4,9 @@ with jax.sharding meshes and XLA collectives over ICI."""
 from .distributed import (initialize_distributed, shard_wide_matrix,
                           wide_matrix_sharding)
 from .mesh import (Mesh, NamedSharding, PartitionSpec, cv_mesh, make_mesh,
-                   n_devices, replicate, shard_rows)
+                   n_devices, replicate, shard_rows, to_host)
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "cv_mesh", "make_mesh",
-           "n_devices", "replicate", "shard_rows",
+           "n_devices", "replicate", "shard_rows", "to_host",
            "initialize_distributed", "wide_matrix_sharding",
            "shard_wide_matrix"]

@@ -30,6 +30,8 @@ from typing import Dict, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .mesh import to_host
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.linear import (binary_logistic_core, linear_regression_core,
@@ -136,7 +138,7 @@ def fit_linear_fold_grid(kind: str, X: np.ndarray, y: np.ndarray,
     fn = _mesh_kernel(cfg, mesh)
     params = fn(jnp.asarray(wmat), jnp.asarray(regs),
                 jnp.asarray(alphas), jnp.asarray(X), jnp.asarray(y))
-    return np.asarray(params)[:FG].reshape(F, G, d + 1)
+    return to_host(params)[:FG].reshape(F, G, d + 1)
 
 
 def _candidate_fit(cfg, w, reg, alpha, X_, y_, axis_name=None):

@@ -70,3 +70,16 @@ def replicate(mesh: Mesh) -> NamedSharding:
 def shard_rows(mesh: Mesh, axis: str = "data") -> NamedSharding:
     """Shard a (rows, ...) array's leading dim over one mesh axis."""
     return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def to_host(array) -> "np.ndarray":
+    """Materialize a (possibly multi-process global) jax.Array on the
+    host. Single-process arrays convert directly; arrays spanning other
+    processes' devices gather their remote shards first
+    (multihost_utils.process_allgather) — the DCN hop of SURVEY §5.8.
+    """
+    if getattr(array, "is_fully_addressable", True):
+        return np.asarray(array)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        array, tiled=True))
