@@ -264,3 +264,40 @@ def test_wide_matrix_sharded_fit(rng):
     # coefficients stay sharded over the feature axis
     spec = out[0].sharding.spec
     assert tuple(spec) == ("data",)
+
+
+def test_mlp_nb_mesh_kernels_match_local(rng):
+    """MLP and NaiveBayes fold kernels sharded over the mesh 'models'
+    axis select/produce the same models as their local vmapped paths
+    (same mapping the linear/tree kernels use)."""
+    import numpy as np
+    from transmogrifai_tpu.models import (MultilayerPerceptronClassifier,
+                                          NaiveBayes)
+    from transmogrifai_tpu.parallel import make_mesh
+    X = rng.normal(size=(160, 6))
+    y = ((X[:, 0] + X[:, 1]) > 0.2).astype(float)
+    masks = np.zeros((3, 160))
+    for f in range(3):
+        masks[f] = 1.0
+        masks[f, f::3] = 0.0
+    mesh = make_mesh({"models": 8})
+
+    est = MultilayerPerceptronClassifier(max_iter=25)
+    grid = [{"hidden_layers": (6,)}]
+    local = est.fit_fold_grid_arrays(X, y, masks, grid)
+    meshed = est.fit_fold_grid_arrays(X, y, masks, grid, mesh=mesh)
+    for f in range(3):
+        for Wl, Wm in zip(local[f][0].weights, meshed[f][0].weights):
+            np.testing.assert_allclose(Wl, Wm, atol=1e-8)
+
+    Xp = np.abs(X)
+    nb = NaiveBayes()
+    ngrid = [{"smoothing": 0.5}, {"smoothing": 2.0}]
+    local_nb = nb.fit_fold_grid_arrays(Xp, y, masks, ngrid)
+    mesh_nb = nb.fit_fold_grid_arrays(Xp, y, masks, ngrid, mesh=mesh)
+    for f in range(3):
+        for g in range(2):
+            np.testing.assert_allclose(local_nb[f][g].pi,
+                                       mesh_nb[f][g].pi, atol=1e-12)
+            np.testing.assert_allclose(local_nb[f][g].theta,
+                                       mesh_nb[f][g].theta, atol=1e-12)

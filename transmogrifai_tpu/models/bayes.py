@@ -49,9 +49,8 @@ def _fit_nb(X, y, smoothing, *, num_classes: int, model_type: str):
                            num_classes, model_type)
 
 
-@functools.partial(jax.jit, static_argnames=("num_classes", "model_type"))
-def _fit_nb_masked(X, y, masks, smoothing, *, num_classes: int,
-                   model_type: str):
+def _nb_masked_body(X, y, masks, smoothing, *, num_classes: int,
+                    model_type: str):
     """Fold x grid candidates as one vmapped program: candidate =
     (fold mask, traced smoothing); mask-weighted class/feature sums
     equal the per-fold subset sums, so each lane reproduces the
@@ -67,6 +66,31 @@ def _fit_nb_masked(X, y, masks, smoothing, *, num_classes: int,
     return jax.vmap(one)(masks, smoothing)
 
 
+@functools.partial(jax.jit, static_argnames=("num_classes", "model_type"))
+def _fit_nb_masked(X, y, masks, smoothing, *, num_classes: int,
+                   model_type: str):
+    return _nb_masked_body(X, y, masks, smoothing,
+                           num_classes=num_classes, model_type=model_type)
+
+
+@functools.lru_cache(maxsize=None)
+def _nb_mesh_kernel(num_classes: int, model_type: str, mesh):
+    """Candidate axis sharded over the mesh ``models`` axis (same
+    mapping as the other family kernels); X/y replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    def batched(masks, smoothing, X, y):
+        return _nb_masked_body(X, y, masks, smoothing,
+                               num_classes=num_classes,
+                               model_type=model_type)
+
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None), P("models"), P(), P()),
+        out_specs=(P("models", None), P("models", None, None)),
+        check_vma=False))
+
+
 class NaiveBayes(Predictor):
     """Multinomial/Bernoulli naive Bayes (reference OpNaiveBayes.scala).
     Requires non-negative features, as in MLlib."""
@@ -80,8 +104,9 @@ class NaiveBayes(Predictor):
 
     def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
         """Validator fast path (see _ValidatorBase.validate): smoothing
-        is traced, model_type groups statically. ``mesh`` accepted for
-        call symmetry; NB candidate counts are tiny."""
+        is traced, model_type groups statically; fold x grid candidates
+        shard over the mesh ``models`` axis when a mesh is supplied
+        (padded with all-ones masks)."""
         if (np.asarray(X) < 0).any():
             raise ValueError("NaiveBayes requires non-negative features")
         grid = [dict(p) for p in (list(grid) or [{}])]
@@ -101,14 +126,23 @@ class NaiveBayes(Predictor):
             cand = self.with_params(**p)
             groups.setdefault(cand.model_type, []).append((gi, cand))
         X_j, y_j = jnp.asarray(X), jnp.asarray(y)
+        from ..parallel.mesh import to_host
+        from .trees import _pad_candidates
         for model_type, members in groups.items():
             gk = len(members)
             sm = np.tile([float(c.smoothing) for _, c in members], F)
             masks_c = np.repeat(masks, gk, axis=0)   # fold-major
-            pi, theta = _fit_nb_masked(
-                X_j, y_j, jnp.asarray(masks_c), jnp.asarray(sm),
-                num_classes=k, model_type=model_type)
-            pi, theta = np.asarray(pi), np.asarray(theta)
+            (masks_c, sm), _ = _pad_candidates(
+                mesh, [masks_c, sm], masks_c.shape[1])
+            if mesh is not None:
+                fn = _nb_mesh_kernel(k, model_type, mesh)
+                pi, theta = fn(jnp.asarray(masks_c), jnp.asarray(sm),
+                               X_j, y_j)
+            else:
+                pi, theta = _fit_nb_masked(
+                    X_j, y_j, jnp.asarray(masks_c), jnp.asarray(sm),
+                    num_classes=k, model_type=model_type)
+            pi, theta = to_host(pi), to_host(theta)
             for f in range(F):
                 for j, (gi, _) in enumerate(members):
                     c = f * gk + j

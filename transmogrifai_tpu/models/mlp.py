@@ -60,8 +60,7 @@ def _fit_mlp(X, y, key, *, sizes: Tuple[int, ...], max_iter: int,
     return lbfgs_minimize(loss, params0, max_iter=max_iter, tol=tol)
 
 
-@functools.partial(jax.jit, static_argnames=("sizes", "max_iter", "tol"))
-def _fit_mlp_folds(X, y, masks, key, *, sizes: Tuple[int, ...],
+def _mlp_fold_body(X, y, masks, key, *, sizes: Tuple[int, ...],
                    max_iter: int, tol: float):
     """All folds of one MLP config as ONE vmapped L-BFGS program: the
     mask-weighted mean cross-entropy over the full matrix equals the
@@ -84,6 +83,34 @@ def _fit_mlp_folds(X, y, masks, key, *, sizes: Tuple[int, ...],
     return jax.vmap(one_fold)(masks)
 
 
+@functools.partial(jax.jit, static_argnames=("sizes", "max_iter", "tol"))
+def _fit_mlp_folds(X, y, masks, key, *, sizes: Tuple[int, ...],
+                   max_iter: int, tol: float):
+    return _mlp_fold_body(X, y, masks, key, sizes=sizes,
+                          max_iter=max_iter, tol=tol)
+
+
+@functools.lru_cache(maxsize=None)
+def _mlp_mesh_kernel(sizes: Tuple[int, ...], max_iter: int, tol: float,
+                     mesh):
+    """Fold kernel sharded over the mesh ``models`` axis (same mapping
+    as the tree/linear fold x grid kernels): each shard trains its
+    slice of fold candidates; X/y/key replicate."""
+    from jax.sharding import PartitionSpec as P
+    n_layers = len(sizes) - 1
+    out_specs = [(P("models", None, None), P("models", None))
+                 for _ in range(n_layers)]
+
+    def batched(masks, X, y, key):
+        return _mlp_fold_body(X, y, masks, key, sizes=sizes,
+                              max_iter=max_iter, tol=tol)
+
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None), P(), P(), P()),
+        out_specs=out_specs, check_vma=False))
+
+
 class MultilayerPerceptronClassifier(Predictor):
     """Feed-forward classifier (reference
     OpMultilayerPerceptronClassifier.scala:48). ``hidden_layers`` are the
@@ -101,9 +128,9 @@ class MultilayerPerceptronClassifier(Predictor):
     def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
         """Validator fast path (see _ValidatorBase.validate): grid
         points group by their (all static) params, and each group's
-        folds train as one vmapped program. ``mesh`` is accepted for
-        call symmetry with the tree/linear kernels; MLP candidate
-        counts are small, so they run on the local device."""
+        folds train as one vmapped program — sharded over the mesh
+        ``models`` axis when a ("models", ...) mesh is supplied (fold
+        candidates padded to the shard count with all-ones masks)."""
         grid = [dict(p) for p in (list(grid) or [{}])]
         allowed = {"hidden_layers", "max_iter", "tol", "seed"}
         for p in grid:
@@ -123,15 +150,23 @@ class MultilayerPerceptronClassifier(Predictor):
             groups.setdefault(key, []).append(gi)
         X_j = jnp.asarray(X)
         y_j = jnp.asarray(y)
-        m_j = jnp.asarray(masks).astype(X_j.dtype)
+        from ..parallel.mesh import to_host
+        from .trees import _pad_candidates
+        (masks_p,), _ = _pad_candidates(mesh, [masks], masks.shape[1])
+        m_j = jnp.asarray(masks_p).astype(X_j.dtype)
         for (hidden, mi, tol, seed), gis in groups.items():
             sizes = (X.shape[1],) + tuple(hidden) + (k,)
-            params = _fit_mlp_folds(X_j, y_j, m_j,
-                                    jax.random.PRNGKey(seed), sizes=sizes,
-                                    max_iter=mi, tol=tol)
+            if mesh is not None:
+                fn = _mlp_mesh_kernel(sizes, mi, tol, mesh)
+                params = fn(m_j, X_j, y_j, jax.random.PRNGKey(seed))
+            else:
+                params = _fit_mlp_folds(X_j, y_j, m_j,
+                                        jax.random.PRNGKey(seed),
+                                        sizes=sizes, max_iter=mi, tol=tol)
+            params_h = [(to_host(W), to_host(b)) for W, b in params]
             for f in range(F):
-                ws = [np.asarray(W[f]) for W, _ in params]
-                bs = [np.asarray(b[f]) for _, b in params]
+                ws = [W[f] for W, _ in params_h]
+                bs = [b[f] for _, b in params_h]
                 mdl = MultilayerPerceptronClassifierModel(weights=ws,
                                                           biases=bs)
                 for gi in gis:      # identical configs share the fit
