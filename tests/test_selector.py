@@ -307,15 +307,20 @@ class TestBatchedEvaluation:
         rng = np.random.default_rng(4)
         X = rng.normal(size=(300, 8))
         y = ((X[:, 0] + X[:, 1] ** 2) > 0.8).astype(float)
+        from transmogrifai_tpu.parallel import make_mesh
         pool = [(MultilayerPerceptronClassifier(max_iter=40),
                  [{"hidden_layers": (8,)}, {"hidden_layers": (12, 6)}])]
+        # batched MLP is mesh-only (fold_grid_needs_mesh): supply the
+        # virtual 8-device mesh so the kernel actually runs
         cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=3,
-                             seed=5)
+                             seed=5, mesh=make_mesh({"models": 8}))
         best_batched = cv.validate(pool, X, y)
-        monkeypatch.setattr(
-            MultilayerPerceptronClassifier, "fit_fold_grid_arrays",
-            lambda *a, **k: (_ for _ in ()).throw(NotImplementedError()))
-        best_seq = cv.validate(pool, X, y)
+        # no mesh -> fold_grid_needs_mesh keeps MLP on the sequential
+        # path; assert that directly instead of monkeypatching
+        cv_seq = CrossValidation(BinaryClassificationEvaluator(),
+                                 num_folds=3, seed=5)
+        assert not cv_seq._use_batched_kernel(pool[0][0])
+        best_seq = cv_seq.validate(pool, X, y)
         assert best_batched.params == best_seq.params
         for rb, rs in zip(best_batched.results, best_seq.results):
             np.testing.assert_allclose(rb.metric_values, rs.metric_values,

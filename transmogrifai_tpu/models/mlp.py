@@ -116,6 +116,12 @@ class MultilayerPerceptronClassifier(Predictor):
     OpMultilayerPerceptronClassifier.scala:48). ``hidden_layers`` are the
     intermediate layer widths; input/output widths come from the data."""
 
+    #: the fold-batched kernel vmaps L-BFGS, forcing every fold into
+    #: lockstep line searches — a measured ~4x single-device slowdown
+    #: (BASELINE config 5). It pays off only when a mesh actually
+    #: spreads the candidates, so the validator uses it mesh-only.
+    fold_grid_needs_mesh = True
+
     def __init__(self, hidden_layers: Sequence[int] = (10,),
                  max_iter: int = 100, tol: float = 1e-6, seed: int = 42,
                  uid: Optional[str] = None):

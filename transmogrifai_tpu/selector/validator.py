@@ -112,6 +112,16 @@ class _ValidatorBase:
             round_robin(np.arange(len(y)))
         return assign
 
+    def _use_batched_kernel(self, estimator) -> bool:
+        """Whether to hand this family's grid to its batched fold
+        kernel: it must expose one, and families flagged
+        ``fold_grid_needs_mesh`` (vmapped-solver lockstep cost outweighs
+        single-device batching — see MultilayerPerceptronClassifier)
+        only batch when a mesh actually spreads the candidates."""
+        return hasattr(estimator, "fit_fold_grid_arrays") and not (
+            getattr(estimator, "fold_grid_needs_mesh", False)
+            and self.mesh is None)
+
     # -- main loop (reference getSummary, OpValidator.scala:270-310) -------
     def validate(self,
                  models: Sequence[Tuple[Predictor, Sequence[Dict]]],
@@ -131,7 +141,7 @@ class _ValidatorBase:
             # candidates in ONE batched XLA program (mesh-sharded when
             # self.mesh is set) instead of len(grid) x folds fits
             fitted = None
-            if hasattr(estimator, "fit_fold_grid_arrays"):
+            if self._use_batched_kernel(estimator):
                 try:
                     fitted = estimator.fit_fold_grid_arrays(
                         X, y, masks, grid, mesh=self.mesh)
@@ -199,7 +209,7 @@ class _ValidatorBase:
         for estimator, grid in models:
             grid = list(grid) or [{}]
             fitted = None
-            if hasattr(estimator, "fit_fold_grid_arrays"):
+            if self._use_batched_kernel(estimator):
                 try:
                     fitted = [
                         estimator.fit_fold_grid_arrays(
