@@ -187,4 +187,7 @@ def run(csv_path: str = None, model_stage=None, verbose: bool = True,
 
 
 if __name__ == "__main__":
+    from transmogrifai_tpu.utils.jax_setup import (
+        pin_platform_from_env)
+    pin_platform_from_env()
     run(csv_path=sys.argv[1] if len(sys.argv) > 1 else None)

@@ -51,9 +51,10 @@ def main() -> None:
     ap.add_argument("--card", type=int, default=500)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
-    if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    from transmogrifai_tpu.utils.jax_setup import pin_platform_from_env
+    pin_platform_from_env()
     from transmogrifai_tpu.utils.jax_setup import enable_compilation_cache
     enable_compilation_cache()
 

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["enable_compilation_cache", "device_trace"]
+__all__ = ["enable_compilation_cache", "device_trace",
+           "pin_platform_from_env"]
 
 
 def device_trace(log_dir: str):
@@ -72,3 +73,23 @@ def enable_compilation_cache(path: str = None) -> str:
     except AttributeError:  # older jax without the knob
         pass
     return path
+
+
+def pin_platform_from_env() -> None:
+    """Honor a JAX_PLATFORMS env request via jax.config.
+
+    In this environment the env var alone is NOT enough: a
+    sitecustomize imports jax at interpreter start and the remote-TPU
+    (axon) plugin can dial its tunnel during backend discovery even
+    when the env filter says cpu — hanging indefinitely if the tunnel
+    is down. ``jax.config.update("jax_platforms", ...)`` after import
+    reliably avoids the dial, so entry points (examples, benches) call
+    this once before first device use.
+    """
+    import os
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
