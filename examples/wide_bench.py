@@ -100,6 +100,9 @@ def main() -> None:
     # train is already reported as feature_eng_seconds above)
     sel_s = sum(m.seconds for m in listener.metrics.stage_metrics
                 if "ModelSelector" in m.stage_name)
+    if not sel_s:
+        raise SystemExit("no ModelSelector stage timed by the listener; "
+                         "cannot report a selector rate")
     mf = len(grid) * num_folds
     print(json.dumps({
         "config": "wide_hicard_mlp", "rows": args.rows,

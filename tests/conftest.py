@@ -38,3 +38,8 @@ def _deterministic_uids():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running parity tests (TX_RUN_SLOW=1)")

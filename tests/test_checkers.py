@@ -222,6 +222,34 @@ class TestRawFeatureFilter:
         # scoring works without the dead feature
         scored = model.score(records[:5])
         assert scored[model.result_features[0].name].data.shape == (5,)
+        # RFF results ride on the fitted model (r4: reference
+        # OpWorkflowModelWriter.scala:75-120) ...
+        rff_res = model.raw_feature_filter_results
+        assert rff_res is not None
+        assert "dead" in rff_res.excluded_names
+        assert model.blacklisted_feature_names == ["dead"]
+        # ... survive save/load ...
+        import tempfile
+
+        from transmogrifai_tpu.workflow.persistence import (load_model,
+                                                            save_model)
+        with tempfile.TemporaryDirectory() as tmp:
+            save_model(model, tmp)
+            loaded = load_model(tmp)
+        assert loaded.raw_feature_filter_results is not None
+        assert "dead" in loaded.raw_feature_filter_results.excluded_names
+        assert loaded.blacklisted_feature_names == ["dead"]
+        names = {d.name for d
+                 in loaded.raw_feature_filter_results.train_distributions}
+        assert "dead" in names and "x" in names
+        # ... and surface in ModelInsights (reference
+        # ModelInsights.scala:72)
+        from transmogrifai_tpu.insights import extract_model_insights
+        ins = extract_model_insights(model)
+        by_name = {fi.feature_name: fi for fi in ins.features}
+        assert by_name["dead"].exclusion_reasons
+        assert any(d.get("split") == "train"
+                   for d in by_name["dead"].distributions)
 
 
 class TestRewire:

@@ -1,0 +1,181 @@
+"""Device-resident selector search parity.
+
+The fused fit+metric kernels (eval_fold_grid_arrays) must reproduce the
+host evaluation path's per-candidate metrics and winner — the search is
+only faster, never different (the property VERDICT r3 demanded of the
+on-device metric redesign).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import (BinaryClassificationEvaluator,
+                                          MultiClassificationEvaluator,
+                                          RegressionEvaluator)
+from transmogrifai_tpu.models import (GBTClassifier, GBTRegressor,
+                                      LinearRegression, LinearSVC,
+                                      LogisticRegression, NaiveBayes,
+                                      RandomForestClassifier,
+                                      RandomForestRegressor)
+from transmogrifai_tpu.selector import CrossValidation
+
+
+def _host_only(evaluator):
+    """Evaluator clone whose device spec is disabled — forces the host
+    per-candidate path."""
+    import copy
+    ev = copy.copy(evaluator)
+    ev.device_metric_spec = lambda: None
+    return ev
+
+
+def _assert_same_search(pool, X, y, evaluator, atol=1e-9):
+    cv_dev = CrossValidation(evaluator, num_folds=3, seed=7)
+    cv_host = CrossValidation(_host_only(evaluator), num_folds=3, seed=7)
+    best_dev = cv_dev.validate(pool, X, y)
+    best_host = cv_host.validate(pool, X, y)
+    assert best_dev.name == best_host.name
+    assert best_dev.params == best_host.params
+    for rd, rh in zip(best_dev.results, best_host.results):
+        assert rd.model_name == rh.model_name
+        assert rd.params == rh.params
+        np.testing.assert_allclose(rd.metric_values, rh.metric_values,
+                                   atol=atol, err_msg=rd.model_name)
+    return best_dev
+
+
+class TestBinaryDeviceSearch:
+    def test_full_binary_pool_parity(self, rng):
+        X = rng.normal(size=(240, 6))
+        X[:, 3] = np.abs(X[:, 3])               # keep NB viable? no: mixed
+        y = ((X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.normal(size=240)) > 0
+             ).astype(float)
+        pool = [
+            (LogisticRegression(),
+             [{"reg_param": 0.0}, {"reg_param": 0.1,
+                                   "elastic_net_param": 0.5}]),
+            (LinearSVC(), [{"reg_param": 0.01}]),
+            (RandomForestClassifier(num_trees=10, max_depth=4),
+             [{"min_instances_per_node": 1},
+              {"min_instances_per_node": 20}]),
+            (GBTClassifier(num_rounds=8, max_depth=3),
+             [{"step_size": 0.1}, {"step_size": 0.3}]),
+            (NaiveBayes(), [{"smoothing": 1.0}]),  # negative X -> drops out
+        ]
+        best = _assert_same_search(pool, X, y,
+                                   BinaryClassificationEvaluator())
+        assert best.metric > 0.6
+
+    def test_nonneg_pool_with_nb(self, rng):
+        X = np.abs(rng.normal(size=(200, 5)))
+        y = (X[:, 0] + X[:, 1] > 1.5).astype(float)
+        pool = [
+            (NaiveBayes(), [{"smoothing": 0.5}, {"smoothing": 2.0}]),
+            (LogisticRegression(), [{"reg_param": 0.01}]),
+        ]
+        _assert_same_search(pool, X, y, BinaryClassificationEvaluator())
+
+    def test_error_metric(self, rng):
+        X = rng.normal(size=(150, 4))
+        y = (X[:, 0] > 0).astype(float)
+        pool = [(LogisticRegression(),
+                 [{"reg_param": 0.0}, {"reg_param": 10.0}])]
+        ev = BinaryClassificationEvaluator(default_metric="Error")
+        _assert_same_search(pool, X, y, ev)
+
+
+class TestMulticlassDeviceSearch:
+    def test_multiclass_pool_parity(self, rng):
+        X = np.abs(rng.normal(size=(240, 5)))
+        y = rng.integers(0, 3, 240).astype(float)
+        y[X[:, 0] > 1.0] = 2.0                   # some signal
+        pool = [
+            (RandomForestClassifier(num_trees=8, max_depth=4),
+             [{"min_instances_per_node": 1}]),
+            (NaiveBayes(), [{"smoothing": 1.0}]),
+        ]
+        _assert_same_search(pool, X, y, MultiClassificationEvaluator())
+
+
+class TestRegressionDeviceSearch:
+    def test_regression_pool_parity(self, rng):
+        X = rng.normal(size=(240, 5))
+        y = X @ np.array([1.0, -2.0, 0.5, 0.0, 0.3]) \
+            + 0.1 * rng.normal(size=240)
+        pool = [
+            (LinearRegression(),
+             [{"reg_param": 0.0}, {"reg_param": 1.0}]),
+            (RandomForestRegressor(num_trees=8, max_depth=4),
+             [{"min_instances_per_node": 5}]),
+            (GBTRegressor(num_rounds=8, max_depth=3),
+             [{"step_size": 0.2}]),
+        ]
+        best = _assert_same_search(pool, X, y, RegressionEvaluator())
+        assert best.metric < 2.0
+
+
+class TestDeviceSearchOnMesh:
+    def test_mesh_matches_local_device_search(self, rng):
+        from transmogrifai_tpu.parallel import make_mesh
+        X = rng.normal(size=(160, 5))
+        y = (X[:, 0] + X[:, 2] > 0).astype(float)
+        pool = [
+            (LogisticRegression(),
+             [{"reg_param": 0.0}, {"reg_param": 0.1}]),
+            (GBTClassifier(num_rounds=6, max_depth=3),
+             [{"step_size": 0.1}, {"step_size": 0.3}]),
+        ]
+        ev = BinaryClassificationEvaluator()
+        local = CrossValidation(ev, num_folds=2, seed=3).validate(
+            pool, X, y)
+        mesh = make_mesh({"models": 8})
+        meshed = CrossValidation(ev, num_folds=2, seed=3,
+                                 mesh=mesh).validate(pool, X, y)
+        assert meshed.name == local.name
+        assert meshed.params == local.params
+        for rm, rl in zip(meshed.results, local.results):
+            np.testing.assert_allclose(rm.metric_values, rl.metric_values,
+                                       atol=1e-9)
+
+    def test_mesh_with_data_axis(self, rng):
+        from transmogrifai_tpu.parallel import make_mesh
+        X = rng.normal(size=(160, 5))
+        y = (X[:, 0] + X[:, 2] > 0).astype(float)
+        pool = [(LogisticRegression(),
+                 [{"reg_param": 0.0}, {"reg_param": 0.1}])]
+        ev = BinaryClassificationEvaluator()
+        local = CrossValidation(ev, num_folds=2, seed=3).validate(
+            pool, X, y)
+        mesh = make_mesh({"models": 2, "data": 4})
+        meshed = CrossValidation(ev, num_folds=2, seed=3,
+                                 mesh=mesh).validate(pool, X, y)
+        for rm, rl in zip(meshed.results, local.results):
+            np.testing.assert_allclose(rm.metric_values, rl.metric_values,
+                                       atol=1e-7)
+
+
+class TestWorkflowCVDeviceSearch:
+    def test_validate_prepared_parity(self, rng):
+        # per-fold prepared matrices (workflow-level CV entry) also run
+        # the device path, one fold at a time
+        X = rng.normal(size=(180, 5))
+        y = (X[:, 0] - X[:, 1] > 0).astype(float)
+        folds = []
+        rngs = np.random.default_rng(0)
+        for _ in range(3):
+            idx = rngs.permutation(180)
+            folds.append((X[idx[:120]], y[idx[:120]],
+                          X[idx[120:]], y[idx[120:]]))
+        pool = [(LogisticRegression(),
+                 [{"reg_param": 0.0}, {"reg_param": 0.1}]),
+                (GBTClassifier(num_rounds=6, max_depth=3),
+                 [{"step_size": 0.1}])]
+        ev = BinaryClassificationEvaluator()
+        dev = CrossValidation(ev, num_folds=3).validate_prepared(
+            pool, folds)
+        host_ev = _host_only(ev)
+        host = CrossValidation(host_ev, num_folds=3).validate_prepared(
+            pool, folds)
+        assert dev.name == host.name and dev.params == host.params
+        for rd, rh in zip(dev.results, host.results):
+            np.testing.assert_allclose(rd.metric_values, rh.metric_values,
+                                       atol=1e-9)

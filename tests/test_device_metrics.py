@@ -1,0 +1,165 @@
+"""Device metric kernels must reproduce the host evaluators exactly.
+
+The selector's device-resident search picks winners from these numbers
+(see evaluators/device_metrics.py); any drift vs the host evaluators
+could flip a winner between the batched and sequential paths.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.binary import binary_metrics
+from transmogrifai_tpu.evaluators.device_metrics import (
+    BINARY_METRICS, MULTICLASS_METRICS, REGRESSION_METRICS,
+    binary_from_raw_pair, binary_from_sigmoid, binary_from_votes,
+    binary_metric, multiclass_metric, regression_metric)
+from transmogrifai_tpu.evaluators.multiclass import multiclass_metrics
+from transmogrifai_tpu.evaluators.regression import regression_metrics
+
+
+def _host_binary(y, margin):
+    # host path: score = positive-class probability; hard label = the
+    # probability argmax (GBT-style sigmoid transform here)
+    score = 1.0 / (1.0 + np.exp(-margin))
+    return binary_metrics(y, (score > 1.0 - score).astype(np.float64),
+                          score)
+
+
+def _dev_binary(y, margin, metric):
+    score, plabel = binary_from_sigmoid(jnp.asarray(margin))
+    return float(binary_metric(jnp.asarray(y), score, plabel, metric))
+
+
+@pytest.mark.parametrize("metric", BINARY_METRICS)
+def test_binary_parity_random(metric, rng):
+    for trial in range(5):
+        n = int(rng.integers(3, 400))
+        y = rng.integers(0, 2, n).astype(np.float64)
+        margin = rng.normal(size=n)
+        # force score ties in some trials (the tie-grouped curve path)
+        if trial % 2:
+            margin = np.round(margin, 1)
+        host = float(getattr(_host_binary(y, margin), metric))
+        assert _dev_binary(y, margin, metric) == pytest.approx(
+            host, abs=1e-12), (metric, trial)
+
+
+def test_binary_saturated_sigmoid_ties(rng):
+    # saturation collapses distinct margins into tied probabilities:
+    # the device curve must tie-group on the PROBABILITY, as host does
+    y = rng.integers(0, 2, 64).astype(np.float64)
+    margin = rng.normal(size=64) * 60.0          # mostly p = exactly 0/1
+    for metric in ("AuPR", "AuROC"):
+        host = float(getattr(_host_binary(y, margin), metric))
+        assert _dev_binary(y, margin, metric) == pytest.approx(
+            host, abs=1e-12), metric
+
+
+def test_binary_softmax_pair_transform(rng):
+    # LogisticRegression host: raw = [-m, m] -> max-shifted softmax
+    y = rng.integers(0, 2, 100).astype(np.float64)
+    m = rng.normal(size=100) * 30
+    raw = np.stack([-m, m], axis=1)
+    shifted = raw - raw.max(axis=1, keepdims=True)
+    e = np.exp(shifted)
+    prob = e / e.sum(axis=1, keepdims=True)
+    host = binary_metrics(y, np.argmax(prob, axis=1).astype(np.float64),
+                          prob[:, 1])
+    score, plabel = binary_from_raw_pair(jnp.asarray(raw))
+    np.testing.assert_allclose(np.asarray(score), prob[:, 1], atol=0)
+    for metric in BINARY_METRICS:
+        dev = float(binary_metric(jnp.asarray(y), score, plabel, metric))
+        assert dev == pytest.approx(float(getattr(host, metric)),
+                                    abs=1e-12)
+
+
+def test_binary_vote_transform(rng):
+    # forest host: normalize vote masses by the row sum
+    y = rng.integers(0, 2, 80).astype(np.float64)
+    votes = rng.random(size=(80, 2))
+    s = votes.sum(axis=1, keepdims=True)
+    prob = votes / np.where(s > 0, s, 1.0)
+    host = binary_metrics(y, np.argmax(prob, axis=1).astype(np.float64),
+                          prob[:, 1])
+    score, plabel = binary_from_votes(jnp.asarray(votes))
+    for metric in BINARY_METRICS:
+        dev = float(binary_metric(jnp.asarray(y), score, plabel, metric))
+        assert dev == pytest.approx(float(getattr(host, metric)),
+                                    abs=1e-12)
+
+
+@pytest.mark.parametrize("metric", BINARY_METRICS)
+def test_binary_single_class(metric):
+    y = np.ones(10)
+    margin = np.linspace(-1, 1, 10)
+    host = float(getattr(_host_binary(y, margin), metric))
+    assert _dev_binary(y, margin, metric) == pytest.approx(host, abs=1e-12)
+
+
+def test_binary_all_tied_scores():
+    y = np.array([0.0, 1.0, 1.0, 0.0, 1.0])
+    margin = np.zeros(5)
+    for metric in ("AuPR", "AuROC"):
+        host = float(getattr(_host_binary(y, margin), metric))
+        assert _dev_binary(y, margin, metric) == pytest.approx(
+            host, abs=1e-12)
+
+
+@pytest.mark.parametrize("metric", MULTICLASS_METRICS)
+def test_multiclass_parity(metric, rng):
+    for _ in range(5):
+        n, k = int(rng.integers(5, 300)), int(rng.integers(2, 6))
+        y = rng.integers(0, k, n).astype(np.float64)
+        raw = rng.normal(size=(n, k))
+        pred = np.argmax(raw, axis=1).astype(np.float64)
+        host = float(getattr(multiclass_metrics(y, pred), metric))
+        dev = float(multiclass_metric(jnp.asarray(y), jnp.asarray(raw),
+                                      metric))
+        assert dev == pytest.approx(host, abs=1e-12)
+
+
+def test_multiclass_absent_class():
+    # class 2 never occurs in y: weighted PRF must ignore it (host
+    # iterates np.unique(y); device weights it zero)
+    y = np.array([0.0, 0, 1, 1, 0])
+    raw = np.eye(3)[np.array([0, 2, 1, 1, 2])]
+    pred = np.argmax(raw, axis=1).astype(np.float64)
+    for metric in MULTICLASS_METRICS:
+        host = float(getattr(multiclass_metrics(y, pred), metric))
+        dev = float(multiclass_metric(jnp.asarray(y), jnp.asarray(raw),
+                                      metric))
+        assert dev == pytest.approx(host, abs=1e-12)
+
+
+@pytest.mark.parametrize("metric", REGRESSION_METRICS)
+def test_regression_parity(metric, rng):
+    for _ in range(5):
+        n = int(rng.integers(2, 300))
+        y = rng.normal(size=n) * 10
+        pred = y + rng.normal(size=n)
+        host = float(getattr(regression_metrics(y, pred), metric))
+        dev = float(regression_metric(jnp.asarray(y), jnp.asarray(pred),
+                                      metric))
+        assert dev == pytest.approx(host, rel=1e-12, abs=1e-12)
+
+
+def test_constant_label_r2():
+    y = np.full(8, 3.0)
+    pred = np.arange(8.0)
+    host = float(regression_metrics(y, pred).R2)
+    dev = float(regression_metric(jnp.asarray(y), jnp.asarray(pred), "R2"))
+    assert dev == pytest.approx(host)
+
+
+def test_device_metric_specs():
+    from transmogrifai_tpu.evaluators import (
+        BinaryClassificationEvaluator, MultiClassificationEvaluator,
+        RegressionEvaluator)
+    assert (BinaryClassificationEvaluator().device_metric_spec()
+            == ("binary", "AuPR"))
+    assert (MultiClassificationEvaluator().device_metric_spec()
+            == ("multiclass", "F1"))
+    assert (RegressionEvaluator().device_metric_spec()
+            == ("regression", "RootMeanSquaredError"))
+    assert (BinaryClassificationEvaluator(default_metric="TP")
+            .device_metric_spec() is None)

@@ -131,3 +131,49 @@ def test_workflow_cv_changes_validation_metric(rng):
     assert naive.best_validation_metric != wcv.best_validation_metric
     # both searched the same grid and scoring still works end-to-end
     assert len(naive.validation_results) == len(wcv.validation_results)
+
+
+def test_workflow_cv_imbalanced_with_balancer():
+    """Documented deviation (workflow/workflow.py
+    _find_best_with_workflow_cv): the selector's DataBalancer applies
+    only at the final full refit — the per-fold search relies on
+    stratified folds for class balance. On 10:1 imbalanced data the
+    search must still complete, keep every fold's metric finite (no
+    single-class folds), and the final balanced refit must produce a
+    model that actually detects the minority class."""
+    from transmogrifai_tpu.selector.splitters import DataBalancer
+    rng = np.random.default_rng(7)
+    recs = []
+    for i in range(440):
+        xs = rng.normal(size=5)
+        # ~9% positives, signal on x0
+        y = float(xs[0] > 1.3)
+        rec = {f"x{j}": float(xs[j]) for j in range(5)}
+        rec["label"] = y
+        recs.append(rec)
+    assert 0.05 < np.mean([r["label"] for r in recs]) < 0.18
+    label = FeatureBuilder.real_nn("label").extract(
+        lambda r: r["label"]).as_response()
+    xs = [FeatureBuilder.real(f"x{j}").extract(
+        lambda r, j=j: r[f"x{j}"]).as_predictor() for j in range(5)]
+    fv = transmogrify(xs)
+    checked = SanityChecker(check_sample=1.0).set_input(
+        label, fv).get_output()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, stratify=True,
+        splitter=DataBalancer(sample_fraction=0.4, seed=3),
+        models=[(LogisticRegression(max_iter=25),
+                 [{"reg_param": r} for r in (0.01, 0.1)])])
+    pred = selector.set_input(label, checked).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(recs).with_workflow_cv().train())
+    sel_model = [s for s in model.stages()
+                 if isinstance(s, SelectedModel)][0]
+    for r in sel_model.summary.validation_results:
+        assert all(np.isfinite(v) for v in r.metric_values), r
+    scored = model.score(recs)
+    pred_labels = scored[pred.name].data
+    y = np.array([r["label"] for r in recs])
+    # balanced refit must not collapse to the majority class
+    assert pred_labels[y == 1].mean() > 0.5
+    assert (pred_labels == y).mean() > 0.85

@@ -74,6 +74,13 @@ class FeatureDistribution:
                 "distribution": self.distribution.tolist(),
                 "isNumeric": self.is_numeric, "fillRate": self.fill_rate}
 
+    @classmethod
+    def from_json(cls, d: dict) -> "FeatureDistribution":
+        return cls(name=d["name"], count=d["count"], nulls=d["nulls"],
+                   distribution=np.asarray(d["distribution"],
+                                           dtype=np.float64),
+                   is_numeric=d["isNumeric"])
+
 
 @dataclass
 class ExclusionReason:
@@ -83,6 +90,10 @@ class ExclusionReason:
 
     def to_json(self) -> dict:
         return {"name": self.name, "reason": self.reason}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ExclusionReason":
+        return cls(name=d["name"], reason=d["reason"])
 
 
 @dataclass
@@ -110,6 +121,16 @@ class RawFeatureFilterResults:
             "scoreDistributions": [d.to_json()
                                    for d in self.score_distributions],
             "exclusions": [e.to_json() for e in self.exclusions]}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "RawFeatureFilterResults":
+        return cls(
+            train_distributions=[FeatureDistribution.from_json(x)
+                                 for x in d.get("trainDistributions", [])],
+            score_distributions=[FeatureDistribution.from_json(x)
+                                 for x in d.get("scoreDistributions", [])],
+            exclusions=[ExclusionReason.from_json(x)
+                        for x in d.get("exclusions", [])])
 
 
 class RawFeatureFilter:

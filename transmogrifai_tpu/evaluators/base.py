@@ -111,6 +111,27 @@ class Evaluator:
     def metric_from(self, metrics: EvaluationMetrics) -> float:
         return float(getattr(metrics, self.default_metric))
 
+    def device_metric_spec(self):
+        """(kind, metric_name) consumed by the on-device fold x grid
+        metric kernels (evaluators/device_metrics.py), or None when the
+        default metric can't be computed on device (custom evaluators,
+        metrics outside the supported sets) — the validator then keeps
+        the host per-candidate evaluation path."""
+        return None
+
+    def _device_spec(self, base_cls, supported, kind):
+        """Shared device_metric_spec body for the library evaluators:
+        subclasses that customize evaluation/metric extraction must keep
+        the host path (the device kernels can't see overrides), and the
+        default metric must be in the kernel-supported set."""
+        cls = type(self)
+        if (cls.metric_from is not Evaluator.metric_from
+                or cls.evaluate_arrays is not base_cls.evaluate_arrays):
+            return None
+        if self.default_metric in supported:
+            return (kind, self.default_metric)
+        return None
+
     def set_columns(self, label_col: str, prediction_col: str) -> "Evaluator":
         self.label_col = label_col
         self.prediction_col = prediction_col

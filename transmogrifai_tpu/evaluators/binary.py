@@ -167,6 +167,11 @@ class BinaryClassificationEvaluator(Evaluator):
         return binary_metrics(y, pred.data, score,
                               record_curves=self.record_curves)
 
+    def device_metric_spec(self):
+        from .device_metrics import BINARY_METRICS
+        return self._device_spec(BinaryClassificationEvaluator,
+                                 BINARY_METRICS, "binary")
+
 
 @dataclass
 class BinScoreMetrics(EvaluationMetrics):

@@ -127,3 +127,8 @@ class MultiClassificationEvaluator(Evaluator):
         prob = pred.probability if pred.probability.shape[1] else None
         return multiclass_metrics(y, pred.data, prob,
                                   top_ns=self.top_ns, n_bins=self.n_bins)
+
+    def device_metric_spec(self):
+        from .device_metrics import MULTICLASS_METRICS
+        return self._device_spec(MultiClassificationEvaluator,
+                                 MULTICLASS_METRICS, "multiclass")

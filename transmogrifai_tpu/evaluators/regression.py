@@ -55,3 +55,8 @@ class RegressionEvaluator(Evaluator):
     def evaluate_arrays(self, y: np.ndarray, pred: PredictionColumn
                         ) -> RegressionMetrics:
         return regression_metrics(y, pred.data)
+
+    def device_metric_spec(self):
+        from .device_metrics import REGRESSION_METRICS
+        return self._device_spec(RegressionEvaluator,
+                                 REGRESSION_METRICS, "regression")

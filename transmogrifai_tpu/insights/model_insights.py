@@ -76,6 +76,12 @@ class FeatureInsights:
     feature_name: str
     feature_type: str = ""
     derived: List[DerivedFeatureInsight] = field(default_factory=list)
+    #: RawFeatureFilter train/score distributions for this raw feature
+    #: (reference ModelInsights feature distributions)
+    distributions: List[dict] = field(default_factory=list)
+    #: RawFeatureFilter exclusion reasons (non-empty = feature was
+    #: blacklisted before training)
+    exclusion_reasons: List[str] = field(default_factory=list)
 
     @property
     def total_contribution(self) -> float:
@@ -84,7 +90,9 @@ class FeatureInsights:
     def to_json(self) -> dict:
         return {"featureName": self.feature_name,
                 "featureType": self.feature_type,
-                "derivedFeatures": [d.to_json() for d in self.derived]}
+                "derivedFeatures": [d.to_json() for d in self.derived],
+                "distributions": list(self.distributions),
+                "exclusionReasons": list(self.exclusion_reasons)}
 
 
 @dataclass
@@ -217,6 +225,28 @@ def extract_model_insights(wf_model) -> ModelInsights:
                 cramers_v=cs.cramers_v, is_dropped=True,
                 dropped_reasons=list(cs.reasons)))
     insights.features = list(by_parent.values())
+
+    # RawFeatureFilter results (reference ModelInsights.scala:72 —
+    # distributions + exclusion reasons per raw feature; excluded
+    # features have no derived columns but still appear)
+    rff = getattr(wf_model, "raw_feature_filter_results", None)
+    if rff is not None:
+        by_name = {fi.feature_name: fi for fi in insights.features}
+
+        def entry(name: str) -> FeatureInsights:
+            if name not in by_name:
+                by_name[name] = FeatureInsights(feature_name=name)
+                insights.features.append(by_name[name])
+            return by_name[name]
+
+        for dist in rff.train_distributions:
+            entry(dist.name).distributions.append(
+                dict(dist.to_json(), split="train"))
+        for dist in rff.score_distributions:
+            entry(dist.name).distributions.append(
+                dict(dist.to_json(), split="score"))
+        for exc in rff.exclusions:
+            entry(exc.name).exclusion_reasons.append(exc.reason)
 
     if isinstance(pred_model, SelectedModel) and pred_model.summary:
         insights.selected_model = pred_model.summary.to_json()

@@ -28,7 +28,18 @@ from ..stages.base import BinaryEstimator, BinaryModel
 from ..types import OPVector, Prediction, RealNN
 
 __all__ = ["Predictor", "PredictionModel", "ClassifierModel",
-           "RegressionModel", "check_is_response_values"]
+           "RegressionModel", "check_is_response_values",
+           "FamilyPreconditionError"]
+
+
+class FamilyPreconditionError(ValueError):
+    """The data violates a model family's preconditions (e.g.
+    NaiveBayes on negative features). Subclasses ValueError so the
+    sequential per-fold handler still drops the candidate with NaN
+    metrics; the batched/device kernel entry points raise THIS type so
+    the validator can distinguish 'family not applicable' from a
+    genuine kernel bug (which must propagate, not silently fall back
+    to the slow host path)."""
 
 
 def check_is_response_values(label, features) -> None:

@@ -14,8 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import (ClassifierModel, Predictor,
-                   check_fold_classes, num_classes)
+from .base import (ClassifierModel, FamilyPreconditionError,
+                   Predictor, check_fold_classes, num_classes)
 
 __all__ = ["NaiveBayes", "NaiveBayesModel"]
 
@@ -73,7 +73,67 @@ def _fit_nb_masked(X, y, masks, smoothing, *, num_classes: int,
                            num_classes=num_classes, model_type=model_type)
 
 
-@functools.lru_cache(maxsize=None)
+def _nb_raw(pi, theta, Xv, model_type: str):
+    """(nv, K) log-joint scores — the device twin of
+    NaiveBayesModel.predict_raw."""
+    if model_type == "bernoulli":
+        Xb = (Xv != 0).astype(theta.dtype)
+        neg = jnp.log1p(-jnp.minimum(jnp.exp(theta), 1 - 1e-12))
+        return pi + Xb @ theta.T + (1.0 - Xb) @ neg.T
+    return pi + Xv @ theta.T
+
+
+def _nb_eval_body(X, y, masks, smoothing, fidx, Xv, yv, *,
+                  num_classes: int, model_type: str, spec: tuple):
+    """Fused fit + validation metric per candidate (device-resident
+    search — see evaluators/device_metrics.py). Binary margins are the
+    log-joint difference (argmax parity with the host softmax)."""
+    from ..evaluators.device_metrics import (binary_from_raw_pair,
+                                             metric_fn,
+                                             softmax_probability)
+    mfn = metric_fn(*spec)
+    labels = y.astype(jnp.int32)
+    Xf = (X != 0).astype(X.dtype) if model_type == "bernoulli" else X
+
+    def one(mask, sm, fi):
+        pi, theta = _nb_closed_form(Xf, labels, mask, sm, num_classes,
+                                    model_type)
+        raw = _nb_raw(pi, theta, Xv[fi], model_type)
+        # host NaiveBayesModel ranks by the softmax of the log-joints
+        scores = (binary_from_raw_pair(raw) if spec[0] == "binary"
+                  else softmax_probability(raw))
+        return mfn(yv[fi], scores)
+
+    return jax.vmap(one)(masks, smoothing, fidx)
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes", "model_type",
+                                             "spec"))
+def _eval_nb_masked(X, y, masks, smoothing, fidx, Xv, yv, *,
+                    num_classes: int, model_type: str, spec: tuple):
+    return _nb_eval_body(X, y, masks, smoothing, fidx, Xv, yv,
+                         num_classes=num_classes, model_type=model_type,
+                         spec=spec)
+
+
+@functools.lru_cache(maxsize=32)
+def _nb_eval_mesh_kernel(num_classes: int, model_type: str, spec: tuple,
+                         mesh):
+    from jax.sharding import PartitionSpec as P
+
+    def batched(masks, smoothing, fidx, X, y, Xv, yv):
+        return _nb_eval_body(X, y, masks, smoothing, fidx, Xv, yv,
+                             num_classes=num_classes,
+                             model_type=model_type, spec=spec)
+
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None), P("models"), P("models"),
+                  P(), P(), P(), P()),
+        out_specs=P("models"), check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
 def _nb_mesh_kernel(num_classes: int, model_type: str, mesh):
     """Candidate axis sharded over the mesh ``models`` axis (same
     mapping as the other family kernels); X/y replicate."""
@@ -108,7 +168,8 @@ class NaiveBayes(Predictor):
         shard over the mesh ``models`` axis when a mesh is supplied
         (padded with all-ones masks)."""
         if (np.asarray(X) < 0).any():
-            raise ValueError("NaiveBayes requires non-negative features")
+            raise FamilyPreconditionError(
+                "NaiveBayes requires non-negative features")
         grid = [dict(p) for p in (list(grid) or [{}])]
         allowed = {"smoothing", "model_type"}
         for p in grid:
@@ -150,9 +211,68 @@ class NaiveBayes(Predictor):
                         pi=pi[c], theta=theta[c], model_type=model_type)
         return models
 
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """Device-resident search: fused fit + validation metric, (F, G)
+        matrix out (candidate grouping mirrors fit_fold_grid_arrays)."""
+        if spec[0] not in ("binary", "multiclass"):
+            raise NotImplementedError(
+                "NaiveBayes device eval needs a classification metric")
+        if (np.asarray(X) < 0).any():
+            raise FamilyPreconditionError(
+                "NaiveBayes requires non-negative features")
+        k = num_classes(y)
+        if spec[0] == "binary" and k != 2:
+            raise NotImplementedError(
+                "binary device eval needs binary labels")
+        grid = [dict(p) for p in (list(grid) or [{}])]
+        allowed = {"smoothing", "model_type"}
+        for p in grid:
+            extra = set(p) - allowed
+            if extra:
+                raise NotImplementedError(
+                    f"batched NaiveBayes kernel cannot vary {sorted(extra)}")
+        masks = np.asarray(masks, dtype=np.float64)
+        check_fold_classes(y, masks)
+        F = masks.shape[0]
+        metric_mat = np.full((F, len(grid)), np.nan)
+        groups = {}
+        for gi, p in enumerate(grid):
+            cand = self.with_params(**p)
+            groups.setdefault(cand.model_type, []).append((gi, cand))
+        X_j, y_j = jnp.asarray(X), jnp.asarray(y)
+        Xv_j = jnp.asarray(np.asarray(X_val, dtype=np.float64))
+        yv_j = jnp.asarray(np.asarray(y_val, dtype=np.float64))
+        from ..parallel.mesh import to_host
+        from .trees import _pad_candidates
+        for model_type, members in groups.items():
+            gk = len(members)
+            sm = np.tile([float(c.smoothing) for _, c in members], F)
+            masks_c = np.repeat(masks, gk, axis=0)   # fold-major
+            fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
+            (masks_c, sm), count = _pad_candidates(
+                mesh, [masks_c, sm], masks_c.shape[1])
+            fidx = np.concatenate(
+                [fidx, np.zeros(len(sm) - count, dtype=np.int32)])
+            if mesh is not None:
+                fn = _nb_eval_mesh_kernel(k, model_type, spec, mesh)
+                mm = fn(jnp.asarray(masks_c), jnp.asarray(sm),
+                        jnp.asarray(fidx), X_j, y_j, Xv_j, yv_j)
+            else:
+                mm = _eval_nb_masked(
+                    X_j, y_j, jnp.asarray(masks_c), jnp.asarray(sm),
+                    jnp.asarray(fidx), Xv_j, yv_j, num_classes=k,
+                    model_type=model_type, spec=spec)
+            mm = to_host(mm)[:count]
+            for f in range(F):
+                for j, (gi, _) in enumerate(members):
+                    metric_mat[f, gi] = mm[f * gk + j]
+        return metric_mat
+
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> "NaiveBayesModel":
         if (X < 0).any():
-            raise ValueError("NaiveBayes requires non-negative features")
+            raise FamilyPreconditionError(
+                "NaiveBayes requires non-negative features")
         k = num_classes(y)
         pi, theta = _fit_nb(jnp.asarray(X), jnp.asarray(y),
                             jnp.asarray(self.smoothing, dtype=jnp.float64),

@@ -316,6 +316,22 @@ class LogisticRegression(Predictor):
         return [[LogisticRegressionModel(p[:d], p[d]) for p in row]
                 for row in params]
 
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """Device-resident search: fit + validation metric for every
+        candidate in one program, (F, G) metric matrix out (see
+        parallel/cv.eval_linear_fold_grid). Binary margins."""
+        if spec[0] != "binary":
+            raise NotImplementedError("logistic device eval is binary-only")
+        if len(y) and int(np.max(y)) + 1 > 2:
+            raise NotImplementedError("batched kernel is binary-only")
+        from ..parallel.cv import eval_linear_fold_grid
+        ga = _grid_to_reg_alpha(self, grid)
+        return eval_linear_fold_grid(
+            "logistic", X, y, masks, ga, X_val, y_val, spec, mesh=mesh,
+            fit_intercept=self.fit_intercept,
+            standardize=self.standardization, max_iter=self.max_iter)
+
 
 class LogisticRegressionModel(ClassifierModel):
     def __init__(self, coefficients, intercept, uid: Optional[str] = None):
@@ -384,6 +400,20 @@ class LinearRegression(Predictor):
         return [[LinearRegressionModel(p[:d], float(p[d])) for p in row]
                 for row in params]
 
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """Device-resident search (see LogisticRegression); predicted
+        values feed the regression metric kernel."""
+        if spec[0] != "regression":
+            raise NotImplementedError(
+                "linear-regression device eval needs a regression metric")
+        from ..parallel.cv import eval_linear_fold_grid
+        ga = _grid_to_reg_alpha(self, grid)
+        return eval_linear_fold_grid(
+            "squared", X, y, masks, ga, X_val, y_val, spec, mesh=mesh,
+            fit_intercept=self.fit_intercept,
+            standardize=self.standardization, max_iter=self.max_iter)
+
 
 class LinearRegressionModel(RegressionModel):
     def __init__(self, coefficients, intercept: float = 0.0,
@@ -442,6 +472,19 @@ class LinearSVC(Predictor):
         d = X.shape[1]
         return [[LinearSVCModel(p[:d], float(p[d])) for p in row]
                 for row in params]
+
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """Device-resident search (see LogisticRegression); SVC margins
+        rank identically to the host raw-prediction score."""
+        if spec[0] != "binary":
+            raise NotImplementedError("SVC device eval is binary-only")
+        from ..parallel.cv import eval_linear_fold_grid
+        ga = _grid_to_reg_alpha(self, grid, allowed=("reg_param",))
+        return eval_linear_fold_grid(
+            "svc", X, y, masks, ga, X_val, y_val, spec, mesh=mesh,
+            fit_intercept=self.fit_intercept,
+            standardize=self.standardization, max_iter=self.max_iter)
 
 
 class LinearSVCModel(ClassifierModel):

@@ -117,6 +117,15 @@ def pallas_level_hist(bin_oh: jnp.ndarray, slot: jnp.ndarray,
     n, TB = bin_oh.shape
     S = stats.shape[1]
     C = int(num_slots)
+    if stats.dtype == jnp.float64:
+        # the kernel accumulates in f32 (MXU-native); under
+        # jax_enable_x64 that would silently downgrade split-search
+        # precision vs the scatter/matmul strategies, breaking the
+        # "mathematically identical strategies" contract of
+        # _level_histograms — stream the f64 case via the XLA einsum
+        slot_oh = jax.nn.one_hot(slot, C, dtype=stats.dtype)
+        return jnp.einsum("nc,ns,nb->cbs", slot_oh, stats,
+                          bin_oh.astype(stats.dtype))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 

@@ -12,8 +12,13 @@ OpLogisticRegression.scala:45). TPU-native equivalents:
 - :func:`fista_minimize` — proximal gradient with Nesterov acceleration
   for elastic-net (L1) penalties, replacing breeze OWL-QN.
 
+(The non-convex MLP's BATCHED fold x grid path uses a fixed-trip
+mini-batch Adam loop instead — it needs per-step data slicing, so it
+lives next to the model in models/mlp.py:_mlp_batched_fit.)
+
 Everything is static-shape: no data-dependent Python control flow, only
-``lax.while_loop`` with scalar convergence predicates.
+``lax.while_loop`` with scalar convergence predicates (or fixed-length
+``lax.scan``).
 """
 from __future__ import annotations
 

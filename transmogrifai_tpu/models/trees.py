@@ -162,6 +162,28 @@ def _compress_nodes(node: jnp.ndarray, cap: int):
     return slot, node_of_slot, rank[-1] + 1
 
 
+def _compress_nodes_global(node: jnp.ndarray, cap: int, level_size: int,
+                           axis_name: str):
+    """Rank-compress node ids CONSISTENTLY across row shards.
+
+    The sort-based :func:`_compress_nodes` ranks whatever nodes the
+    local rows happen to occupy — under row sharding different shards
+    would assign different slots to the same node, and the psum'd
+    histograms would mix nodes. This variant ranks against the GLOBAL
+    occupancy bitmap (one psum of a (2^level,) int vector — the same
+    ICI hop the histograms take), producing the identical
+    ascending-node-id slot order the sort produces on one device.
+    """
+    occ = jnp.zeros((level_size,), jnp.int32).at[node].set(1, mode="drop")
+    occ = (jax.lax.psum(occ, axis_name) > 0).astype(jnp.int32)
+    rank = jnp.cumsum(occ) - 1                      # slot per node id
+    slot = rank[node].astype(node.dtype)
+    node_of_slot = jnp.full((cap,), _SLOT_SENTINEL, jnp.int32).at[
+        jnp.where(occ > 0, rank, cap)].set(
+        jnp.arange(level_size, dtype=jnp.int32), mode="drop")
+    return slot, node_of_slot, jnp.sum(occ)
+
+
 _SLOT_SENTINEL = jnp.iinfo(jnp.int32).max
 
 #: default per-level active-node slot cap (see _grow_tree docstring)
@@ -212,7 +234,8 @@ def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
                       stats: jnp.ndarray, num_slots: int,
                       total_bins: int,
                       bin_oh: Optional[jnp.ndarray] = None,
-                      mode: str = "scatter") -> jnp.ndarray:
+                      mode: str = "scatter",
+                      axis_name: Optional[str] = None) -> jnp.ndarray:
     """(num_slots, total_bins, S) histograms. Three mathematically
     identical strategies (see _hist_mode):
 
@@ -232,9 +255,13 @@ def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
         if mode == "pallas":
             from transmogrifai_tpu.models.pallas_hist import (
                 pallas_level_hist)
-            return pallas_level_hist(bin_oh, slot, stats, num_slots)
-        slot_oh = jax.nn.one_hot(slot, num_slots, dtype=stats.dtype)
-        return jnp.einsum("nc,ns,nb->cbs", slot_oh, stats, bin_oh)
+            hist = pallas_level_hist(bin_oh, slot, stats, num_slots)
+        else:
+            slot_oh = jax.nn.one_hot(slot, num_slots, dtype=stats.dtype)
+            hist = jnp.einsum("nc,ns,nb->cbs", slot_oh, stats, bin_oh)
+        # histograms are linear in rows: the data-parallel reduction is
+        # one psum over ICI — the Rabit-allreduce role (SURVEY §2.9)
+        return (jax.lax.psum(hist, axis_name) if axis_name else hist)
     n_chunks = max(1, -(- (n * d * s_dim) // _HIST_CHUNK_ELEMS))
     step = -(-d // n_chunks)
     segs = num_slots * total_bins
@@ -248,6 +275,8 @@ def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
                              ).reshape(n * db, s_dim),
             seg.reshape(-1), num_segments=segs)
         out = part if out is None else out + part
+    if axis_name:
+        out = jax.lax.psum(out, axis_name)
     return out.reshape(num_slots, total_bins, s_dim)
 
 
@@ -259,7 +288,9 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
                max_features: Optional[int] = None,
                node_cap: Optional[int] = None,
                feat_map: Optional[jnp.ndarray] = None,
-               hist_mode: Optional[str] = None):
+               hist_mode: Optional[str] = None,
+               axis_name: Optional[str] = None,
+               row_total: Optional[int] = None):
     """Grow one complete tree of static ``depth`` over a packed binned
     design (see :class:`_PackedDesign`).
 
@@ -274,12 +305,21 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     node-batch limiting. With default min-instances grids (>= 10) the
     cap never binds; it only limits very deep unregularized trees.
 
+    With ``axis_name`` set (row-sharded fit inside shard_map), every
+    cross-row reduction — per-level histograms, node totals, leaf stats
+    and the slot-compression occupancy — goes through ``psum`` over that
+    mesh axis, so each shard holds only its rows yet every shard makes
+    identical split decisions (the TPU equivalent of XGBoost's Rabit
+    allreduce, SURVEY §2.9). ``row_total`` must then carry the GLOBAL
+    row count (slot caps must not depend on the shard-local count).
+
     Returns (feat_heap (2^depth - 1,), thr_heap (2^depth - 1,),
     leaf_stats (2^depth, S), final node assignment (n,)).
     """
     n, d = packed.shape
     TB = feat_of.shape[0]
-    cap = min(n, _DEFAULT_NODE_CAP if node_cap is None else node_cap)
+    cap = min(row_total if row_total is not None else n,
+              _DEFAULT_NODE_CAP if node_cap is None else node_cap)
     node = jnp.zeros((n,), jnp.int32)
     heap_len = max(2 ** depth - 1, 1)
     feat_heap = jnp.zeros((heap_len,), jnp.int32)[:2 ** depth - 1]
@@ -310,9 +350,13 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
             active = None
         else:
             C = min(2 ** level, cap)               # static slots this level
-            slot, node_of_slot, active = _compress_nodes(node, C)
+            if axis_name:
+                slot, node_of_slot, active = _compress_nodes_global(
+                    node, C, 2 ** level, axis_name)
+            else:
+                slot, node_of_slot, active = _compress_nodes(node, C)
         hist = _level_histograms(packed, slot, stats, C, TB, bin_oh,
-                                 mode=hist_mode)
+                                 mode=hist_mode, axis_name=axis_name)
         cs = jnp.cumsum(hist, axis=1)              # packed-axis running sum
         # per-feature segmented cumsum: subtract the running sum at the
         # owning block's start; splitting at bin b sends bins<=b left
@@ -331,11 +375,15 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
                 jnp.concatenate(
                     [stats, jnp.ones((n, 1), stats.dtype)], axis=1),
                 slot, num_segments=C)
+            if axis_name:
+                aug = jax.lax.psum(aug, axis_name)
             total = aug[:, None, :-1]
             nonempty = aug[:, -1] > 0
         else:
             total = jax.ops.segment_sum(stats, slot,
                                         num_segments=C)[:, None, :]
+            if axis_name:
+                total = jax.lax.psum(total, axis_name)
         right = total - left
         gain = gain_fn(left, right, total)         # (C, TB)
         gain = jnp.where(not_a_split[None, :], -jnp.inf, gain)
@@ -388,6 +436,8 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
         go_left = packed[jnp.arange(n), bfeat[slot]] <= best_r[slot]
         node = 2 * node + (1 - go_left.astype(jnp.int32))  # within-level idx
     leaf_stats = jax.ops.segment_sum(stats, node, num_segments=2 ** depth)
+    if axis_name:
+        leaf_stats = jax.lax.psum(leaf_stats, axis_name)
     return feat_heap, thr_heap, leaf_stats, node
 
 
@@ -538,18 +588,82 @@ def _tree_pool(pkey, binned, col_thr, narrow_idx, wide_idx, pool_cfg):
             jnp.concatenate(parts_thr))
 
 
+def _row_draw(draw_fn, wkey, n: int, axis_name: Optional[str],
+              row_total: Optional[int]):
+    """Per-row random draw that is SHARD-POSITION-STABLE: under row
+    sharding the draw is generated over the GLOBAL row count (identical
+    on every shard — the key replicates) and each shard slices its own
+    contiguous block, so a sharded fit resamples exactly the rows the
+    single-device fit would (mesh ≡ local parity). The global vector is
+    O(rows) scalars — negligible next to the (rows, features) design."""
+    if not axis_name:
+        return draw_fn(wkey, n)
+    full = draw_fn(wkey, row_total)
+    start = jax.lax.axis_index(axis_name) * n
+    return jax.lax.dynamic_slice(full, (start,), (n,))
+
+
+#: transient-memory budget for batching independent forest trees with
+#: vmap (bytes); TX_TREE_BLOCK_MB overrides. Trees of a bagged forest
+#: are embarrassingly parallel — a lax.scan over them serializes
+#: hundreds of tiny per-level ops (the dominant cost of small-data
+#: selector searches, where dispatch/latency beats FLOPs), so trees are
+#: fit in vmapped BLOCKS as large as the budget allows: small data ->
+#: the whole forest in one program step; huge data -> block size 1,
+#: which is exactly the old scan.
+_TREE_BLOCK_BUDGET_MB = 256
+
+
+def _tree_budget_mb() -> int:
+    """Resolved tree-block budget in MB. Callers must thread this into
+    their kernel cache keys / jit statics — reading the env var inside
+    an already-compiled program would silently ignore changes."""
+    import os
+    return (int(os.environ.get("TX_TREE_BLOCK_MB", "0"))
+            or _TREE_BLOCK_BUDGET_MB)
+
+
+def _tree_block_size(n: int, total_bins: int, depth: int, s_dim: int,
+                     num_trees: int, hist_mode: str, pooled: bool,
+                     outer_batch: int = 1,
+                     budget_mb: Optional[int] = None) -> int:
+    budget = (budget_mb or _tree_budget_mb()) * 1024 * 1024
+    cap = min(n, _DEFAULT_NODE_CAP)
+    c_max = min(2 ** max(depth - 1, 0), cap)
+    per_tree = 2 * n * 8 + 2 * c_max * total_bins * s_dim * 8
+    if hist_mode in ("matmul", "pallas"):
+        # the (n, c_max) slot one-hot is the dominant per-tree transient
+        # of the einsum strategy at depth
+        per_tree += n * c_max * 8
+        if pooled:
+            per_tree += n * total_bins * 8  # per-tree pooled bin indicator
+    if pooled:
+        per_tree += 3 * n * 8               # per-tree gathered design cols
+    b = max(1, int(budget // max(per_tree * outer_batch, 1)))
+    return min(b, num_trees)
+
+
 def _forest_body(packed, feat_of, block_start, packed_thr,
                  binned, col_thr, narrow_idx, wide_idx, y, key, mask,
                  min_instances, min_info_gain, subsample, *, kind: str,
                  depth: int, num_classes: int, num_trees: int,
                  max_features: Optional[int], pool_cfg: Optional[tuple],
                  impurity: str, bootstrap: bool,
-                 hist_mode: Optional[str]):
+                 hist_mode: Optional[str],
+                 axis_name: Optional[str] = None,
+                 row_total: Optional[int] = None,
+                 outer_batch: int = 1,
+                 budget_mb: Optional[int] = None):
     """Shared forest program: ``mask`` (n,) row weights let one body
     serve the single fit (mask=ones), the fold x grid batched kernel
     (mask = fold membership, traced per-candidate hyperparams), and the
     "models"-axis mesh path — masked rows contribute nothing to
-    histograms or leaves, which is exactly fitting on the subset."""
+    histograms or leaves, which is exactly fitting on the subset.
+    ``axis_name`` row-shards the fit: every cross-row reduction psums
+    over that mesh axis (see _grow_tree) and bootstrap draws slice a
+    global-shaped sample (_row_draw). Independent trees are fit in
+    vmapped blocks (see _tree_block_size); ``outer_batch`` tells the
+    budget how many of these bodies an enclosing vmap runs at once."""
     n, d = packed.shape
     dtype = packed_thr.dtype
     if kind == "cls":
@@ -560,10 +674,13 @@ def _forest_body(packed, feat_of, block_start, packed_thr,
     else:
         gain_fn = _variance_gain(min_instances)
 
-    def one_tree(carry, tkey):
+    def one_tree(tkey):
         pkey, wkey, fkey = jax.random.split(tkey, 3)
         if bootstrap:
-            w = jax.random.poisson(wkey, subsample, (n,)).astype(dtype)
+            w = _row_draw(
+                lambda k, m: jax.random.poisson(k, subsample,
+                                                (m,)).astype(dtype),
+                wkey, n, axis_name, row_total)
         else:
             w = jnp.ones((n,), dtype)
         w = w * mask
@@ -576,29 +693,49 @@ def _forest_body(packed, feat_of, block_start, packed_thr,
                 p_sub, fo_sub, bs_sub, thr_sub, stats, depth=depth,
                 gain_fn=gain_fn, min_info_gain=min_info_gain,
                 feat_key=fkey, max_features=max_features, feat_map=pool,
-                hist_mode=hist_mode)
+                hist_mode=hist_mode, axis_name=axis_name,
+                row_total=row_total)
         else:
             feat, thr, leaf_stats, _ = _grow_tree(
                 packed, feat_of, block_start, packed_thr, stats,
                 depth=depth, gain_fn=gain_fn,
                 min_info_gain=min_info_gain, feat_key=fkey,
-                max_features=max_features, hist_mode=hist_mode)
+                max_features=max_features, hist_mode=hist_mode,
+                axis_name=axis_name, row_total=row_total)
         if kind == "cls":
             lw = jnp.sum(leaf_stats, axis=-1, keepdims=True)
             leaf = jnp.where(lw > 0, leaf_stats / jnp.maximum(lw, 1e-12),
                              1.0 / num_classes)
         else:
             leaf = leaf_stats[:, 1] / jnp.maximum(leaf_stats[:, 0], 1e-12)
-        return carry, (feat, thr, leaf)
+        return feat, thr, leaf
+
+    keys = jax.random.split(key, num_trees)
+    # full-design TB is a safe upper bound for the pooled design's
+    tb = _tree_block_size(
+        row_total if row_total is not None else n,
+        int(feat_of.shape[0]), depth,
+        num_classes if kind == "cls" else 3, num_trees,
+        hist_mode or "scatter", pool_cfg is not None, outer_batch,
+        budget_mb=budget_mb)
+    if tb >= num_trees:
+        return jax.vmap(one_tree)(keys)
+    if tb == 1:
+        _, outs = jax.lax.scan(lambda c, k: (c, one_tree(k)), None, keys)
+        return outs
+    pad = (-num_trees) % tb
+    keys_p = jnp.concatenate([keys, keys[:pad]], axis=0)
     _, (feats, thrs, leaves) = jax.lax.scan(
-        one_tree, None, jax.random.split(key, num_trees))
-    return feats, thrs, leaves
+        lambda c, kb: (c, jax.vmap(one_tree)(kb)), None,
+        keys_p.reshape(-1, tb, *keys.shape[1:]))
+    flat = lambda a: a.reshape((-1,) + a.shape[2:])[:num_trees]
+    return flat(feats), flat(thrs), flat(leaves)
 
 
 @functools.partial(
     jax.jit, static_argnames=("depth", "num_classes", "num_trees",
                               "max_features", "pool_cfg", "impurity",
-                              "bootstrap", "hist_mode"))
+                              "bootstrap", "hist_mode", "budget_mb"))
 def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
                            binned, col_thr, narrow_idx, wide_idx, y, key,
                            *, depth: int, num_classes: int, num_trees: int,
@@ -606,19 +743,21 @@ def _fit_forest_classifier(packed, feat_of, block_start, packed_thr,
                            pool_cfg: Optional[tuple], impurity: str,
                            min_instances: float, min_info_gain: float,
                            subsample: float, bootstrap: bool,
-                           hist_mode: Optional[str]):
+                           hist_mode: Optional[str],
+                           budget_mb: Optional[int] = None):
     return _forest_body(
         packed, feat_of, block_start, packed_thr, binned, col_thr,
         narrow_idx, wide_idx, y, key, jnp.ones_like(y), min_instances,
         min_info_gain, subsample, kind="cls", depth=depth,
         num_classes=num_classes, num_trees=num_trees,
         max_features=max_features, pool_cfg=pool_cfg, impurity=impurity,
-        bootstrap=bootstrap, hist_mode=hist_mode)
+        bootstrap=bootstrap, hist_mode=hist_mode, budget_mb=budget_mb)
 
 
 @functools.partial(
     jax.jit, static_argnames=("depth", "num_trees", "max_features",
-                              "pool_cfg", "bootstrap", "hist_mode"))
+                              "pool_cfg", "bootstrap", "hist_mode",
+                              "budget_mb"))
 def _fit_forest_regressor(packed, feat_of, block_start, packed_thr,
                           binned, col_thr, narrow_idx, wide_idx, y, key,
                           *, depth: int, num_trees: int,
@@ -626,27 +765,36 @@ def _fit_forest_regressor(packed, feat_of, block_start, packed_thr,
                           pool_cfg: Optional[tuple],
                           min_instances: float, min_info_gain: float,
                           subsample: float, bootstrap: bool,
-                          hist_mode: Optional[str]):
+                          hist_mode: Optional[str],
+                          budget_mb: Optional[int] = None):
     return _forest_body(
         packed, feat_of, block_start, packed_thr, binned, col_thr,
         narrow_idx, wide_idx, y, key, jnp.ones_like(y), min_instances,
         min_info_gain, subsample, kind="reg", depth=depth, num_classes=0,
         num_trees=num_trees, max_features=max_features, pool_cfg=pool_cfg,
-        impurity="", bootstrap=bootstrap, hist_mode=hist_mode)
+        impurity="", bootstrap=bootstrap, hist_mode=hist_mode,
+        budget_mb=budget_mb)
 
 
 def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
               step_size, reg_lambda, gamma, min_child_weight, subsample,
               *, depth: int, num_rounds: int, objective: str,
-              hist_mode: Optional[str]):
+              hist_mode: Optional[str],
+              axis_name: Optional[str] = None,
+              row_total: Optional[int] = None):
     """Shared boosting program with row-mask semantics (see
     _forest_body): masked rows get zero grad/hess weight; the base
-    margin is the mask-weighted mean."""
+    margin is the mask-weighted mean. ``axis_name`` row-shards the fit
+    (psum'd histograms/means, global-sliced subsampling)."""
     n, d = packed.shape
     dtype = packed_thr.dtype
     gain_fn = _xgb_gain(reg_lambda, gamma, min_child_weight)
-    msum = jnp.maximum(jnp.sum(mask), 1.0)
-    mean_y = jnp.sum(mask * y) / msum
+
+    def _gsum(v):
+        return jax.lax.psum(v, axis_name) if axis_name else v
+
+    msum = jnp.maximum(_gsum(jnp.sum(mask)), 1.0)
+    mean_y = _gsum(jnp.sum(mask * y)) / msum
     if objective == "logistic":
         p0 = jnp.clip(mean_y, 1e-6, 1 - 1e-6)
         base = jnp.log(p0 / (1 - p0))
@@ -661,12 +809,16 @@ def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
             g, h = p - y, jnp.maximum(p * (1 - p), 1e-12)
         else:
             g, h = margins - y, jnp.ones_like(y)
-        m = jax.random.bernoulli(rkey, subsample, (n,)).astype(dtype) * mask
+        m = _row_draw(
+            lambda k, mm: jax.random.bernoulli(k, subsample,
+                                               (mm,)).astype(dtype),
+            rkey, n, axis_name, row_total) * mask
         g, h = g * m, h * m
         feat, thr, leaf_stats, node = _grow_tree(
             packed, feat_of, block_start, packed_thr,
             jnp.stack([g, h], axis=1), depth=depth,
-            gain_fn=gain_fn, min_info_gain=0.0, hist_mode=hist_mode)
+            gain_fn=gain_fn, min_info_gain=0.0, hist_mode=hist_mode,
+            axis_name=axis_name, row_total=row_total)
         vals = -step_size * leaf_stats[:, 0] / (leaf_stats[:, 1] + reg_lambda)
         vals = jnp.where(jnp.sum(jnp.abs(leaf_stats), axis=1) > 0, vals, 0.0)
         margins = margins + vals[node]
@@ -710,22 +862,25 @@ def _predict_leaves(X, feats, thrs, depth: int):
 # fold's train rows (feature-distribution information only — standard
 # for histogram-GBM cross-validation).
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _forest_fg_kernel(statics: tuple, mesh=None):
     (kind, depth, num_classes, num_trees, max_features, pool_cfg,
-     impurity, bootstrap, hist_mode) = statics
+     impurity, bootstrap, hist_mode, budget_mb) = statics
 
-    def one(mask, mi, mg, sr, packed, feat_of, block_start, packed_thr,
-            binned, col_thr, narrow, wide, y, key):
+    def one(ob, mask, mi, mg, sr, packed, feat_of, block_start,
+            packed_thr, binned, col_thr, narrow, wide, y, key):
         return _forest_body(
             packed, feat_of, block_start, packed_thr, binned, col_thr,
             narrow, wide, y, key, mask, mi, mg, sr, kind=kind,
             depth=depth, num_classes=num_classes, num_trees=num_trees,
             max_features=max_features, pool_cfg=pool_cfg,
-            impurity=impurity, bootstrap=bootstrap, hist_mode=hist_mode)
+            impurity=impurity, bootstrap=bootstrap, hist_mode=hist_mode,
+            outer_batch=ob, budget_mb=budget_mb)
 
     def batched(masks, mi, mg, sr, *rest):
-        return jax.vmap(one, in_axes=(0, 0, 0, 0) + (None,) * 10
+        ob = masks.shape[0]     # candidate lanes share the block budget
+        return jax.vmap(functools.partial(one, ob),
+                        in_axes=(0, 0, 0, 0) + (None,) * 10
                         )(masks, mi, mg, sr, *rest)
 
     if mesh is None:
@@ -741,7 +896,7 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
                    leaves_spec), check_vma=False))
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _gbt_fg_kernel(statics: tuple, mesh=None):
     depth, num_rounds, objective, hist_mode = statics
 
@@ -765,6 +920,205 @@ def _gbt_fg_kernel(statics: tuple, mesh=None):
         out_specs=(P("models", None, None), P("models", None, None),
                    P("models", None, None), P("models")),
         check_vma=False))
+
+
+def _candidate_scores(kind, spec_kind, depth, feats, thrs, leaves, base,
+                      Xv):
+    """Validation scores for ONE fitted tree candidate, on device:
+    traversal + leaf gather + tree reduction, then the HOST model's
+    exact score transform (evaluators/device_metrics.py host twins:
+    vote normalization for forests, sigmoid for GBT classifiers) so the
+    device metric ranks candidates identically to the host evaluator."""
+    from ..evaluators.device_metrics import (binary_from_sigmoid,
+                                             binary_from_votes,
+                                             vote_probability)
+    leaf = jax.vmap(lambda fh, th: _traverse(Xv, fh, th, depth))(feats, thrs)
+    vals = leaves[jnp.arange(leaves.shape[0])[:, None], leaf]
+    if kind == "gbt":
+        margin = base + jnp.sum(vals, axis=0)
+        if spec_kind == "binary":
+            return binary_from_sigmoid(margin)
+        return margin                       # regression values
+    agg = jnp.mean(vals, axis=0)            # (nv, K) votes or (nv,) values
+    if spec_kind == "binary":
+        return binary_from_votes(agg)
+    if spec_kind == "multiclass":
+        return vote_probability(agg)
+    return agg
+
+
+@functools.lru_cache(maxsize=32)
+def _forest_eval_kernel(statics: tuple, spec: tuple, mesh=None):
+    """Fit + validation-metric fusion of _forest_fg_kernel: candidates
+    never materialize on host — the program returns one metric scalar
+    per candidate (see evaluators/device_metrics.py for why)."""
+    (kind, depth, num_classes, num_trees, max_features, pool_cfg,
+     impurity, bootstrap, hist_mode, budget_mb) = statics
+    from ..evaluators.device_metrics import metric_fn
+    mfn = metric_fn(*spec)
+
+    def one(ob, mask, mi, mg, sr, fi, Xv, yv, packed, feat_of,
+            block_start, packed_thr, binned, col_thr, narrow, wide, y,
+            key):
+        feats, thrs, leaves = _forest_body(
+            packed, feat_of, block_start, packed_thr, binned, col_thr,
+            narrow, wide, y, key, mask, mi, mg, sr, kind=kind,
+            depth=depth, num_classes=num_classes, num_trees=num_trees,
+            max_features=max_features, pool_cfg=pool_cfg,
+            impurity=impurity, bootstrap=bootstrap, hist_mode=hist_mode,
+            outer_batch=ob, budget_mb=budget_mb)
+        scores = _candidate_scores("forest", spec[0], depth, feats, thrs,
+                                   leaves, 0.0, Xv[fi])
+        return mfn(yv[fi], scores)
+
+    def batched(masks, mi, mg, sr, fi, Xv, yv, *rest):
+        ob = masks.shape[0]
+        return jax.vmap(functools.partial(one, ob),
+                        in_axes=(0, 0, 0, 0, 0, None, None)
+                        + (None,) * 10
+                        )(masks, mi, mg, sr, fi, Xv, yv, *rest)
+
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None), P("models"), P("models"),
+                  P("models"), P("models")) + (P(),) * 12,
+        out_specs=P("models"), check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
+def _gbt_eval_kernel(statics: tuple, spec: tuple, mesh=None):
+    """Fit + validation-metric fusion of _gbt_fg_kernel."""
+    depth, num_rounds, objective, hist_mode = statics
+    from ..evaluators.device_metrics import metric_fn
+    mfn = metric_fn(*spec)
+
+    def one(mask, ss, rl, ga, mcw, sub, fi, Xv, yv, packed, feat_of,
+            block_start, packed_thr, y, key):
+        feats, thrs, leaves, base = _gbt_body(
+            packed, feat_of, block_start, packed_thr, y, key, mask, ss,
+            rl, ga, mcw, sub, depth=depth, num_rounds=num_rounds,
+            objective=objective, hist_mode=hist_mode)
+        scores = _candidate_scores("gbt", spec[0], depth, feats, thrs,
+                                   leaves, base, Xv[fi])
+        return mfn(yv[fi], scores)
+
+    def batched(masks, ss, rl, ga, mcw, sub, fi, Xv, yv, *rest):
+        return jax.vmap(one, in_axes=(0,) * 7 + (None, None)
+                        + (None,) * 6
+                        )(masks, ss, rl, ga, mcw, sub, fi, Xv, yv, *rest)
+
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None),) + (P("models"),) * 6 + (P(),) * 8,
+        out_specs=P("models"), check_vma=False))
+
+
+# ---------------------------------------------------------------------------
+# row-sharded (data-parallel) single fits — the Rabit-allreduce role
+# ---------------------------------------------------------------------------
+#
+# The fold x grid kernels above shard CANDIDATES (task parallelism); the
+# kernels here shard ROWS of one fit over a mesh axis: each chip holds a
+# contiguous block of the binned design and psums per-level histograms
+# over ICI (see _grow_tree axis_name). This is the promised data-parallel
+# path of the module docstring — how one model's training scales past a
+# single chip's HBM/FLOPs, the role Rabit allreduce plays for the
+# reference's XGBoost (core/build.gradle:27, SURVEY §2.9).
+
+@functools.lru_cache(maxsize=32)
+def _forest_sharded_kernel(statics: tuple, mesh, axis: str):
+    (kind, depth, num_classes, num_trees, max_features, pool_cfg,
+     impurity, bootstrap, hist_mode, row_total, budget_mb) = statics
+    from jax.sharding import PartitionSpec as P
+
+    def body(packed, binned, y, mask, feat_of, block_start, packed_thr,
+             col_thr, narrow, wide, key, mi, mg, sr):
+        return _forest_body(
+            packed, feat_of, block_start, packed_thr, binned, col_thr,
+            narrow, wide, y, key, mask, mi, mg, sr, kind=kind,
+            depth=depth, num_classes=num_classes, num_trees=num_trees,
+            max_features=max_features, pool_cfg=pool_cfg,
+            impurity=impurity, bootstrap=bootstrap, hist_mode=hist_mode,
+            axis_name=axis, row_total=row_total, budget_mb=budget_mb)
+
+    # outputs replicate: every shard reaches identical split decisions
+    # from the psum'd reductions
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis), P(axis))
+        + (P(),) * 10,
+        out_specs=(P(), P(), P()), check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
+def _gbt_sharded_kernel(statics: tuple, mesh, axis: str):
+    depth, num_rounds, objective, hist_mode, row_total = statics
+    from jax.sharding import PartitionSpec as P
+
+    def body(packed, y, mask, feat_of, block_start, packed_thr, key,
+             ss, rl, ga, mcw, sub):
+        return _gbt_body(packed, feat_of, block_start, packed_thr, y,
+                         key, mask, ss, rl, ga, mcw, sub, depth=depth,
+                         num_rounds=num_rounds, objective=objective,
+                         hist_mode=hist_mode, axis_name=axis,
+                         row_total=row_total)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis)) + (P(),) * 9,
+        out_specs=(P(), P(), P(), P()), check_vma=False))
+
+
+def _gbt_fit_sharded(est, X, y, mesh, axis: str, objective: str):
+    """Shared driver for the row-sharded GBT fits (see
+    _forest_sharded_kernel notes on replication and padding)."""
+    shards = mesh.shape[axis]
+    design, _ = _design_args(X, est.max_bins)
+    packed, feat_of, block_start, packed_thr = design[:4]
+    (packed_p, y_p), mask = _pad_rows(
+        [np.asarray(packed), np.asarray(y)], shards)
+    row_total = len(mask)
+    statics = (est.max_depth, est.num_rounds, objective,
+               _hist_mode(row_total, int(feat_of.shape[0])), row_total)
+    fn = _gbt_sharded_kernel(statics, mesh, axis)
+    feats, thrs, leaves, base = fn(
+        jnp.asarray(packed_p), jnp.asarray(y_p), jnp.asarray(mask),
+        feat_of, block_start, packed_thr,
+        jax.random.PRNGKey(est.seed),
+        jnp.asarray(float(est.step_size)),
+        jnp.asarray(float(est.reg_lambda)),
+        jnp.asarray(float(est.gamma)),
+        jnp.asarray(float(est.min_child_weight)),
+        jnp.asarray(float(est.subsample)))
+    model_cls = (GBTClassifierModel if objective == "logistic"
+                 else GBTRegressorModel)
+    return model_cls(to_host(feats), to_host(thrs), to_host(leaves),
+                     depth=est.max_depth, base=float(to_host(base)),
+                     n_features=X.shape[1])
+
+
+def _pad_rows(arrays, shards: int):
+    """Pad each array's leading (row) axis to a multiple of ``shards``
+    by repeating row 0 (padded rows carry mask 0, so they contribute
+    nothing — repeating a real row keeps every bin index in range).
+    Returns (padded arrays, mask (n_padded,))."""
+    n = arrays[0].shape[0]
+    pad = (-n) % shards
+    mask = np.concatenate([np.ones(n), np.zeros(pad)])
+    if not pad:
+        return list(arrays), mask
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        fill = np.repeat(a[:1], pad, axis=0)
+        out.append(np.concatenate([a, fill], axis=0))
+    return out, mask
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "kind"))
@@ -1052,10 +1406,15 @@ _GBT_TRACED = ("step_size", "reg_lambda", "gamma", "min_child_weight",
 _GBT_STATIC = ("max_depth", "num_rounds", "max_bins", "seed", "num_round")
 
 
-def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
+def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
+                      eval_ctx=None):
     """All (fold, grid point) forest candidates in vmapped programs (one
     per static shape group), optionally sharded over a mesh ``models``
-    axis — see the kernel docstrings for the bin-edge deviation."""
+    axis — see the kernel docstrings for the bin-edge deviation.
+
+    With ``eval_ctx = (X_val (F,nv,d), y_val (F,nv), spec)`` the fused
+    fit+metric kernels run instead and the return value is the (F, G)
+    validation-metric matrix — fitted trees never reach the host."""
     grid = [dict(p) for p in (list(grid) or [{}])]
     allowed = set(_FOREST_TRACED) | set(_FOREST_STATIC)
     for p in grid:
@@ -1070,6 +1429,11 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
     k = num_classes(y)
     y_j = jnp.asarray(y)
     models = [[None] * G for _ in range(F)]
+    metric_mat = np.full((F, G), np.nan)
+    if eval_ctx is not None:
+        Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
+        yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
+        spec = eval_ctx[2]
     groups: Dict[tuple, list] = {}
     for gi, p in enumerate(grid):
         cand = est.with_params(**p)
@@ -1090,13 +1454,28 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
         mg = np.tile([float(c.min_info_gain) for _, c in members], F)
         sr = np.tile([float(c.subsampling_rate) for _, c in members], F)
         masks_c = np.repeat(masks, gk, axis=0)   # fold-major candidates
+        fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
         (masks_p, mi, mg, sr), count = _pad_candidates(
             mesh, [masks_c, mi, mg, sr], n)
+        fidx = np.concatenate(
+            [fidx, np.zeros(len(mi) - count, dtype=np.int32)])
         statics = ("cls" if classification else "reg", cand0.max_depth,
                    k if classification else 0, cand0.num_trees, mf,
                    pool_cfg, getattr(cand0, "impurity", ""),
                    cand0.bootstrap,
-                   _hist_mode(n, int(design[1].shape[0])))
+                   _hist_mode(n, int(design[1].shape[0])),
+                   _tree_budget_mb())
+        if eval_ctx is not None:
+            fn = _forest_eval_kernel(statics, spec, mesh)
+            mm = to_host(fn(
+                jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
+                jnp.asarray(sr), jnp.asarray(fidx), Xv_j, yv_j, *design,
+                narrow, wide, y_j,
+                jax.random.PRNGKey(cand0.seed)))[:count]
+            for f in range(F):
+                for j, (gi, _) in enumerate(members):
+                    metric_mat[f, gi] = mm[f * gk + j]
+            continue
         fn = _forest_fg_kernel(statics, mesh)
         feats, thrs, leaves = fn(
             jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
@@ -1113,13 +1492,14 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool):
                 models[f][gi] = model_cls(
                     feats[c], thrs[c], leaves[c],
                     depth=cand0.max_depth, n_features=d)
-    return models
+    return metric_mat if eval_ctx is not None else models
 
 
-def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
+def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str,
+                   eval_ctx=None):
     # mirrors _forest_fold_grid's candidate contract (fold-major
-    # flattening, static-group partitioning, padding) — change both
-    # together
+    # flattening, static-group partitioning, padding, eval_ctx fusion)
+    # — change both together
     grid = [dict(p) for p in (list(grid) or [{}])]
     allowed = set(_GBT_TRACED) | set(_GBT_STATIC)
     for p in grid:
@@ -1133,6 +1513,11 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
     d = X.shape[1]
     y_j = jnp.asarray(y)
     models = [[None] * G for _ in range(F)]
+    metric_mat = np.full((F, G), np.nan)
+    if eval_ctx is not None:
+        Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
+        yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
+        spec = eval_ctx[2]
     groups: Dict[tuple, list] = {}
     for gi, p in enumerate(grid):
         cand = est.with_params(**p)
@@ -1150,12 +1535,25 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
         mcw = np.tile([float(c.min_child_weight) for _, c in members], F)
         sub = np.tile([float(c.subsample) for _, c in members], F)
         masks_c = np.repeat(masks, gk, axis=0)
+        fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
         (masks_p, ss, rl, ga, mcw, sub), count = _pad_candidates(
             mesh, [masks_c, ss, rl, ga, mcw, sub], n)
-        fn = _gbt_fg_kernel((cand0.max_depth, cand0.num_rounds,
-                             objective,
-                             _hist_mode(n, int(design[1].shape[0]))),
-                            mesh)
+        fidx = np.concatenate(
+            [fidx, np.zeros(len(ss) - count, dtype=np.int32)])
+        statics = (cand0.max_depth, cand0.num_rounds, objective,
+                   _hist_mode(n, int(design[1].shape[0])))
+        if eval_ctx is not None:
+            fn = _gbt_eval_kernel(statics, spec, mesh)
+            mm = to_host(fn(
+                jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
+                jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
+                jnp.asarray(fidx), Xv_j, yv_j, *design[:4], y_j,
+                jax.random.PRNGKey(cand0.seed)))[:count]
+            for f in range(F):
+                for j, (gi, _) in enumerate(members):
+                    metric_mat[f, gi] = mm[f * gk + j]
+            continue
+        fn = _gbt_fg_kernel(statics, mesh)
         feats, thrs, leaves, base = fn(
             jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
             jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
@@ -1170,7 +1568,7 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str):
                 models[f][gi] = model_cls(
                     feats[c], thrs[c], leaves[c], depth=cand0.max_depth,
                     base=float(base[c]), n_features=d)
-    return models
+    return metric_mat if eval_ctx is not None else models
 
 
 class _ForestClassifierBase(Predictor):
@@ -1182,6 +1580,56 @@ class _ForestClassifierBase(Predictor):
         vmapped program per static group, mesh-shardable over the
         candidate axis (reference OpValidator.scala:270 parallelism)."""
         return _forest_fold_grid(self, X, y, masks, grid, mesh, True)
+
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """Device-resident search: fused fit + validation metric, (F, G)
+        matrix out (see _forest_fold_grid eval_ctx)."""
+        if spec[0] == "binary" and num_classes(y) != 2:
+            raise NotImplementedError(
+                "binary device eval needs binary labels")
+        if spec[0] not in ("binary", "multiclass"):
+            raise NotImplementedError(
+                "forest-classifier device eval needs a classification "
+                "metric")
+        return _forest_fold_grid(self, X, y, masks, grid, mesh, True,
+                                 eval_ctx=(X_val, y_val, spec))
+
+    def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
+                           ) -> TreeEnsembleClassifierModel:
+        """Row-sharded (data-parallel) fit: each ``mesh[axis]`` shard
+        holds a contiguous row block; per-level histograms psum over
+        ICI (_grow_tree axis_name — the Rabit-allreduce role, SURVEY
+        §2.9). Identical trees to fit_arrays when the row count divides
+        the shard count (same bootstrap draws via _row_draw)."""
+        k = num_classes(y)
+        d = X.shape[1]
+        shards = mesh.shape[axis]
+        mf = _resolve_max_features(self.feature_subset_strategy, d, True) \
+            if self.bootstrap else None
+        design, widths = _design_args(X, self.max_bins)
+        (narrow, wide), pool_cfg, mf = _pool_plan(widths, mf)
+        packed, feat_of, block_start, packed_thr, binned, col_thr = design
+        (packed_p, binned_p, y_p), mask = _pad_rows(
+            [np.asarray(packed), np.asarray(binned), np.asarray(y)],
+            shards)
+        row_total = len(mask)
+        statics = ("cls", self.max_depth, k, self.num_trees, mf,
+                   pool_cfg, self.impurity, self.bootstrap,
+                   _hist_mode(row_total, int(feat_of.shape[0])),
+                   row_total, _tree_budget_mb())
+        fn = _forest_sharded_kernel(statics, mesh, axis)
+        feats, thrs, leaves = fn(
+            jnp.asarray(packed_p), jnp.asarray(binned_p),
+            jnp.asarray(y_p), jnp.asarray(mask), feat_of, block_start,
+            packed_thr, col_thr, narrow, wide,
+            jax.random.PRNGKey(self.seed),
+            jnp.asarray(float(self.min_instances_per_node)),
+            jnp.asarray(float(self.min_info_gain)),
+            jnp.asarray(float(self.subsampling_rate)))
+        return TreeEnsembleClassifierModel(
+            to_host(feats), to_host(thrs), to_host(leaves),
+            depth=self.max_depth, n_features=d)
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray
                    ) -> TreeEnsembleClassifierModel:
@@ -1199,7 +1647,8 @@ class _ForestClassifierBase(Predictor):
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap,
-            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])))
+            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])),
+            budget_mb=_tree_budget_mb())
         return TreeEnsembleClassifierModel(feats, thrs, leaves,
                                            depth=self.max_depth,
                                            n_features=d)
@@ -1212,6 +1661,46 @@ class _ForestRegressorBase(Predictor):
     def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
         """See _ForestClassifierBase.fit_fold_grid_arrays."""
         return _forest_fold_grid(self, X, y, masks, grid, mesh, False)
+
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """See _ForestClassifierBase.eval_fold_grid_arrays."""
+        if spec[0] != "regression":
+            raise NotImplementedError(
+                "forest-regressor device eval needs a regression metric")
+        return _forest_fold_grid(self, X, y, masks, grid, mesh, False,
+                                 eval_ctx=(X_val, y_val, spec))
+
+    def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
+                           ) -> TreeEnsembleRegressorModel:
+        """See _ForestClassifierBase.fit_arrays_sharded."""
+        d = X.shape[1]
+        shards = mesh.shape[axis]
+        mf = _resolve_max_features(self.feature_subset_strategy, d,
+                                   False) if self.bootstrap else None
+        design, widths = _design_args(X, self.max_bins)
+        (narrow, wide), pool_cfg, mf = _pool_plan(widths, mf)
+        packed, feat_of, block_start, packed_thr, binned, col_thr = design
+        (packed_p, binned_p, y_p), mask = _pad_rows(
+            [np.asarray(packed), np.asarray(binned), np.asarray(y)],
+            shards)
+        row_total = len(mask)
+        statics = ("reg", self.max_depth, 0, self.num_trees, mf,
+                   pool_cfg, "", self.bootstrap,
+                   _hist_mode(row_total, int(feat_of.shape[0])),
+                   row_total, _tree_budget_mb())
+        fn = _forest_sharded_kernel(statics, mesh, axis)
+        feats, thrs, leaves = fn(
+            jnp.asarray(packed_p), jnp.asarray(binned_p),
+            jnp.asarray(y_p), jnp.asarray(mask), feat_of, block_start,
+            packed_thr, col_thr, narrow, wide,
+            jax.random.PRNGKey(self.seed),
+            jnp.asarray(float(self.min_instances_per_node)),
+            jnp.asarray(float(self.min_info_gain)),
+            jnp.asarray(float(self.subsampling_rate)))
+        return TreeEnsembleRegressorModel(
+            to_host(feats), to_host(thrs), to_host(leaves),
+            depth=self.max_depth, n_features=d)
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray
                    ) -> TreeEnsembleRegressorModel:
@@ -1228,7 +1717,8 @@ class _ForestRegressorBase(Predictor):
             min_instances=float(self.min_instances_per_node),
             min_info_gain=self.min_info_gain,
             subsample=self.subsampling_rate, bootstrap=self.bootstrap,
-            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])))
+            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])),
+            budget_mb=_tree_budget_mb())
         return TreeEnsembleRegressorModel(feats, thrs, leaves,
                                           depth=self.max_depth,
                                           n_features=d)
@@ -1351,6 +1841,30 @@ class GBTClassifier(Predictor):
                 "batched GBT kernel requires binary labels {0, 1}")
         return _gbt_fold_grid(self, X, y, masks, grid, mesh, "logistic")
 
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """Device-resident search: fused fit + validation metric, (F, G)
+        matrix out (see _gbt_fold_grid eval_ctx)."""
+        if spec[0] != "binary":
+            raise NotImplementedError(
+                "GBT-classifier device eval is binary-only")
+        bad = np.setdiff1d(np.unique(y), [0.0, 1.0])
+        if bad.size:
+            raise NotImplementedError(
+                "batched GBT kernel requires binary labels {0, 1}")
+        return _gbt_fold_grid(self, X, y, masks, grid, mesh, "logistic",
+                              eval_ctx=(X_val, y_val, spec))
+
+    def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
+                           ) -> GBTClassifierModel:
+        """Row-sharded (data-parallel) boosting — see
+        _ForestClassifierBase.fit_arrays_sharded."""
+        bad = np.setdiff1d(np.unique(y), [0.0, 1.0])
+        if bad.size:
+            raise ValueError(
+                "GBTClassifier supports binary labels {0, 1} only")
+        return _gbt_fit_sharded(self, X, y, mesh, axis, "logistic")
+
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTClassifierModel:
         bad = np.setdiff1d(np.unique(y), [0.0, 1.0])
         if bad.size:
@@ -1395,6 +1909,20 @@ class GBTRegressor(Predictor):
     def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
         """See _ForestClassifierBase.fit_fold_grid_arrays."""
         return _gbt_fold_grid(self, X, y, masks, grid, mesh, "squared")
+
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """See GBTClassifier.eval_fold_grid_arrays."""
+        if spec[0] != "regression":
+            raise NotImplementedError(
+                "GBT-regressor device eval needs a regression metric")
+        return _gbt_fold_grid(self, X, y, masks, grid, mesh, "squared",
+                              eval_ctx=(X_val, y_val, spec))
+
+    def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
+                           ) -> GBTRegressorModel:
+        """See GBTClassifier.fit_arrays_sharded."""
+        return _gbt_fit_sharded(self, X, y, mesh, axis, "squared")
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> GBTRegressorModel:
         design, _ = _design_args(X, self.max_bins)

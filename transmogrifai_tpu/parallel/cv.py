@@ -37,8 +37,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.linear import (binary_logistic_core, linear_regression_core,
                              linear_svc_core)
 
-__all__ = ["fold_masks", "fit_linear_fold_grid", "models_mesh",
-           "LINEAR_KERNELS"]
+__all__ = ["fold_masks", "fit_linear_fold_grid", "eval_linear_fold_grid",
+           "models_mesh", "LINEAR_KERNELS"]
 
 #: kind -> weighted fit core (all share the signature
 #: (X, y, w, reg, alpha, *, fit_intercept, standardize, max_iter,
@@ -141,6 +141,121 @@ def fit_linear_fold_grid(kind: str, X: np.ndarray, y: np.ndarray,
     return to_host(params)[:FG].reshape(F, G, d + 1)
 
 
+def eval_linear_fold_grid(kind: str, X: np.ndarray, y: np.ndarray,
+                          masks: np.ndarray, grid: np.ndarray,
+                          X_val: np.ndarray, y_val: np.ndarray,
+                          spec: tuple, *,
+                          mesh: Optional[Mesh] = None,
+                          fit_intercept: bool = True,
+                          standardize: bool = True,
+                          max_iter: int = 100) -> np.ndarray:
+    """Fit AND evaluate every (fold, grid point) candidate in ONE device
+    program, returning only the (F, G) validation-metric matrix.
+
+    This is the device-resident replacement for the reference's
+    fit-then-evaluate grid loop (OpValidator.scala:293-295): fitted
+    parameters never leave the device — the selector refits only the
+    winner afterwards — so a remote-TPU search transfers a few hundred
+    bytes instead of every candidate's coefficients.
+
+    X_val : (F, nv, d) per-fold validation rows (equal-sized folds,
+            see _ValidatorBase._assignments)
+    y_val : (F, nv) validation labels
+    spec  : (kind, metric) for evaluators.device_metrics.metric_fn —
+            "binary" uses decision margins, "regression" raw values.
+    """
+    X = np.asarray(X, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    masks = np.asarray(masks, dtype=np.float64)
+    grid = np.asarray(grid, dtype=np.float64).reshape(-1, 2)
+    F, n = masks.shape
+    G, d = grid.shape[0], X.shape[1]
+    use_l1 = bool(np.any(grid[:, 0] * grid[:, 1] > 0))
+    cfg = (kind, use_l1, fit_intercept, standardize, max_iter)
+
+    regs = np.tile(grid[:, 0], F)
+    alphas = np.tile(grid[:, 1], F)
+    wmat = np.repeat(masks, G, axis=0)            # (F*G, n)
+    fidx = np.repeat(np.arange(F, dtype=np.int32), G)
+    Xv = jnp.asarray(np.asarray(X_val, dtype=np.float64))
+    yv = jnp.asarray(np.asarray(y_val, dtype=np.float64))
+
+    if mesh is None:
+        fn = _local_eval_kernel(cfg, spec)
+        mm = fn(jnp.asarray(wmat), jnp.asarray(regs), jnp.asarray(alphas),
+                jnp.asarray(fidx), jnp.asarray(X), jnp.asarray(y), Xv, yv)
+        return np.asarray(mm).reshape(F, G)
+
+    m_shards = mesh.shape["models"]
+    d_shards = mesh.shape.get("data", 1)
+    FG = F * G
+    pad_c = (-FG) % m_shards
+    if pad_c:
+        wmat = np.concatenate([wmat, np.ones((pad_c, n))], axis=0)
+        regs = np.concatenate([regs, np.zeros(pad_c)])
+        alphas = np.concatenate([alphas, np.zeros(pad_c)])
+        fidx = np.concatenate([fidx, np.zeros(pad_c, dtype=np.int32)])
+    pad_r = (-n) % d_shards
+    if pad_r:
+        X = np.concatenate([X, np.zeros((pad_r, d))], axis=0)
+        y = np.concatenate([y, np.zeros(pad_r)])
+        wmat = np.concatenate(
+            [wmat, np.zeros((wmat.shape[0], pad_r))], axis=1)
+    fn = _mesh_eval_kernel(cfg, spec, mesh)
+    mm = fn(jnp.asarray(wmat), jnp.asarray(regs), jnp.asarray(alphas),
+            jnp.asarray(fidx), jnp.asarray(X), jnp.asarray(y), Xv, yv)
+    return to_host(mm)[:FG].reshape(F, G)
+
+
+def _candidate_eval(cfg, spec, params, fi, Xv, yv):
+    """Validation metric for one fitted candidate against its fold's
+    validation rows, using the host model's exact score semantics:
+    logistic ranks by softmax probability of the [-m, m] raw pair, SVC
+    by the raw margin (no probability, as in MLlib), regression by the
+    predicted values."""
+    from ..evaluators.device_metrics import (binary_from_raw_pair,
+                                             metric_fn)
+    d = Xv.shape[-1]
+    m = Xv[fi] @ params[:d] + params[d]
+    if spec[0] == "binary":
+        if cfg[0] == "svc":
+            scores = (m, (m > 0).astype(m.dtype))
+        else:
+            scores = binary_from_raw_pair(jnp.stack([-m, m], axis=1))
+    else:
+        scores = m
+    return metric_fn(*spec)(yv[fi], scores)
+
+
+@functools.lru_cache(maxsize=32)
+def _local_eval_kernel(cfg, spec):
+    def one(w, r, a, fi, X_, y_, Xv, yv):
+        params = _candidate_fit(cfg, w, r, a, X_, y_)
+        return _candidate_eval(cfg, spec, params, fi, Xv, yv)
+    return jax.jit(jax.vmap(
+        one, in_axes=(0, 0, 0, 0, None, None, None, None)))
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_eval_kernel(cfg, spec, mesh):
+    data_ax = "data" if "data" in mesh.axis_names else None
+
+    def shard_body(w_loc, r_loc, a_loc, fi_loc, X_loc, y_loc, Xv, yv):
+        def one(w, r, a, fi):
+            params = _candidate_fit(cfg, w, r, a, X_loc, y_loc,
+                                    axis_name=data_ax)
+            # params are psum-complete (identical on every data shard),
+            # and Xv/yv replicate — the metric is data-axis-invariant
+            return _candidate_eval(cfg, spec, params, fi, Xv, yv)
+        return jax.vmap(one)(w_loc, r_loc, a_loc, fi_loc)
+
+    return jax.jit(jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P("models", data_ax), P("models"), P("models"),
+                  P("models"), P(data_ax, None), P(data_ax), P(), P()),
+        out_specs=P("models"), check_vma=False))
+
+
 def _candidate_fit(cfg, w, reg, alpha, X_, y_, axis_name=None):
     kind, use_l1, fit_intercept, standardize, max_iter = cfg
     # solver="fista": static trip count so the mesh and local batched
@@ -155,22 +270,29 @@ def _candidate_fit(cfg, w, reg, alpha, X_, y_, axis_name=None):
 
 # jitted-kernel caches: one compiled program per (config, shapes) — NOT
 # per fit_linear_fold_grid call (a fresh closure per call would defeat
-# the jit cache and recompile every fold of a workflow-CV search)
+# the jit cache and recompile every fold of a workflow-CV search).
+# Bounded (here and in the other family kernels) so long-lived processes
+# that recreate meshes per workflow don't pin every mesh's device
+# handles forever via cache keys.
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _local_kernel(cfg):
     return jax.jit(jax.vmap(
         lambda w, r, a, X_, y_: _candidate_fit(cfg, w, r, a, X_, y_),
         in_axes=(0, 0, 0, None, None)))
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _mesh_kernel(cfg, mesh):
+    # a mesh may be candidate-only (no "data" axis): rows then stay
+    # unsharded and the fit cores run without a psum axis
+    data_ax = "data" if "data" in mesh.axis_names else None
+
     def shard_body(w_loc, r_loc, a_loc, X_loc, y_loc):
         # w_loc: (FG_local, n_local) — vmap candidates, psum row shards
         return jax.vmap(
             lambda w, r, a: _candidate_fit(cfg, w, r, a, X_loc, y_loc,
-                                           axis_name="data")
+                                           axis_name=data_ax)
         )(w_loc, r_loc, a_loc)
 
     # check_vma=False because solver state inits (zeros) are axis-
@@ -179,6 +301,6 @@ def _mesh_kernel(cfg, mesh):
     # transposes a collective (silently wrong with vma checking off)
     return jax.jit(jax.shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P("models", "data"), P("models"), P("models"),
-                  P("data", None), P("data")),
+        in_specs=(P("models", data_ax), P("models"), P("models"),
+                  P(data_ax, None), P(data_ax)),
         out_specs=P("models", None), check_vma=False))

@@ -151,9 +151,11 @@ class AggregateDataReader(DataReader):
             return events
         if is_response:
             kept = [e for e in events if e.date_ms >= cutoff]
-            if self.response_window_ms is not None:
-                kept = [e for e in kept
-                        if e.date_ms <= cutoff + self.response_window_ms]
+            # per-feature window takes precedence over the reader-level
+            # response window (reference specialTimeWindow.orElse(timeWindow))
+            rw = window if window is not None else self.response_window_ms
+            if rw is not None:
+                kept = [e for e in kept if e.date_ms <= cutoff + rw]
         else:
             kept = [e for e in events if e.date_ms < cutoff]
             if window is not None:
@@ -206,7 +208,9 @@ class ConditionalDataReader(AggregateDataReader):
         for f in raw_features:
             gen = self._generator(f)
             agg = gen.aggregator or default_aggregator(f.ftype)
-            window = gen.aggregate_window_ms or self.predictor_window_ms
+            # per-feature window; reader-level defaults (predictor vs
+            # response) are resolved per branch in _filter_conditional
+            window = gen.aggregate_window_ms
             values: List[Any] = []
             for k in keys:
                 cutoff = cutoffs.get(k)
@@ -233,16 +237,21 @@ class ConditionalDataReader(AggregateDataReader):
         """Predictors strictly before the target event; responses at or
         after it, up to and INCLUDING cutoff + window — the same
         boundaries as the aggregate filter (FeatureAggregator.scala:
-        114-122), with the per-key target time as the cutoff."""
+        114-122), with the per-key target time as the cutoff. ``window``
+        is the PER-FEATURE window; the reader-level defaults
+        (predictor_window_ms / response_window_ms) apply per branch when
+        the feature has none (reference
+        specialTimeWindow.orElse(timeWindow))."""
         if is_response:
             kept = [e for e in events if e.date_ms >= cutoff]
-            if self.response_window_ms is not None:
-                kept = [e for e in kept
-                        if e.date_ms <= cutoff + self.response_window_ms]
+            rw = window if window is not None else self.response_window_ms
+            if rw is not None:
+                kept = [e for e in kept if e.date_ms <= cutoff + rw]
         else:
             kept = [e for e in events if e.date_ms < cutoff]
-            if window is not None:
-                kept = [e for e in kept if e.date_ms >= cutoff - window]
+            pw = window if window is not None else self.predictor_window_ms
+            if pw is not None:
+                kept = [e for e in kept if e.date_ms >= cutoff - pw]
         return kept
 
 

@@ -22,7 +22,8 @@ import numpy as np
 _log = logging.getLogger(__name__)
 
 from ..evaluators.base import Evaluator
-from ..models.base import PredictionModel, Predictor
+from ..models.base import (FamilyPreconditionError,
+                           PredictionModel, Predictor)
 
 __all__ = ["ValidationResult", "BestEstimator", "CrossValidation",
            "TrainValidationSplit"]
@@ -122,6 +123,44 @@ class _ValidatorBase:
             getattr(estimator, "fold_grid_needs_mesh", False)
             and self.mesh is None)
 
+    def _try_device_eval(self, estimator, grid, X, y, masks,
+                         X_val_st, y_val_st, spec):
+        """(F, G) metric matrix from the family's fused fit+metric
+        device kernel, or None to fall through to the host paths.
+        This is the device-resident search: candidates' fitted
+        parameters never reach the host — only these floats do (the
+        winner is refit from scratch by the selector afterwards)."""
+        if (X_val_st is None or spec is None
+                or not hasattr(estimator, "eval_fold_grid_arrays")
+                or not self._use_batched_kernel(estimator)):
+            return None
+        try:
+            return estimator.eval_fold_grid_arrays(
+                X, y, masks, grid, X_val_st, y_val_st, spec,
+                mesh=self.mesh)
+        except NotImplementedError:
+            return None         # grid/labels not traceable -> host path
+        except FamilyPreconditionError as e:
+            # family precondition violated (e.g. NaiveBayes on negative
+            # features): the sequential path below raises it per fold,
+            # dropping the family with NaN metrics instead of failing.
+            # Deliberately NOT a blanket ValueError catch — a genuine
+            # kernel bug must propagate, not silently degrade every
+            # search to the host path.
+            _log.warning("device eval kernel for %s rejected the "
+                         "data: %s", type(estimator).__name__, e)
+            return None
+
+    def _results_from_matrix(self, estimator, grid, mm
+                             ) -> List[ValidationResult]:
+        return [
+            ValidationResult(
+                model_name=type(estimator).__name__,
+                model_uid=estimator.uid, grid_index=gi,
+                params=dict(params),
+                metric_values=[float(v) for v in mm[:, gi]])
+            for gi, params in enumerate(grid)]
+
     # -- main loop (reference getSummary, OpValidator.scala:270-310) -------
     def validate(self,
                  models: Sequence[Tuple[Predictor, Sequence[Dict]]],
@@ -134,9 +173,22 @@ class _ValidatorBase:
         # and grid point — stable array identity also lets the tree
         # family's host-side binning memoize per fold
         fold_data = [(X[tr], y[tr], X[va], y[va]) for tr, va in splits]
+        # stacked validation folds for the device-resident fast path
+        # (fold sizes are equal by _assignments construction)
+        spec = self.evaluator.device_metric_spec()
+        X_val_st = y_val_st = None
+        if spec is not None and len({len(va) for _, va in splits}) == 1:
+            X_val_st = np.stack([fd[2] for fd in fold_data])
+            y_val_st = np.stack([fd[3] for fd in fold_data])
         results: List[ValidationResult] = []
         for estimator, grid in models:
             grid = list(grid) or [{}]
+            mm = self._try_device_eval(estimator, grid, X, y, masks,
+                                       X_val_st, y_val_st, spec)
+            if mm is not None:
+                results.extend(self._results_from_matrix(
+                    estimator, grid, mm))
+                continue
             # fast path: families exposing a fold x grid kernel train all
             # candidates in ONE batched XLA program (mesh-sharded when
             # self.mesh is set) instead of len(grid) x folds fits
@@ -147,7 +199,7 @@ class _ValidatorBase:
                         X, y, masks, grid, mesh=self.mesh)
                 except NotImplementedError:
                     fitted = None   # grid not traceable -> sequential
-                except ValueError as e:
+                except FamilyPreconditionError as e:
                     # family precondition violated (e.g. NaiveBayes on
                     # negative features): the sequential path raises it
                     # per fold below, dropping the family out of the
@@ -205,9 +257,30 @@ class _ValidatorBase:
         fold's train rows, so feature matrices may differ across folds
         (even in width). ``folds`` is [(X_tr, y_tr, X_val, y_val), ...].
         Grid batching still applies per fold via the family kernels."""
+        spec = self.evaluator.device_metric_spec()
         results: List[ValidationResult] = []
         for estimator, grid in models:
             grid = list(grid) or [{}]
+            # device-resident fast path, one fold at a time (fold
+            # matrices may differ in shape after per-fold DAG refits,
+            # so they cannot stack into one kernel call)
+            mm = None
+            if spec is not None:
+                rows = []
+                for X_tr, y_tr, X_val, y_val in folds:
+                    row = self._try_device_eval(
+                        estimator, grid, X_tr, y_tr,
+                        np.ones((1, len(y_tr))), X_val[None],
+                        np.asarray(y_val)[None], spec)
+                    if row is None:
+                        break
+                    rows.append(row[0])
+                else:
+                    mm = np.stack(rows) if rows else None
+            if mm is not None:
+                results.extend(self._results_from_matrix(
+                    estimator, grid, mm))
+                continue
             fitted = None
             if self._use_batched_kernel(estimator):
                 try:
@@ -218,7 +291,7 @@ class _ValidatorBase:
                         for X_tr, y_tr, _, _ in folds]
                 except NotImplementedError:
                     fitted = None
-                except ValueError as e:
+                except FamilyPreconditionError as e:
                     _log.warning("batched kernel for %s rejected the "
                                  "data: %s", type(estimator).__name__, e)
                     fitted = None

@@ -211,12 +211,19 @@ def save_model(model, path: str) -> None:
             staged.add(s.uid)
             stages.append(stage_to_json(s, arrays))
     from ..utils.version import version_info
+    rff = getattr(model, "raw_feature_filter_results", None)
     doc = {
         "formatVersion": 1,
         "versionInfo": version_info().to_json(),
         "resultFeatureUids": [f.uid for f in model.result_features],
         "features": [_feature_to_json(f) for f in feats],
         "stages": stages,
+        # reference OpWorkflowModelWriter persists RFF results into
+        # op-model.json (OpWorkflowModelWriter.scala:75-120)
+        "rawFeatureFilterResults": rff.to_json() if rff is not None
+        else None,
+        "blacklistedFeatureNames": list(
+            getattr(model, "blacklisted_feature_names", ())),
     }
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, MODEL_JSON), "w") as fh:
@@ -255,4 +262,11 @@ def load_model(path: str):
             stage.input_features = parents
             stage._output_feature = f
     result = tuple(features[u] for u in doc["resultFeatureUids"])
-    return WorkflowModel(result_features=result)
+    rff = None
+    if doc.get("rawFeatureFilterResults"):
+        from ..checkers.raw_feature_filter import RawFeatureFilterResults
+        rff = RawFeatureFilterResults.from_json(
+            doc["rawFeatureFilterResults"])
+    return WorkflowModel(
+        result_features=result, raw_feature_filter_results=rff,
+        blacklisted_feature_names=doc.get("blacklistedFeatureNames", ()))

@@ -403,8 +403,11 @@ class Workflow:
                        for f in result_features)
         if listener is not None:
             listener.on_application_end()
-        return WorkflowModel(result_features=result,
-                             train_dataset=train_ds)
+        return WorkflowModel(
+            result_features=result, train_dataset=train_ds,
+            raw_feature_filter_results=self.raw_feature_filter_results,
+            blacklisted_feature_names=[f.name for f
+                                       in self.blacklisted_features])
 
     def _find_best_with_workflow_cv(self, result_features, ds
                                     ) -> Optional[Dict[str, PipelineStage]]:
@@ -464,10 +467,17 @@ class WorkflowModel:
     transformer (reference OpWorkflowModel.scala:58)."""
 
     def __init__(self, result_features: Tuple[Feature, ...],
-                 train_dataset: Optional[Dataset] = None):
+                 train_dataset: Optional[Dataset] = None,
+                 raw_feature_filter_results=None,
+                 blacklisted_feature_names=()):
         self.result_features = tuple(result_features)
         #: transformed training data (all intermediate columns)
         self.train_dataset = train_dataset
+        #: RawFeatureFilterResults carried into the fitted model and the
+        #: saved op-model.json (reference OpWorkflowModelWriter:75-120 /
+        #: ModelInsights.scala:72 — r3 kept them on the Workflow only)
+        self.raw_feature_filter_results = raw_feature_filter_results
+        self.blacklisted_feature_names = list(blacklisted_feature_names)
 
     def raw_features(self) -> List[Feature]:
         return _unique_raw_features(self.result_features)
