@@ -71,7 +71,13 @@ def run(verbose: bool = True, seed: int = 42):
     vec = transmogrify(feats)
     selector = MultiClassificationModelSelector.with_cross_validation(
         num_folds=3, seed=seed,
-        splitter=DataCutter(reserve_test_fraction=0.2, seed=seed))
+        splitter=DataCutter(reserve_test_fraction=0.2, seed=seed),
+        # default pool (LR/RF/NB/DT) + the softmax XGBoost opt-in
+        # (reference xgboost4j multi:softprob, OpXGBoostClassifier)
+        model_types_to_use=["LogisticRegression",
+                            "RandomForestClassifier", "NaiveBayes",
+                            "DecisionTreeClassifier",
+                            "XGBoostClassifier"])
     pred = selector.set_input(label, vec).get_output()
 
     t0 = time.perf_counter()

@@ -1,0 +1,106 @@
+"""K-class softmax boosting (models/trees._gbt_softmax_body).
+
+The reference reaches multiclass boosting through xgboost4j's
+multi:softprob (OpXGBoostClassifier.scala:47); MLlib GBT itself is
+binary-only — so GBTClassifier here stays binary (parity) and
+XGBoostClassifier carries the softmax path.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import (GBTClassifier,
+                                      GBTMulticlassClassifierModel,
+                                      RandomForestClassifier,
+                                      XGBoostClassifier)
+
+
+def _three_class(n=450, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    y = np.zeros(n)
+    y[X[:, 0] > 0.5] = 1.0
+    y[X[:, 1] > 0.8] = 2.0
+    return X, y
+
+
+class TestSoftmaxBoosting:
+    def test_multiclass_fit_quality(self):
+        X, y = _three_class()
+        model = XGBoostClassifier(num_round=15, max_depth=3).fit_arrays(
+            X, y)
+        assert isinstance(model, GBTMulticlassClassifierModel)
+        pred = model.predict_arrays(X)
+        acc = float(np.mean(pred.data == y))
+        assert acc > 0.93, acc
+        # probabilities are a proper softmax simplex
+        prob = pred.probability
+        assert prob.shape == (len(y), 3)
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-9)
+
+    def test_binary_still_uses_binary_booster(self):
+        X, y = _three_class()
+        yb = (y > 0).astype(float)
+        model = XGBoostClassifier(num_round=10).fit_arrays(X, yb)
+        from transmogrifai_tpu.models import GBTClassifierModel
+        assert isinstance(model, GBTClassifierModel)
+
+    def test_gbt_classifier_remains_binary_only(self):
+        X, y = _three_class()
+        with pytest.raises(ValueError, match="binary"):
+            GBTClassifier().fit_arrays(X, y)
+
+    def test_quality_competitive_with_rf(self):
+        # VERDICT r3 item 5 done-criterion: boosted multiclass quality
+        # in the same class as the RF winner
+        X, y = _three_class()
+        holdout = slice(0, 150)
+        train = slice(150, None)
+        xgb = XGBoostClassifier(num_round=20, max_depth=3).fit_arrays(
+            X[train], y[train])
+        rf = RandomForestClassifier(num_trees=30, max_depth=6).fit_arrays(
+            X[train], y[train])
+        acc_x = float(np.mean(xgb.predict_arrays(X[holdout]).data
+                              == y[holdout]))
+        acc_r = float(np.mean(rf.predict_arrays(X[holdout]).data
+                              == y[holdout]))
+        assert acc_x >= acc_r - 0.05, (acc_x, acc_r)
+
+    def test_save_load_round_trip(self, tmp_path):
+        from transmogrifai_tpu.workflow.persistence import (stage_from_json,
+                                                            stage_to_json)
+        X, y = _three_class(n=240)
+        model = XGBoostClassifier(num_round=5, max_depth=3).fit_arrays(
+            X, y)
+        arrays = {}
+        doc = stage_to_json(model, arrays)
+        loaded = stage_from_json(doc, arrays)
+        np.testing.assert_allclose(loaded.predict_raw(X[:20]),
+                                   model.predict_raw(X[:20]))
+
+    def test_multiclass_search_includes_xgb(self):
+        # the multiclass opt-in pool exposes XGBoostClassifier
+        # (reference modelTypesToUse selection)
+        from transmogrifai_tpu.selector import (
+            MultiClassificationModelSelector, SelectedModel)
+        from transmogrifai_tpu.models import NaiveBayes
+        X, y = _three_class(n=330)
+        sel = MultiClassificationModelSelector.with_cross_validation(
+            num_folds=2, stratify=True, splitter=None,
+            model_types_to_use=["XGBoostClassifier",
+                                "RandomForestClassifier"],
+            models=None)
+        names = {type(est).__name__ for est, _ in sel.models}
+        assert names == {"XGBoostClassifier", "RandomForestClassifier"}
+        # shrink grids for test speed
+        sel.models = [(est.with_params(**(
+            {"num_round": 5} if type(est).__name__ == "XGBoostClassifier"
+            else {"num_trees": 10})),
+            grid[:2]) for est, grid in sel.models]
+        best = sel.fit_arrays(X, y)
+        assert best.summary is not None
+        fams = {r.model_name for r in best.summary.validation_results}
+        assert "XGBoostClassifier" in fams
+        finite = [v for r in best.summary.validation_results
+                  for v in r.metric_values
+                  if r.model_name == "XGBoostClassifier"]
+        assert all(np.isfinite(v) for v in finite)

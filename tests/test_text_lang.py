@@ -1,0 +1,143 @@
+"""Non-Latin text stack contract tests (VERDICT r3 item 7).
+
+The reference's text pipeline ships Lucene analyzers with CJK support
+(Kuromoji, core/build.gradle:18-21) and Optimaize n-gram language
+detection. These tests pin the host-side equivalents: script-routed +
+Cavnar–Trenkle langid (utils/text_lang.py), CJK bigram tokenization
+(ops/text.tokenize), and the gazetteer+context NER.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.text import tokenize
+from transmogrifai_tpu.utils.text_lang import (detect_language,
+                                               dominant_script)
+
+FIXTURES = [
+    ("The weather is nice today and the children play outside", "en"),
+    ("Il fait beau aujourd'hui et les enfants jouent dehors", "fr"),
+    ("Das Wetter ist heute schön und die Kinder spielen draußen", "de"),
+    ("El tiempo está agradable hoy y los niños juegan afuera", "es"),
+    ("Il tempo è bello oggi e i bambini giocano fuori", "it"),
+    ("O tempo está bom hoje e as crianças brincam lá fora", "pt"),
+    ("Het weer is vandaag mooi en de kinderen spelen buiten", "nl"),
+    ("Погода сегодня хорошая и дети играют на улице", "ru"),
+    ("Погода сьогодні гарна і діти граються надворі", "uk"),
+    ("今日は天気がいいので子供たちは外で遊んでいます", "ja"),
+    ("今天天气很好孩子们在外面玩", "zh"),
+    ("오늘 날씨가 좋아서 아이들이 밖에서 놀고 있어요", "ko"),
+    ("الطقس جميل اليوم والأطفال يلعبون في الخارج", "ar"),
+    ("מזג האוויר יפה היום והילדים משחקים בחוץ", "he"),
+    ("Ο καιρός είναι ωραίος σήμερα και τα παιδιά παίζουν έξω", "el"),
+    ("आज मौसम अच्छा है और बच्चे बाहर खेल रहे हैं", "hi"),
+]
+
+
+class TestLanguageDetection:
+    @pytest.mark.parametrize("text,lang", FIXTURES)
+    def test_fixture(self, text, lang):
+        got, conf = detect_language(text)
+        assert got == lang, (got, lang)
+        assert conf > 0.3
+
+    def test_empty_and_signalless(self):
+        assert detect_language("")[0] == "unknown"
+        assert detect_language(None)[0] == "unknown"
+        assert detect_language("12345 !!!")[0] == "unknown"
+
+    def test_default_override(self):
+        assert detect_language("", default="xx")[0] == "xx"
+
+    def test_script_routing(self):
+        assert dominant_script("привет мир") == "cyrillic"
+        assert dominant_script("ひらがな") == "hiragana"
+        assert dominant_script("hello") == "latin"
+        assert dominant_script("123") is None
+
+    def test_lang_detector_stage_non_latin(self):
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.features.columns import (Dataset,
+                                                        FeatureColumn)
+        from transmogrifai_tpu.ops.derived import LangDetector
+        from transmogrifai_tpu.types import Text
+        f = (FeatureBuilder.text("t").extract(lambda r: r)
+             .as_predictor())
+        ds = Dataset({"t": FeatureColumn.from_values(Text, [
+            "the cat sat on the mat in the warm house",
+            "今日は天気がいいですね",
+            "Погода сегодня очень хорошая на улице",
+            None])})
+        out = LangDetector().set_input(f).transform_columns([ds["t"]])
+        assert list(out.data) == ["en", "ja", "ru", None]
+
+
+class TestCJKTokenization:
+    def test_japanese_bigrams(self):
+        toks = tokenize("今日は天気")
+        assert toks == ["今日", "日は", "は天", "天気"]
+
+    def test_chinese_bigrams(self):
+        assert tokenize("机器学习") == ["机器", "器学", "学习"]
+
+    def test_korean_bigrams_respect_spaces(self):
+        assert tokenize("한국어 처리") == ["한국", "국어", "처리"]
+
+    def test_mixed_script(self):
+        assert tokenize("learn 機械学習 fast") == [
+            "learn", "機械", "械学", "学習", "fast"]
+
+    def test_single_cjk_char(self):
+        assert tokenize("一") == ["一"]
+
+    def test_latin_unchanged(self):
+        assert tokenize("Hello, World! x") == ["hello", "world", "x"]
+
+    def test_hashing_vectorizer_handles_cjk(self):
+        # downstream contract: CJK text produces non-empty hash vectors
+        from transmogrifai_tpu.ops.text import _hash_block
+        block = _hash_block(["機械学習は楽しい", "机器学习", None], 64,
+                            track_nulls=True)
+        assert block[0].sum() > 0 and block[1].sum() > 0
+        assert block[2, 64] == 1.0  # null indicator
+
+
+class TestUpgradedNER:
+    def test_honorific_with_org_connector_span(self):
+        from transmogrifai_tpu.ops import NameEntityRecognizer
+        out = NameEntityRecognizer().transform_value(
+            "Dr. Alice Smith of Acme Corp visited Paris.")
+        tags = out.value
+        assert tags["Alice"] == {"Person"}
+        assert tags["Smith"] == {"Person"}
+        assert "Organization" in tags["Acme"]
+        assert tags["Paris"] == {"Location"}
+
+    def test_given_name_gazetteer(self):
+        from transmogrifai_tpu.utils.text_ner import (
+            HeuristicNameEntityTagger)
+        tags = HeuristicNameEntityTagger().tag(
+            "yesterday Maria Garcia signed the papers")
+        assert tags["Maria"] == {"Person"}
+        assert tags["Garcia"] == {"Person"}
+
+    def test_reporting_verb_cue(self):
+        from transmogrifai_tpu.utils.text_ner import (
+            HeuristicNameEntityTagger)
+        tags = HeuristicNameEntityTagger().tag(
+            "the spokesman said Novak would resign")
+        assert tags["Novak"] == {"Person"}
+
+    def test_locative_preposition(self):
+        from transmogrifai_tpu.utils.text_ner import (
+            HeuristicNameEntityTagger)
+        tags = HeuristicNameEntityTagger().tag(
+            "the factory is located in Springfield")
+        assert tags["Springfield"] == {"Location"}
+
+    def test_org_ministry(self):
+        from transmogrifai_tpu.utils.text_ner import (
+            HeuristicNameEntityTagger)
+        tags = HeuristicNameEntityTagger().tag(
+            "officials at the Finance Ministry declined to comment")
+        assert "Organization" in tags["Ministry"]
+        assert "Organization" in tags["Finance"]

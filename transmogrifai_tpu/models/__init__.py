@@ -17,6 +17,7 @@ from .trees import (DecisionTreeClassifier, DecisionTreeRegressor,
                     GBTClassifier, GBTClassifierModel, GBTRegressor,
                     GBTRegressorModel, RandomForestClassifier,
                     RandomForestRegressor, TreeEnsembleClassifierModel,
+                    GBTMulticlassClassifierModel,
                     TreeEnsembleRegressorModel, XGBoostClassifier,
                     XGBoostRegressor)
 
@@ -33,6 +34,7 @@ __all__ = [
     "IsotonicRegressionCalibrator", "IsotonicRegressionCalibratorModel",
     "pava",
     "XGBoostClassifier", "XGBoostRegressor",
+    "GBTMulticlassClassifierModel",
     "TreeEnsembleClassifierModel", "TreeEnsembleRegressorModel",
     "NaiveBayes", "NaiveBayesModel",
     "GeneralizedLinearRegression", "GeneralizedLinearRegressionModel",

@@ -97,7 +97,18 @@ def default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
 
 
 def default_multiclass_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
-    return []
+    """Opt-in multiclass families: softmax XGBoost (the reference's
+    xgboost4j handles K classes via multi:softprob,
+    OpXGBoostClassifier.scala:47) and the MLP."""
+    from .mlp import MultilayerPerceptronClassifier
+    from .trees import XGBoostClassifier
+    return [
+        (XGBoostClassifier(num_round=_GBT_ROUNDS),
+         [{"max_depth": d, "min_child_weight": float(m)}
+          for d in _DEPTH for m in _MIN_INST[:1]]),
+        (MultilayerPerceptronClassifier(),
+         [{"hidden_layers": h} for h in ((10,), (32, 16))]),
+    ]
 
 
 def default_regression_models() -> List[Tuple[Predictor, List[Dict]]]:

@@ -60,6 +60,7 @@ __all__ = [
     "XGBoostClassifier", "XGBoostRegressor",
     "TreeEnsembleClassifierModel", "TreeEnsembleRegressorModel",
     "GBTClassifierModel", "GBTRegressorModel",
+    "GBTMulticlassClassifierModel",
 ]
 
 
@@ -842,6 +843,81 @@ def _fit_gbt(packed, feat_of, block_start, packed_thr, y, key, *, depth: int,
                      hist_mode=hist_mode)
 
 
+def _gbt_softmax_body(packed, feat_of, block_start, packed_thr, y, key,
+                      mask, step_size, reg_lambda, gamma,
+                      min_child_weight, subsample, *, depth: int,
+                      num_rounds: int, num_classes: int,
+                      hist_mode: Optional[str],
+                      axis_name: Optional[str] = None,
+                      row_total: Optional[int] = None):
+    """K-class softmax boosting: each round fits one tree PER CLASS on
+    the softmax gradients/hessians (g_k = p_k - 1[y=k],
+    h_k = p_k(1-p_k)) — the ``multi:softprob`` objective the reference
+    reaches through xgboost4j (OpXGBoostClassifier.scala:47; MLlib GBT
+    itself has no multiclass mode). The K trees of a round see the same
+    fixed margins, so they vmap as one batched program (histogram width
+    x K, sequential depth unchanged). Base margins are the log class
+    priors. Returns (feats (R,K,H), thrs (R,K,H), leaves (R,K,L),
+    base (K,))."""
+    n, d = packed.shape
+    dtype = packed_thr.dtype
+    gain_fn = _xgb_gain(reg_lambda, gamma, min_child_weight)
+
+    def _gsum(v):
+        return jax.lax.psum(v, axis_name) if axis_name else v
+
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_classes, dtype=dtype)
+    counts = _gsum(jnp.sum(mask[:, None] * onehot, axis=0))
+    priors = jnp.clip(counts / jnp.maximum(jnp.sum(counts), 1.0),
+                      1e-6, 1.0)
+    base = jnp.log(priors)
+    margins0 = jnp.broadcast_to(base, (n, num_classes)).astype(dtype)
+
+    def one_round(margins, rkey):
+        p = jax.nn.softmax(margins, axis=1)
+        g = p - onehot                                  # (n, K)
+        h = jnp.maximum(p * (1.0 - p), 1e-12)
+        m = _row_draw(
+            lambda k, mm: jax.random.bernoulli(k, subsample,
+                                               (mm,)).astype(dtype),
+            rkey, n, axis_name, row_total) * mask
+
+        def per_class(gk, hk):
+            feat, thr, leaf_stats, node = _grow_tree(
+                packed, feat_of, block_start, packed_thr,
+                jnp.stack([gk * m, hk * m], axis=1), depth=depth,
+                gain_fn=gain_fn, min_info_gain=0.0, hist_mode=hist_mode,
+                axis_name=axis_name, row_total=row_total)
+            vals = (-step_size * leaf_stats[:, 0]
+                    / (leaf_stats[:, 1] + reg_lambda))
+            vals = jnp.where(
+                jnp.sum(jnp.abs(leaf_stats), axis=1) > 0, vals, 0.0)
+            return feat, thr, vals, vals[node]
+
+        feats, thrs, vals, delta = jax.vmap(per_class, in_axes=(1, 1)
+                                            )(g, h)     # over classes
+        return margins + delta.T, (feats, thrs, vals)
+
+    _, (feats, thrs, leaves) = jax.lax.scan(
+        one_round, margins0, jax.random.split(key, num_rounds))
+    return feats, thrs, leaves, base
+
+
+@functools.partial(
+    jax.jit, static_argnames=("depth", "num_rounds", "num_classes",
+                              "hist_mode"))
+def _fit_gbt_softmax(packed, feat_of, block_start, packed_thr, y, key, *,
+                     depth: int, num_rounds: int, num_classes: int,
+                     step_size: float, reg_lambda: float, gamma: float,
+                     min_child_weight: float, subsample: float,
+                     hist_mode: Optional[str]):
+    return _gbt_softmax_body(
+        packed, feat_of, block_start, packed_thr, y, key,
+        jnp.ones_like(y), step_size, reg_lambda, gamma, min_child_weight,
+        subsample, depth=depth, num_rounds=num_rounds,
+        num_classes=num_classes, hist_mode=hist_mode)
+
+
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _predict_leaves(X, feats, thrs, depth: int):
     """(T, n) leaf index per tree via vmapped static-depth traversal."""
@@ -1286,6 +1362,43 @@ class GBTClassifierModel(ClassifierModel):
     @property
     def feature_importances(self) -> np.ndarray:
         return _split_count_importances(self.feats, self.thrs, self.n_features)
+
+
+class GBTMulticlassClassifierModel(ClassifierModel):
+    """K-class softmax booster model (see _gbt_softmax_body): raw
+    predictions are the per-class margins; the default max-shifted
+    softmax of ClassifierModel turns them into ``multi:softprob``
+    probabilities (parity with xgboost4j's multiclass output,
+    OpXGBoostClassifier.scala:47)."""
+
+    def __init__(self, feats, thrs, leaves, depth: int, base,
+                 n_features: int = 0, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.feats = np.asarray(feats, dtype=np.int32)     # (R, K, H)
+        self.thrs = np.asarray(thrs, dtype=np.float64)
+        self.leaves = np.asarray(leaves, dtype=np.float64)  # (R, K, L)
+        self.depth = int(depth)
+        self.base = np.asarray(base, dtype=np.float64)      # (K,)
+        self.n_features = int(n_features)
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        rounds, k, heap = self.feats.shape
+        flat_f = self.feats.reshape(rounds * k, heap)
+        flat_t = self.thrs.reshape(rounds * k, heap)
+        leaf_idx = np.asarray(_predict_leaves(
+            jnp.asarray(X), jnp.asarray(flat_f), jnp.asarray(flat_t),
+            self.depth))                                   # (R*K, n)
+        flat_l = self.leaves.reshape(rounds * k, -1)
+        vals = flat_l[np.arange(rounds * k)[:, None], leaf_idx]
+        margins = vals.reshape(rounds, k, -1).sum(axis=0).T  # (n, K)
+        return self.base + margins
+
+    @property
+    def feature_importances(self) -> np.ndarray:
+        rounds, k, heap = self.feats.shape
+        return _split_count_importances(
+            self.feats.reshape(rounds * k, heap),
+            self.thrs.reshape(rounds * k, heap), self.n_features)
 
 
 class GBTRegressorModel(RegressionModel):
@@ -1942,7 +2055,10 @@ class XGBoostClassifier(GBTClassifier):
     """XGBoost-parameter-named facade over the same histogram booster
     (reference OpXGBoostClassifier.scala:47 — the reference's only native
     C++ component, xgboost4j + Rabit; here the booster IS the second-order
-    histogram GBT above, with multi-chip reduction via psum, SURVEY §2.9)."""
+    histogram GBT above, with multi-chip reduction via psum, SURVEY §2.9).
+    Unlike GBTClassifier (MLlib parity: binary-only), this facade also
+    fits K-class problems via the softmax objective — the
+    ``multi:softprob`` path xgboost4j takes."""
 
     def __init__(self, eta: float = 0.3, max_depth: int = 6,
                  num_round: int = 100, reg_lambda: float = 1.0,
@@ -1956,6 +2072,29 @@ class XGBoostClassifier(GBTClassifier):
             seed=seed, uid=uid)
         self.eta = eta
         self.num_round = num_round
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray):
+        k = num_classes(y)
+        if k <= 2:
+            return GBTClassifier.fit_arrays(self, X, y)
+        bad = np.setdiff1d(np.unique(y), np.arange(k, dtype=np.float64))
+        if bad.size:
+            raise ValueError(
+                f"XGBoostClassifier needs integer class labels 0..{k - 1};"
+                f" got {bad.tolist()}")
+        design, _ = _design_args(X, self.max_bins)
+        feats, thrs, leaves, base = _fit_gbt_softmax(
+            *design[:4], jnp.asarray(y), jax.random.PRNGKey(self.seed),
+            depth=self.max_depth, num_rounds=self.num_rounds,
+            num_classes=k, step_size=self.step_size,
+            reg_lambda=self.reg_lambda, gamma=self.gamma,
+            min_child_weight=self.min_child_weight,
+            subsample=self.subsample,
+            hist_mode=_hist_mode(X.shape[0], int(design[1].shape[0])))
+        return GBTMulticlassClassifierModel(
+            to_host(feats), to_host(thrs), to_host(leaves),
+            depth=self.max_depth, base=to_host(base),
+            n_features=X.shape[1])
 
 
 class XGBoostRegressor(GBTRegressor):

@@ -139,46 +139,33 @@ class MimeTypeDetector(UnaryTransformer):
         return FeatureColumn.from_values(PickList, vals)
 
 
-_LANG_STOPWORDS = {
-    "en": {"the", "and", "of", "to", "in", "is", "that", "it", "was",
-           "for", "with", "his", "her", "this", "have", "not", "are"},
-    "es": {"el", "la", "de", "que", "y", "en", "un", "una", "los", "las",
-           "por", "con", "para", "es", "del", "se", "no"},
-    "fr": {"le", "la", "les", "de", "des", "et", "en", "un", "une", "du",
-           "que", "qui", "dans", "pour", "est", "pas", "sur"},
-    "de": {"der", "die", "das", "und", "in", "den", "von", "zu", "mit",
-           "sich", "des", "auf", "ist", "im", "dem", "nicht", "ein"},
-    "pt": {"o", "a", "os", "as", "de", "que", "e", "do", "da", "em",
-           "um", "uma", "para", "com", "nao", "por", "mais"},
-    "it": {"il", "la", "di", "che", "e", "un", "una", "in", "per", "del",
-           "con", "non", "sono", "le", "dei", "al", "si"},
-}
-
-
 class LangDetector(UnaryTransformer):
-    """Stopword-vote language detection (reference LangDetector.scala;
-    the Optimaize n-gram profiles become stopword tables — a host-side
-    approximation, documented deviation)."""
+    """Language detection via Unicode-script routing + Cavnar–Trenkle
+    character n-gram profiles (utils/text_lang.py) — same model family
+    as the reference's Optimaize detector (LangDetector.scala,
+    core/build.gradle). Handles non-Latin scripts (CJK, Cyrillic,
+    Arabic, ...) that the r3 stopword-vote could not."""
 
     input_types = (Text,)
     output_type = PickList
 
     def __init__(self, default_lang: str = "unknown",
+                 min_confidence: float = 0.0,
                  uid: Optional[str] = None):
         super().__init__(operation_name="langDetect", uid=uid)
         self.default_lang = default_lang
+        self.min_confidence = min_confidence
 
     def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
+        from ..utils.text_lang import detect_language
         vals = []
         for v in cols[0].data:
             if not v:
                 vals.append(None)
                 continue
-            tokens = set(re.findall(r"[a-zà-ÿ]+", str(v).lower()))
-            scores = {lang: len(tokens & sw)
-                      for lang, sw in _LANG_STOPWORDS.items()}
-            best = max(scores, key=scores.get)
-            vals.append(best if scores[best] > 0 else self.default_lang)
+            lang, conf = detect_language(str(v), default=self.default_lang)
+            vals.append(lang if conf >= self.min_confidence
+                        else self.default_lang)
         return FeatureColumn.from_values(PickList, vals)
 
 

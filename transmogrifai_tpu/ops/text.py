@@ -32,17 +32,44 @@ __all__ = ["tokenize", "TextTokenizer", "SmartTextVectorizer",
 
 _TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
 
+#: CJK codepoint ranges (Han, Hiragana, Katakana, Hangul) — runs of
+#: these emit overlapping character BIGRAMS, Lucene CJKBigramFilter's
+#: behavior (the reference's analyzer chain ships CJKAnalyzer/Kuromoji,
+#: core/build.gradle:18-21; bigrams are the classic statistical
+#: segmentation for unsegmented scripts)
+_CJK_RE = re.compile(
+    "([㐀-䶿一-鿿぀-ゟ゠-ヿ"
+    "가-힯]+)")
+
+
+def _cjk_bigrams(run: str) -> List[str]:
+    if len(run) == 1:
+        return [run]
+    return [run[i:i + 2] for i in range(len(run) - 1)]
+
 
 def tokenize(text: Optional[str], min_token_length: int = 1,
              to_lowercase: bool = True) -> List[str]:
-    """Unicode word tokenizer (replaces the Lucene analyzer chain of
-    reference TextTokenizer.scala; host-side preprocessing)."""
+    """Unicode word tokenizer with CJK bigram fallback (replaces the
+    Lucene analyzer chain of reference TextTokenizer.scala; host-side
+    preprocessing). Non-CJK scripts split on word boundaries; CJK runs
+    — which carry no spaces to split on — become overlapping character
+    bigrams. min_token_length applies to word tokens only (bigrams are
+    already minimal units)."""
     if text is None:
         return []
     if to_lowercase:
         text = text.lower()
-    return [t for t in _TOKEN_RE.findall(text)
-            if len(t) >= min_token_length]
+    out: List[str] = []
+    for part in _CJK_RE.split(text):
+        if not part:
+            continue
+        if _CJK_RE.fullmatch(part):
+            out.extend(_cjk_bigrams(part))
+        else:
+            out.extend(t for t in _TOKEN_RE.findall(part)
+                       if len(t) >= min_token_length)
+    return out
 
 
 class TextTokenizer(SequenceModel):

@@ -115,13 +115,11 @@ class _ValidatorBase:
 
     def _use_batched_kernel(self, estimator) -> bool:
         """Whether to hand this family's grid to its batched fold
-        kernel: it must expose one, and families flagged
-        ``fold_grid_needs_mesh`` (vmapped-solver lockstep cost outweighs
-        single-device batching — see MultilayerPerceptronClassifier)
-        only batch when a mesh actually spreads the candidates."""
-        return hasattr(estimator, "fit_fold_grid_arrays") and not (
-            getattr(estimator, "fold_grid_needs_mesh", False)
-            and self.mesh is None)
+        kernel: it must expose one. (r3's ``fold_grid_needs_mesh``
+        escape hatch is gone — the MLP's fixed-trip mini-batch solver
+        removed the last family whose batched kernel lost to the
+        sequential path on one device.)"""
+        return hasattr(estimator, "fit_fold_grid_arrays")
 
     def _try_device_eval(self, estimator, grid, X, y, masks,
                          X_val_st, y_val_st, spec):

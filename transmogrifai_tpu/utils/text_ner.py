@@ -35,19 +35,82 @@ class NameEntityType:
 
 
 _HONORIFICS = {"mr", "mr.", "mrs", "mrs.", "ms", "ms.", "dr", "dr.",
-               "prof", "prof.", "sir", "president", "senator", "judge",
-               "captain", "st", "st."}
+               "prof", "prof.", "sir", "madam", "president", "senator",
+               "judge", "captain", "governor", "mayor", "chancellor",
+               "minister", "ceo", "st", "st."}
 _ORG_SUFFIXES = {"inc", "inc.", "corp", "corp.", "co", "co.", "ltd",
-                 "ltd.", "llc", "plc", "gmbh", "ag", "company",
-                 "corporation", "university", "institute", "bank"}
+                 "ltd.", "llc", "plc", "gmbh", "ag", "sa", "nv", "oy",
+                 "company", "corporation", "university", "institute",
+                 "bank", "group", "holdings", "industries", "systems",
+                 "technologies", "laboratories", "labs", "partners",
+                 "foundation", "association", "agency", "ministry",
+                 "department", "committee", "council"}
 _LOCATIONS = {
+    # countries / regions
+    "usa", "u.s.", "u.s.a.", "uk", "u.k.", "france", "germany", "spain",
+    "italy", "china", "japan", "india", "canada", "australia", "brazil",
+    "mexico", "russia", "england", "scotland", "wales", "ireland",
+    "america", "europe", "asia", "africa", "netherlands", "belgium",
+    "switzerland", "austria", "sweden", "norway", "denmark", "finland",
+    "poland", "portugal", "greece", "turkey", "egypt", "israel",
+    "argentina", "chile", "colombia", "peru", "korea", "vietnam",
+    "thailand", "indonesia", "malaysia", "singapore", "philippines",
+    "nigeria", "kenya", "morocco", "ukraine",
+    # cities
     "paris", "london", "tokyo", "berlin", "madrid", "rome", "moscow",
-    "beijing", "sydney", "toronto", "chicago", "boston", "seattle",
-    "francisco", "york", "angeles", "usa", "u.s.", "uk", "france",
-    "germany", "spain", "italy", "china", "japan", "india", "canada",
-    "australia", "brazil", "mexico", "russia", "england", "america",
-    "europe", "asia", "africa", "california", "texas", "washington",
+    "beijing", "shanghai", "sydney", "melbourne", "toronto", "vancouver",
+    "montreal", "chicago", "boston", "seattle", "francisco", "york",
+    "angeles", "dallas", "houston", "miami", "atlanta", "denver",
+    "phoenix", "philadelphia", "amsterdam", "brussels", "vienna",
+    "zurich", "geneva", "munich", "hamburg", "frankfurt", "barcelona",
+    "lisbon", "dublin", "stockholm", "oslo", "copenhagen", "helsinki",
+    "warsaw", "prague", "budapest", "athens", "istanbul", "cairo",
+    "mumbai", "delhi", "bangalore", "seoul", "osaka", "taipei",
+    "jakarta", "bangkok", "manila", "lagos", "nairobi",
+    # US states
+    "california", "texas", "washington", "florida", "oregon", "arizona",
+    "nevada", "colorado", "georgia", "virginia", "ohio", "michigan",
+    "illinois", "massachusetts", "pennsylvania", "carolina", "alaska",
+    "hawaii", "utah", "montana", "idaho", "kansas", "iowa", "missouri",
 }
+#: common given names — the gazetteer backbone of the Person tag (the
+#: OpenNLP maxent model's role; a name list + context cues is the
+#: classic statistical-NER fallback)
+_GIVEN_NAMES = {
+    "james", "john", "robert", "michael", "william", "david", "richard",
+    "joseph", "thomas", "charles", "christopher", "daniel", "matthew",
+    "anthony", "mark", "donald", "steven", "paul", "andrew", "joshua",
+    "kenneth", "kevin", "brian", "george", "edward", "ronald", "timothy",
+    "jason", "jeffrey", "ryan", "jacob", "gary", "nicholas", "eric",
+    "jonathan", "stephen", "larry", "justin", "scott", "brandon",
+    "benjamin", "samuel", "gregory", "frank", "alexander", "raymond",
+    "patrick", "jack", "dennis", "jerry", "peter", "henry", "adam",
+    "mary", "patricia", "jennifer", "linda", "elizabeth", "barbara",
+    "susan", "jessica", "sarah", "karen", "nancy", "lisa", "betty",
+    "margaret", "sandra", "ashley", "kimberly", "emily", "donna",
+    "michelle", "dorothy", "carol", "amanda", "melissa", "deborah",
+    "stephanie", "rebecca", "sharon", "laura", "cynthia", "kathleen",
+    "amy", "angela", "shirley", "anna", "brenda", "pamela", "emma",
+    "nicole", "helen", "samantha", "katherine", "christine", "debra",
+    "rachel", "catherine", "carolyn", "janet", "ruth", "maria",
+    "heather", "diane", "virginia", "julie", "joyce", "victoria",
+    "olivia", "kelly", "christina", "alice", "julia", "grace", "sofia",
+    "ahmed", "mohammed", "ali", "omar", "hassan", "fatima", "aisha",
+    "wei", "jing", "li", "chen", "yuki", "hiroshi", "kenji", "sakura",
+    "raj", "priya", "arjun", "ananya", "ivan", "dmitri", "olga",
+    "natasha", "pierre", "marie", "jean", "sophie", "hans", "klaus",
+    "ingrid", "carlos", "jose", "juan", "ana", "lucia", "marco",
+    "giulia", "lars", "erik", "astrid",
+}
+#: verbs/cues whose capitalized neighbor is very likely a Person
+_PERSON_CUE_AFTER = {"said", "says", "told", "met", "asked", "replied",
+                     "wrote", "argued", "announced", "stated", "noted",
+                     "added", "explained", "warned"}
+#: prepositions whose capitalized object is very likely a Location
+_LOC_PREPS = {"in", "at", "from", "near", "to", "toward", "towards"}
+#: connectors allowed INSIDE a multi-token proper-noun span
+_SPAN_CONNECTORS = {"of", "the", "de", "da", "del", "della", "van",
+                    "von", "bin", "al", "el", "la", "le"}
 _MONTHS = {"january", "february", "march", "april", "may", "june", "july",
            "august", "september", "october", "november", "december",
            "jan", "feb", "mar", "apr", "jun", "jul", "aug", "sep", "sept",
@@ -86,15 +149,29 @@ def _strip(tok: str) -> str:
     return tok.strip(".,;:!?\"'()[]{}")
 
 
+def _is_cap(tok: str) -> bool:
+    return bool(tok) and (tok[:1].isupper() and not tok.isupper()
+                          or (tok.isupper() and len(tok) > 1))
+
+
 class HeuristicNameEntityTagger:
     """tag(sentence) -> {token: {entity types}}
-    (reference NameEntityTagger.tag returning TaggerResult.tokenTags)."""
+    (reference NameEntityTagger.tag returning TaggerResult.tokenTags).
+
+    Gazetteer + context tagger (the r4 upgrade of the r3 45-line
+    heuristic): capitalized spans are assembled first (connector words
+    like "of"/"van" allowed inside), then each span is classified by —
+    in priority order — corporate suffix (Organization), location
+    gazetteer (Location), honorific / given-name gazetteer / reporting-
+    verb context (Person), locative preposition (Location). Numeric
+    patterns (time/money/percent/date) tag independently per token."""
 
     def tag(self, sentence: str,
             entities: Sequence[str] = NameEntityType.values
             ) -> Dict[str, Set[str]]:
         raw = _TOKEN_RE.findall(sentence or "")
         toks = [_strip(t) for t in raw]
+        lows = [t.lower() for t in toks]
         tags: Dict[str, Set[str]] = {}
         want = set(entities)
 
@@ -102,8 +179,8 @@ class HeuristicNameEntityTagger:
             if ent in want and token:
                 tags.setdefault(token, set()).add(ent)
 
-        for i, (rtok, tok) in enumerate(zip(raw, toks)):
-            low = tok.lower()
+        # numeric / calendar patterns, token-local
+        for tok, low in zip(toks, lows):
             if _TIME_RE.match(tok):
                 add(tok, NameEntityType.Time)
             if _MONEY_RE.match(tok):
@@ -112,36 +189,81 @@ class HeuristicNameEntityTagger:
                 add(tok, NameEntityType.Percentage)
             if low in _MONTHS or low in _WEEKDAYS or _YEAR_RE.match(tok):
                 add(tok, NameEntityType.Date)
-            if low in _LOCATIONS and tok[:1].isupper():
-                add(tok, NameEntityType.Location)
-            cap = tok[:1].isupper() and not tok.isupper() or \
-                (tok.isupper() and len(tok) > 1)
-            if not cap or low in _HONORIFICS:
+
+        # assemble capitalized spans (connectors allowed inside)
+        spans: List[Tuple[int, int]] = []      # [start, end) token idx
+        i = 0
+        n = len(toks)
+        while i < n:
+            if _is_cap(toks[i]) and lows[i] not in _HONORIFICS:
+                j = i + 1
+                while j < n and (
+                        _is_cap(toks[j])
+                        or (lows[j] in _SPAN_CONNECTORS and j + 1 < n
+                            and _is_cap(toks[j + 1]))):
+                    j += 1
+                spans.append((i, j))
+                i = j
+            else:
+                i += 1
+
+        worklist = list(spans)
+        while worklist:
+            start, end = worklist.pop(0)
+            span_lows = lows[start:end]
+            span_toks = [t for t in toks[start:end] if t]
+            prev = lows[start - 1] if start else ""
+            is_sent_start = start == 0
+
+            def add_span(ent: str, skip_connectors: bool = True,
+                         _s=start, _e=end) -> None:
+                for t, lo in zip(toks[_s:_e], lows[_s:_e]):
+                    # only LOWERCASE connectors are glue ("Jean de la
+                    # Fontaine"); a capitalized homograph is part of
+                    # the name itself ("Al Gore", "La Paz")
+                    if (skip_connectors and lo in _SPAN_CONNECTORS
+                            and not _is_cap(t)):
+                        continue
+                    add(t, ent)
+
+            # 0. a connector-bridged span opening with PERSON evidence
+            #    ("Dr. Alice Smith of Acme Corp") splits at the first
+            #    connector: the head is the person, the tail re-enters
+            #    classification on its own
+            if prev in _HONORIFICS or span_lows[0] in _GIVEN_NAMES:
+                split = next((c for c, lo in enumerate(span_lows)
+                              if lo in _SPAN_CONNECTORS
+                              and not _is_cap(toks[start + c])), None)
+                if split is not None:
+                    for t in toks[start:start + split]:
+                        add(t, NameEntityType.Person)
+                    worklist.insert(0, (start + split + 1, end))
+                    continue
+
+            # 1. corporate suffix anywhere in span -> Organization
+            if any(lo in _ORG_SUFFIXES for lo in span_lows):
+                add_span(NameEntityType.Organization,
+                         skip_connectors=False)
                 continue
-            prev = toks[i - 1].lower() if i else ""
-            nxt = toks[i + 1].lower() if i + 1 < len(toks) else ""
-            # corporate suffix tags the capitalized span before it
-            if nxt in _ORG_SUFFIXES or low in _ORG_SUFFIXES and i:
-                add(tok, NameEntityType.Organization)
-                if low in _ORG_SUFFIXES:
-                    add(toks[i - 1], NameEntityType.Organization)
+            # 2. location gazetteer hit -> Location
+            if any(lo in _LOCATIONS for lo in span_lows):
+                add_span(NameEntityType.Location)
                 continue
-            # honorific-introduced or capitalized-bigram mid-sentence span
-            if prev in _HONORIFICS:
-                add(tok, NameEntityType.Person)
-                if i + 1 < len(toks) and toks[i + 1][:1].isupper():
-                    add(toks[i + 1], NameEntityType.Person)
+            # 3. Person evidence: honorific before, given-name first
+            #    token, or a reporting verb adjacent
+            nxt = lows[end] if end < n else ""
+            if (prev in _HONORIFICS
+                    or span_lows[0] in _GIVEN_NAMES
+                    or nxt in _PERSON_CUE_AFTER
+                    or prev in _PERSON_CUE_AFTER):
+                add_span(NameEntityType.Person)
                 continue
-            prev_cap = i > 0 and toks[i - 1][:1].isupper() \
-                and toks[i - 1].lower() not in _HONORIFICS
-            if i > 0 and prev_cap and tags.get(toks[i - 1]) \
-                    and NameEntityType.Person in tags[toks[i - 1]]:
-                add(tok, NameEntityType.Person)
-            elif i > 0 and not prev_cap and i + 1 < len(toks) \
-                    and toks[i + 1][:1].isupper() \
-                    and _strip(toks[i + 1]).lower() not in _ORG_SUFFIXES \
-                    and low not in _LOCATIONS:
-                # mid-sentence capitalized bigram start -> likely Person
-                add(tok, NameEntityType.Person)
-                add(toks[i + 1], NameEntityType.Person)
+            # 4. locative preposition before a non-sentence-initial span
+            if prev in _LOC_PREPS and not is_sent_start:
+                add_span(NameEntityType.Location)
+                continue
+            # 5. multi-token capitalized span mid-sentence, no other
+            #    evidence -> likely Person (OpenNLP's majority case)
+            if not is_sent_start and len(span_toks) >= 2:
+                add_span(NameEntityType.Person)
         return tags
