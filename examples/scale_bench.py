@@ -50,12 +50,33 @@ def main() -> None:
     from transmogrifai_tpu.models.trees import (GBTClassifier,
                                                 RandomForestClassifier)
 
+    import jax
+
     X, y = make_data(args.rows, args.cols)
-    for name, est in [
-        ("gbt_20rounds_d6", GBTClassifier(num_rounds=20, max_depth=6)),
+
+    # rough matmul-mode histogram FLOPs model for an MFU estimate: the
+    # per-level einsum contraction costs ~2*n*C_l*S*TB FLOPs with
+    # C_l = min(2^l, 256) active slots (models/trees._level_histograms)
+    from transmogrifai_tpu.models.trees import _design_args
+
+    def hist_flops(n: int, total_bins: int, depth: int, units: int,
+                   s_dim: int) -> float:
+        per_tree = sum(2.0 * n * min(2 ** l, 256) * s_dim * total_bins
+                       for l in range(depth))
+        return units * per_tree
+
+    #: assumed peak for the MFU denominator; override TX_PEAK_TFLOPS
+    #: (TPU default = v5e bf16 peak; CPU a nominal 100 GFLOPs)
+    peak_tflops = float(os.environ.get(
+        "TX_PEAK_TFLOPS",
+        "197" if jax.default_backend() == "tpu" else "0.1"))
+
+    for name, est, units, s_dim, depth in [
+        ("gbt_20rounds_d6",
+         GBTClassifier(num_rounds=20, max_depth=6), 20, 2, 6),
         ("rf_50trees_d6",
          RandomForestClassifier(num_trees=50, max_depth=6,
-                                min_instances_per_node=10)),
+                                min_instances_per_node=10), 50, 2, 6),
     ]:
         t0 = time.perf_counter()
         model = est.fit_arrays(X, y)
@@ -64,12 +85,21 @@ def main() -> None:
         pred = model.predict_arrays(X[:50_000])
         score_s = time.perf_counter() - t0
         acc = float(np.mean(pred.data == y[:50_000]))
+        # _design_args memoizes on (X identity, max_bins): this hits the
+        # cache the fit itself populated — no re-binning
+        _, widths = _design_args(X, est.max_bins)
+        tb = int(np.sum(widths))
+        gflop = hist_flops(args.rows, tb, depth, units, s_dim) / 1e9
+        mfu = gflop / 1e3 / max(fit_s, 1e-9) / peak_tflops * 100.0
         print(json.dumps({
             "model": name, "rows": args.rows, "cols": args.cols,
             "fit_seconds": round(fit_s, 2),
             "fit_rows_per_sec": round(args.rows / fit_s),
             "score_rows_per_sec": round(50_000 / max(score_s, 1e-9)),
-            "train_subset_acc": round(acc, 4)}))
+            "train_subset_acc": round(acc, 4),
+            "hist_gflop_est": round(gflop, 1),
+            "mfu_pct_est": round(mfu, 3),
+            "platform": jax.default_backend()}))
 
 
 if __name__ == "__main__":

@@ -407,3 +407,101 @@ class TestDataprepExamples:
     def test_conditional_aggregation(self):
         from examples.dataprep import conditional_aggregation
         conditional_aggregation()
+
+
+class TestTailingStream:
+    def test_tail_directory_picks_up_new_files(self, tmp_path):
+        """Live directory tail (reference DStream fileStream,
+        StreamingReader.scala:54): files appearing AFTER the stream
+        starts are still delivered; the stream ends only after the
+        idle timeout."""
+        import csv as _csv
+        import threading
+        import time as _time
+
+        from transmogrifai_tpu.readers import StreamingReaders
+
+        import os as _os
+
+        def write(path, rows):
+            # atomic publish: write a temp name outside the glob, then
+            # rename in — with the reader's size-stability guard this
+            # keeps the test deterministic under scheduler delays
+            tmp = str(path) + ".tmp"
+            with open(tmp, "w", newline="") as fh:
+                w = _csv.writer(fh)
+                w.writerow(["i", "v"])
+                w.writerows(rows)
+            _os.replace(tmp, path)
+
+        write(tmp_path / "a0.csv", [[0, "x"], [1, "y"]])
+        sr = StreamingReaders.Simple.tail(
+            str(tmp_path / "*.csv"), poll_interval_s=0.05,
+            idle_timeout_s=2.0)
+
+        def late_writer():
+            _time.sleep(0.3)
+            write(tmp_path / "a1.csv", [[2, "z"]])
+        t = threading.Thread(target=late_writer)
+        t.start()
+        batches = list(sr.stream())
+        t.join()
+        assert [len(b) for b in batches] == [2, 1]
+        assert batches[1][0]["i"] == "2" or batches[1][0]["i"] == 2
+
+    def test_tail_idle_timeout_terminates(self, tmp_path):
+        from transmogrifai_tpu.readers import StreamingReader
+        sr = StreamingReader.tail_directory(
+            str(tmp_path / "*.csv"), poll_interval_s=0.05,
+            idle_timeout_s=0.2)
+        assert list(sr.stream()) == []
+
+
+class TestStreamingStopOnError:
+    def _model_dir(self, tmp_path, rng):
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.models import LogisticRegression
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.workflow import Workflow
+        recs = [{"x": float(v), "label": float(v > 0)}
+                for v in rng.normal(size=60)]
+        label = FeatureBuilder.real_nn("label").extract(
+            lambda r: r["label"]).as_response()
+        x = FeatureBuilder.real("x").extract(
+            lambda r: r["x"]).as_predictor()
+        pred = LogisticRegression().set_input(
+            label, transmogrify([x])).get_output()
+        model = (Workflow().set_result_features(label, pred)
+                 .set_input_records(recs).train())
+        mdir = str(tmp_path / "model")
+        model.save(mdir)
+        return mdir, recs
+
+    def test_stop_on_error_default(self, tmp_path, rng):
+        """Reference semantics: an error in a micro-batch stops the
+        whole stream (OpWorkflowRunner.scala:313-320)."""
+        import pytest as _pytest
+
+        from transmogrifai_tpu.workflow.runner import (OpParams,
+                                                       WorkflowRunner)
+        mdir, recs = self._model_dir(tmp_path, rng)
+        bad = [{"x": object()}]          # unscorable record
+        batches = [recs[:5], bad, recs[5:10]]
+        runner = WorkflowRunner()
+        out = []
+        with _pytest.raises(Exception):
+            for b in runner.streaming_score(
+                    batches, OpParams(model_location=mdir)):
+                out.append(b)
+        assert len(out) == 1             # stopped AT the bad batch
+
+    def test_skip_on_error_opt_in(self, tmp_path, rng):
+        from transmogrifai_tpu.workflow.runner import (OpParams,
+                                                       WorkflowRunner)
+        mdir, recs = self._model_dir(tmp_path, rng)
+        bad = [{"x": object()}]
+        batches = [recs[:5], bad, recs[5:10]]
+        runner = WorkflowRunner()
+        out = list(runner.streaming_score(
+            batches, OpParams(model_location=mdir), stop_on_error=False))
+        assert [len(b) for b in out] == [5, 5]

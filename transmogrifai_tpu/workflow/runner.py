@@ -16,12 +16,15 @@ TPU-native port of the reference run scaffold
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 __all__ = ["OpParams", "WorkflowRunner", "RunType", "RunResult"]
 
@@ -256,17 +259,37 @@ class WorkflowRunner:
                          write_location=out_path, n_rows=n)
 
     def streaming_score(self, batches: Iterable[Iterable[dict]],
-                        params: Optional[OpParams] = None
+                        params: Optional[OpParams] = None,
+                        stop_on_error: bool = True
                         ) -> Iterator[List[dict]]:
         """Micro-batch scoring over a stream of record batches
         (reference streamingScore:232 over DStream micro-batches). Uses
-        the row-level local scoring path so per-batch latency stays flat."""
+        the row-level local scoring path so per-batch latency stays flat.
+
+        ``stop_on_error=True`` (default) stops the stream and re-raises
+        on the first failing batch — the reference's listener stops the
+        streaming context on error (OpWorkflowRunner.scala:313-320).
+        With False, failing batches are logged and skipped."""
         params = params or OpParams()
         model = self._load_model(params)
         from ..local.scoring import ScoreFunction
         fn = ScoreFunction(model)
-        for batch in batches:
-            yield fn.score_batch(list(batch))
+        for i, batch in enumerate(batches):
+            try:
+                scored = fn.score_batch(list(batch))
+            except Exception:
+                if stop_on_error:
+                    _log.error("streaming batch %d failed; stopping the "
+                               "stream (reference stop-on-error, "
+                               "OpWorkflowRunner.scala:313-320)", i)
+                    raise
+                _log.warning("streaming batch %d failed; skipping",
+                             i, exc_info=True)
+                continue
+            # the yield sits OUTSIDE the try: an exception thrown INTO
+            # the suspended generator must propagate as the consumer's
+            # error, not be misattributed to batch scoring
+            yield scored
 
     # -- output ------------------------------------------------------------
     @staticmethod
