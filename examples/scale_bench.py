@@ -57,12 +57,14 @@ def main() -> None:
     # rough matmul-mode histogram FLOPs model for an MFU estimate: the
     # per-level einsum contraction costs ~2*n*C_l*S*TB FLOPs with
     # C_l = min(2^l, 256) active slots (models/trees._level_histograms)
-    from transmogrifai_tpu.models.trees import _design_args
+    from transmogrifai_tpu.models.trees import (_DEFAULT_NODE_CAP,
+                                                _design_args)
 
     def hist_flops(n: int, total_bins: int, depth: int, units: int,
                    s_dim: int) -> float:
-        per_tree = sum(2.0 * n * min(2 ** l, 256) * s_dim * total_bins
-                       for l in range(depth))
+        per_tree = sum(
+            2.0 * n * min(2 ** l, _DEFAULT_NODE_CAP) * s_dim * total_bins
+            for l in range(depth))
         return units * per_tree
 
     #: assumed peak for the MFU denominator; override TX_PEAK_TFLOPS

@@ -103,3 +103,29 @@ class TestShardedGBTParity:
         np.testing.assert_array_equal(sharded.feats, local.feats)
         np.testing.assert_allclose(sharded.leaves, local.leaves,
                                    atol=1e-9)
+
+
+class TestVmappedTreeBlocks:
+    def test_blocks_equal_scan(self, monkeypatch):
+        """TX_TREE_BLOCK_MB forces the vmapped-block forest path (the
+        accelerator default) on CPU; trees must equal the lax.scan
+        path's (same per-tree keys, independent lanes)."""
+        X, yc, _ = _data(n=320)
+        est = RandomForestClassifier(num_trees=12, max_depth=4, seed=9)
+        scan_model = est.fit_arrays(X, yc)
+        monkeypatch.setenv("TX_TREE_BLOCK_MB", "256")
+        block_model = est.fit_arrays(X, yc)
+        np.testing.assert_array_equal(block_model.feats,
+                                      scan_model.feats)
+        np.testing.assert_allclose(block_model.leaves,
+                                   scan_model.leaves, atol=1e-12)
+
+    def test_cpu_defaults_to_scan(self):
+        from transmogrifai_tpu.models.trees import (_tree_block_size,
+                                                    _tree_budget_mb)
+        assert _tree_budget_mb() is None
+        assert _tree_block_size(10_000, 500, 6, 2, 50, "matmul",
+                                False) == 1
+        # explicit budget enables blocks regardless of platform
+        assert _tree_block_size(1_000, 100, 4, 2, 50, "matmul", False,
+                                budget_mb=256) > 1

@@ -615,20 +615,34 @@ def _row_draw(draw_fn, wkey, n: int, axis_name: Optional[str],
 _TREE_BLOCK_BUDGET_MB = 256
 
 
-def _tree_budget_mb() -> int:
-    """Resolved tree-block budget in MB. Callers must thread this into
+def _tree_budget_mb() -> Optional[int]:
+    """Resolved tree-block budget in MB, or None for platform-auto
+    (accelerators: default budget; CPU: no tree batching — measured a
+    ~9% Titanic regression from batching on one core, where the blocks'
+    dispatch-latency win doesn't exist). Callers must thread this into
     their kernel cache keys / jit statics — reading the env var inside
     an already-compiled program would silently ignore changes."""
     import os
-    return (int(os.environ.get("TX_TREE_BLOCK_MB", "0"))
-            or _TREE_BLOCK_BUDGET_MB)
+    v = int(os.environ.get("TX_TREE_BLOCK_MB", "0"))
+    return v or None
 
 
 def _tree_block_size(n: int, total_bins: int, depth: int, s_dim: int,
                      num_trees: int, hist_mode: str, pooled: bool,
                      outer_batch: int = 1,
                      budget_mb: Optional[int] = None) -> int:
-    budget = (budget_mb or _tree_budget_mb()) * 1024 * 1024
+    if budget_mb is None:
+        # platform-auto (decided at trace time, like _hist_mode): vmap
+        # blocks pay on accelerators where a lax.scan of tiny per-level
+        # ops is launch-latency-bound; on CPU the scan wins
+        try:
+            platform = jax.default_backend()
+        except Exception:  # pragma: no cover - defensive
+            platform = "cpu"
+        if platform == "cpu":
+            return 1
+        budget_mb = _TREE_BLOCK_BUDGET_MB
+    budget = budget_mb * 1024 * 1024
     cap = min(n, _DEFAULT_NODE_CAP)
     c_max = min(2 ** max(depth - 1, 0), cap)
     per_tree = 2 * n * 8 + 2 * c_max * total_bins * s_dim * 8

@@ -73,7 +73,8 @@ class StreamingReader:
     @staticmethod
     def tail_directory(path_glob: str, poll_interval_s: float = 1.0,
                        idle_timeout_s: Optional[float] = None,
-                       fmt: str = "auto") -> "StreamingReader":
+                       fmt: str = "auto",
+                       on_error: str = "raise") -> "StreamingReader":
         """LIVE directory tail: yield one micro-batch per NEW file
         matching ``path_glob`` as it appears, polling every
         ``poll_interval_s`` — the continuous-source behavior of the
@@ -82,8 +83,15 @@ class StreamingReader:
         are emitted first (in name order); the stream then keeps
         polling until ``idle_timeout_s`` passes with no new file
         (None = tail forever, like a DStream until its context stops).
-        ``fmt``: "avro" | "csv" | "auto" (by extension)."""
+        ``fmt``: "avro" | "csv" | "auto" (by extension).
+        ``on_error``: "raise" stops the stream on an unreadable file
+        (the reference's stop-on-error); "skip" logs it, marks it
+        consumed, and keeps tailing."""
+        import logging
         import time as _time
+        if on_error not in ("raise", "skip"):
+            raise ValueError("on_error must be 'raise' or 'skip'")
+        log = logging.getLogger(__name__)
 
         def _read(path: str) -> List[dict]:
             kind = fmt
@@ -104,9 +112,11 @@ class StreamingReader:
 
         def gen():
             seen: set = set()
-            pending: dict = {}       # path -> last observed (size, mtime)
+            #: path -> (last observed (size, mtime), first-stable time)
+            pending: dict = {}
             last_new = _time.monotonic()
             while True:
+                now = _time.monotonic()
                 current = sorted(glob.glob(path_glob))
                 # bound memory on long tails over high-churn spools:
                 # rotated-away files leave the bookkeeping
@@ -122,18 +132,32 @@ class StreamingReader:
                     sig = _stat(p)
                     if sig is None:
                         continue
-                    if pending.get(p) != sig:
-                        # first sighting or still growing: require the
-                        # (size, mtime) to hold across two polls so a
-                        # file caught mid-write is not truncated (the
-                        # DStream fileStream's mod-time windowing role)
-                        pending[p] = sig
+                    prev = pending.get(p)
+                    if prev is None or prev[0] != sig:
+                        # first sighting or still growing: the
+                        # (size, mtime) must hold for a full poll
+                        # interval so a file caught mid-write is not
+                        # truncated (DStream mod-time windowing role).
+                        # Wall-clock age, not poll count — delivery
+                        # passes skip the sleep, so consecutive polls
+                        # can be microseconds apart.
+                        pending[p] = (sig, now)
+                        continue
+                    if now - prev[1] < poll_interval_s:
                         continue
                     del pending[p]
                     seen.add(p)
-                    last_new = _time.monotonic()
+                    last_new = now
                     delivered = True
-                    yield _read(p)
+                    try:
+                        batch = _read(p)
+                    except Exception:
+                        if on_error == "raise":
+                            raise
+                        log.warning("tail_directory: unreadable file "
+                                    "%s skipped", p, exc_info=True)
+                        continue
+                    yield batch
                 if not delivered:
                     if idle_timeout_s is not None and not pending and \
                             _time.monotonic() - last_new > idle_timeout_s:
