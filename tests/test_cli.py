@@ -85,3 +85,73 @@ class TestCliAvroAndKind:
         assert "DataReaders.Simple.avro" in src
         assert "RegressionModelSelector" in src
         compile(src, "main.py", "exec")   # generated code parses
+
+
+class TestInteractiveGen:
+    """Reference `op gen` interactive Q&A (cli/.../ProblemSchema)."""
+
+    def _write_csv(self, tmp_path):
+        import csv
+        p = tmp_path / "data.csv"
+        with open(p, "w", newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["id", "age", "color", "label"])
+            for i in range(30):
+                w.writerow([i, 20 + i % 5, ["red", "blue"][i % 2], i % 2])
+        return str(p)
+
+    def test_interactive_overrides_types_and_kind(self, tmp_path):
+        from transmogrifai_tpu.cli.gen import generate_project
+        csv_path = self._write_csv(tmp_path)
+        answers = iter([
+            "skip",        # id column -> excluded entirely
+            "Real",        # age: override Integral -> Real
+            "",            # color: keep inference
+            "none",        # id field (id already skipped above)
+            "binary",      # kind
+        ])
+        out = str(tmp_path / "proj")
+        schema = generate_project(
+            csv_path, "label", out, interactive=True,
+            input_fn=lambda prompt: next(answers))
+        assert "id" not in schema
+        assert schema["age"] == "Real"
+        main_py = open(tmp_path / "proj" / "main.py").read()
+        assert "BinaryClassificationModelSelector" in main_py
+        assert "'age', Real" in main_py or '"age", Real' in main_py
+
+    def test_interactive_reprompts_on_typo(self, tmp_path):
+        # a bad answer re-prompts (the reference Q&A behavior) instead
+        # of discarding the dialogue; type names are case-insensitive
+        from transmogrifai_tpu.cli.gen import generate_project
+        csv_path = self._write_csv(tmp_path)
+        answers = iter([
+            "Bogus", "skip",   # id: typo, then skip on re-prompt
+            "real",            # age: lowercase accepted
+            "",                # color
+            "nope", "none",    # id field: non-column rejected, none ok
+            "binary",
+        ])
+        schema = generate_project(
+            csv_path, "label", str(tmp_path / "p2"), interactive=True,
+            input_fn=lambda prompt: next(answers))
+        assert schema["age"] == "Real" and "id" not in schema
+
+    def test_interactive_gives_up_after_retries(self, tmp_path):
+        from transmogrifai_tpu.cli.gen import generate_project
+        csv_path = self._write_csv(tmp_path)
+        with pytest.raises(ValueError, match="too many invalid"):
+            generate_project(
+                csv_path, "label", str(tmp_path / "p3"), interactive=True,
+                input_fn=lambda prompt: "Bogus")
+
+    def test_flag_wiring(self, tmp_path, monkeypatch):
+        import io
+
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        csv_path = self._write_csv(tmp_path)
+        monkeypatch.setattr("sys.stdin", io.StringIO("\n" * 8))
+        rc = cli_main(["gen", "--input", csv_path, "--response", "label",
+                       "--output", str(tmp_path / "p3"), "--interactive"])
+        assert rc == 0
+        assert (tmp_path / "p3" / "main.py").exists()
