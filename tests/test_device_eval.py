@@ -223,3 +223,77 @@ class TestBinEdgeDeviationWinnerParity:
         for rb, rs in zip(batched.results, seq.results):
             np.testing.assert_allclose(rb.metric_values, rs.metric_values,
                                        atol=0.12, err_msg=rb.model_name)
+
+
+class TestGLMDeviceSearch:
+    def test_glm_pool_parity(self, rng):
+        from transmogrifai_tpu.models.glm import (
+            GeneralizedLinearRegression)
+        X = np.abs(rng.normal(size=(240, 5))) + 0.1
+        y = np.exp(0.3 * X[:, 0] - 0.2 * X[:, 1]) \
+            + 0.05 * rng.normal(size=240)
+        pool = [(GeneralizedLinearRegression(),
+                 [{"family": f, "reg_param": r}
+                  for f in ("gaussian", "poisson")
+                  for r in (0.001, 0.1)])]
+        best = _assert_same_search(pool, X, y, RegressionEvaluator(),
+                                   atol=1e-7)
+        assert np.isfinite(best.metric)
+
+    def test_glm_batched_fit_matches_sequential(self, rng):
+        from transmogrifai_tpu.models.glm import (
+            GeneralizedLinearRegression)
+        X = rng.normal(size=(150, 4))
+        y = X @ np.array([1.0, -0.5, 0.2, 0.0]) \
+            + 0.1 * rng.normal(size=150)
+        est = GeneralizedLinearRegression(reg_param=0.01)
+        masks = np.ones((2, 150))
+        masks[0, :50] = 0.0
+        masks[1, 50:100] = 0.0
+        fitted = est.fit_fold_grid_arrays(
+            X, y, masks, [{"reg_param": 0.01}])
+        for f, mask in enumerate(masks):
+            seq = est.fit_arrays(X[mask > 0], y[mask > 0])
+            np.testing.assert_allclose(
+                fitted[f][0].coefficients, seq.coefficients, atol=1e-8)
+
+    def test_glm_mesh_matches_local(self, rng):
+        from transmogrifai_tpu.models.glm import (
+            GeneralizedLinearRegression)
+        from transmogrifai_tpu.parallel import make_mesh
+        X = rng.normal(size=(160, 4))
+        y = X @ np.array([1.0, -0.5, 0.2, 0.0]) \
+            + 0.1 * rng.normal(size=160)
+        pool = [(GeneralizedLinearRegression(),
+                 [{"reg_param": r} for r in (0.001, 0.1)])]
+        ev = RegressionEvaluator()
+        local = CrossValidation(ev, num_folds=2, seed=3).validate(
+            pool, X, y)
+        meshed = CrossValidation(ev, num_folds=2, seed=3,
+                                 mesh=make_mesh({"models": 8})).validate(
+            pool, X, y)
+        assert meshed.params == local.params
+        for rm, rl in zip(meshed.results, local.results):
+            np.testing.assert_allclose(rm.metric_values, rl.metric_values,
+                                       atol=1e-9)
+
+    def test_glm_masked_rows_do_not_poison_log_link(self, rng):
+        # a held-out outlier row overflows exp() under the log link;
+        # the masked lane must still fit (the sequential per-fold fit
+        # never sees that row)
+        from transmogrifai_tpu.models.glm import (
+            GeneralizedLinearRegression)
+        X = rng.normal(size=(120, 3))
+        X[0, 0] = 400.0                       # masked-out overflow row
+        y = np.exp(np.clip(0.3 * X[:, 0], -5, 5)) \
+            + 0.05 * rng.normal(size=120)
+        y = np.maximum(y, 0.01)
+        masks = np.ones((1, 120))
+        masks[0, 0] = 0.0                     # row 0 held out
+        est = GeneralizedLinearRegression(family="poisson",
+                                          reg_param=0.01)
+        fitted = est.fit_fold_grid_arrays(X, y, masks, [{}])
+        coefs = fitted[0][0].coefficients
+        assert np.all(np.isfinite(coefs)), coefs
+        seq = est.fit_arrays(X[1:], y[1:])
+        np.testing.assert_allclose(coefs, seq.coefficients, atol=1e-6)
