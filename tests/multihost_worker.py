@@ -69,8 +69,28 @@ def main() -> None:
             np.testing.assert_allclose(models_mesh[f][g].leaves,
                                        models_local[f][g].leaves,
                                        rtol=1e-5)
-    print(f"proc {pid}: multihost kernels OK (linear diff {err:.2e})",
-          flush=True)
+    # row-sharded (data-parallel) tree fit whose histogram psums cross
+    # the PROCESS boundary — the Rabit-allreduce-over-DCN role
+    # (SURVEY §2.9/§5.8). Bit-exact parity with the single-device fit
+    # holds on a single-host mesh (tests/test_tree_sharded.py); across
+    # processes the psum's reduction order differs at the ULP level and
+    # can flip near-tie splits — the same property Rabit-distributed
+    # XGBoost has — so here we pin DETERMINISM (same mesh, same trees
+    # twice) and training-quality proximity to the local fit.
+    data_mesh = make_mesh({"data": 4})
+    gbt = GBTClassifier(num_rounds=3, max_depth=3)
+    sharded = gbt.fit_arrays_sharded(X, y, data_mesh)
+    sharded2 = gbt.fit_arrays_sharded(X, y, data_mesh)
+    np.testing.assert_array_equal(sharded.feats, sharded2.feats)
+    np.testing.assert_array_equal(sharded.leaves, sharded2.leaves)
+    local = gbt.fit_arrays(X, y)
+    acc_s = float(np.mean(sharded.predict_arrays(X).data == y))
+    acc_l = float(np.mean(local.predict_arrays(X).data == y))
+    assert abs(acc_s - acc_l) <= 0.03, (acc_s, acc_l)
+
+    print(f"proc {pid}: multihost kernels OK (linear diff {err:.2e}; "
+          f"cross-process data-parallel GBT deterministic, "
+          f"acc {acc_s:.3f} vs local {acc_l:.3f})", flush=True)
 
 
 if __name__ == "__main__":

@@ -80,6 +80,15 @@ def to_host(array) -> "np.ndarray":
     """
     if getattr(array, "is_fully_addressable", True):
         return np.asarray(array)
+    sharding = getattr(array, "sharding", None)
+    if sharding is not None and getattr(sharding, "is_fully_replicated",
+                                        False):
+        # every process already holds the complete value (e.g. a
+        # row-sharded fit's out_specs=P() trees): take the local copy
+        # directly instead of paying process_allgather's redundant
+        # cross-process collective (which handles this case correctly,
+        # just not for free)
+        return np.asarray(array.addressable_data(0))
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(
         array, tiled=True))
