@@ -201,11 +201,14 @@ def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
     """Histogram strategy: "scatter" (fused segment_sum), "matmul"
     (one-hot contractions that ride the MXU), or "pallas" (fused VMEM-
     resident accumulation kernel, models/pallas_hist.py). Auto: matmul
-    on accelerators (XLA scatters serialize there) and for small
-    problems on CPU (dense BLAS beats the scatter for n*TB up to a few
-    million); scatter for large problems on CPU where the contraction
-    FLOPs explode. TX_TREE_HIST overrides. Decided at trace time from
-    static shapes, so all modes stay available side by side."""
+    on accelerators (XLA scatters serialize there); scatter on CPU —
+    r4 re-measured the flagship search ~10% faster under scatter even
+    at small n*TB, retiring r3's small-problem matmul threshold (the
+    fused eval kernels changed the balance). TX_TREE_HIST overrides.
+    Decided at trace time (platform only for now — the n/total_bins
+    parameters stay in the signature so a size-based policy can return
+    without touching every call site), so all modes stay available
+    side by side."""
     import os
     mode = os.environ.get("TX_TREE_HIST")
     if mode in ("scatter", "matmul", "pallas"):
@@ -214,9 +217,7 @@ def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
         platform = jax.default_backend()
     except Exception:
         platform = "cpu"
-    if platform != "cpu":
-        return "matmul"
-    return "matmul" if 0 < n * total_bins <= 2_000_000 else "scatter"
+    return "matmul" if platform != "cpu" else "scatter"
 
 
 def _bin_indicator(packed: jnp.ndarray, total_bins: int,
