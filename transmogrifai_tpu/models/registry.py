@@ -9,11 +9,13 @@ MultiClassificationModelSelector.scala:138-183;
 RegressionModelSelector.scala:150-193; grid values from
 DefaultSelectorParams.scala:36-59).
 
-Documented deviation: the reference's RF/DT grids sweep minInfoGain over
-(0.001, 0.01, 0.1); we pin minInfoGain=0.001 (the Spark-near-default
-end) and sweep depth x minInstancesPerNode, keeping the search's
-shape-distinct compile count low — the dominant quality factors for
-these families on tabular data are depth and leaf-size regularization.
+minInfoGain is swept over (0.001, 0.01, 0.1) exactly as
+DefaultSelectorParams.MinInfoGain prescribes: it is a *traced* scalar
+in the batched fold x grid kernels (`trees._FOREST_TRACED`), so the
+sweep adds vmapped candidate lanes, not compiles. For the XGB-style
+GBT booster the analog is ``gamma`` (min split-loss reduction), swept
+over the same values; ``min_child_weight`` (1, 10) plays the
+minInstancesPerNode (10, 100) role on the hessian scale.
 """
 from __future__ import annotations
 
@@ -34,6 +36,10 @@ _ELASTIC = (0.1, 0.5)
 _DEPTH = (3, 6, 12)
 #: DefaultSelectorParams.MinInstancesPerNode
 _MIN_INST = (10, 100)
+#: DefaultSelectorParams.MinInfoGain
+_MIN_GAIN = (0.001, 0.01, 0.1)
+#: DefaultSelectorParams.MinChildWeight (xgboost)
+_MIN_CHILD = (1.0, 5.0, 10.0)
 #: DefaultSelectorParams.{MaxTrees, MaxIterTree, MaxIterLin}
 _NUM_TREES, _GBT_ROUNDS, _MAX_ITER_LIN = 50, 20, 50
 
@@ -47,13 +53,12 @@ def default_binary_models() -> List[Tuple[Predictor, List[Dict]]]:
         (LogisticRegression(max_iter=_MAX_ITER_LIN),
          [{"reg_param": r, "elastic_net_param": e}
           for r in _REG for e in _ELASTIC]),
-        (RandomForestClassifier(num_trees=_NUM_TREES,
-                                min_info_gain=0.001),
-         [{"max_depth": d, "min_instances_per_node": m}
-          for d in _DEPTH for m in _MIN_INST]),
+        (RandomForestClassifier(num_trees=_NUM_TREES),
+         [{"max_depth": d, "min_instances_per_node": m, "min_info_gain": g}
+          for d in _DEPTH for m in _MIN_INST for g in _MIN_GAIN]),
         (GBTClassifier(num_rounds=_GBT_ROUNDS),
-         [{"max_depth": d, "min_child_weight": float(m)}
-          for d in _DEPTH for m in (1, 10)]),
+         [{"max_depth": d, "min_child_weight": float(m), "gamma": g}
+          for d in _DEPTH for m in (1, 10) for g in _MIN_GAIN]),
         (LinearSVC(max_iter=_MAX_ITER_LIN),
          [{"reg_param": r} for r in _REG]),
     ]
@@ -66,12 +71,12 @@ def default_binary_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
     from .trees import DecisionTreeClassifier, XGBoostClassifier
     return [
         (NaiveBayes(), [{"smoothing": 1.0}]),
-        (DecisionTreeClassifier(min_info_gain=0.001),
-         [{"max_depth": d, "min_instances_per_node": m}
-          for d in _DEPTH for m in _MIN_INST]),
+        (DecisionTreeClassifier(),
+         [{"max_depth": d, "min_instances_per_node": m, "min_info_gain": g}
+          for d in _DEPTH for m in _MIN_INST for g in _MIN_GAIN]),
         (XGBoostClassifier(),
-         [{"max_depth": d, "eta": e}
-          for d in _DEPTH for e in (0.1, 0.3)]),
+         [{"max_depth": d, "eta": e, "min_child_weight": m}
+          for d in _DEPTH for e in (0.1, 0.3) for m in _MIN_CHILD]),
     ]
 
 
@@ -85,14 +90,13 @@ def default_multiclass_models() -> List[Tuple[Predictor, List[Dict]]]:
         (LogisticRegression(max_iter=_MAX_ITER_LIN),
          [{"reg_param": r, "elastic_net_param": e}
           for r in _REG for e in _ELASTIC]),
-        (RandomForestClassifier(num_trees=_NUM_TREES,
-                                min_info_gain=0.001),
-         [{"max_depth": d, "min_instances_per_node": m}
-          for d in _DEPTH for m in _MIN_INST]),
+        (RandomForestClassifier(num_trees=_NUM_TREES),
+         [{"max_depth": d, "min_instances_per_node": m, "min_info_gain": g}
+          for d in _DEPTH for m in _MIN_INST for g in _MIN_GAIN]),
         (NaiveBayes(), [{"smoothing": 1.0}]),
-        (DecisionTreeClassifier(min_info_gain=0.001),
-         [{"max_depth": d, "min_instances_per_node": m}
-          for d in _DEPTH for m in _MIN_INST]),
+        (DecisionTreeClassifier(),
+         [{"max_depth": d, "min_instances_per_node": m, "min_info_gain": g}
+          for d in _DEPTH for m in _MIN_INST for g in _MIN_GAIN]),
     ]
 
 
@@ -104,8 +108,8 @@ def default_multiclass_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
     from .trees import XGBoostClassifier
     return [
         (XGBoostClassifier(num_round=_GBT_ROUNDS),
-         [{"max_depth": d, "min_child_weight": float(m)}
-          for d in _DEPTH for m in _MIN_INST[:1]]),
+         [{"max_depth": d, "min_child_weight": m}
+          for d in _DEPTH for m in _MIN_CHILD]),
         (MultilayerPerceptronClassifier(),
          [{"hidden_layers": h} for h in ((10,), (32, 16))]),
     ]
@@ -123,12 +127,12 @@ def default_regression_models() -> List[Tuple[Predictor, List[Dict]]]:
         (LinearRegression(max_iter=_MAX_ITER_LIN),
          [{"reg_param": r, "elastic_net_param": e}
           for r in _REG for e in _ELASTIC]),
-        (RandomForestRegressor(num_trees=_NUM_TREES, min_info_gain=0.001),
-         [{"max_depth": d, "min_instances_per_node": m}
-          for d in _DEPTH for m in _MIN_INST]),
+        (RandomForestRegressor(num_trees=_NUM_TREES),
+         [{"max_depth": d, "min_instances_per_node": m, "min_info_gain": g}
+          for d in _DEPTH for m in _MIN_INST for g in _MIN_GAIN]),
         (GBTRegressor(num_rounds=_GBT_ROUNDS),
-         [{"max_depth": d, "min_child_weight": float(m)}
-          for d in _DEPTH for m in (1, 10)]),
+         [{"max_depth": d, "min_child_weight": float(m), "gamma": g}
+          for d in _DEPTH for m in (1, 10) for g in _MIN_GAIN]),
         (GeneralizedLinearRegression(),
          [{"family": f, "reg_param": r}
           for f in ("gaussian", "poisson") for r in (0.001, 0.01, 0.1)]),
@@ -138,9 +142,10 @@ def default_regression_models() -> List[Tuple[Predictor, List[Dict]]]:
 def default_regression_extra_models() -> List[Tuple[Predictor, List[Dict]]]:
     from .trees import DecisionTreeRegressor, XGBoostRegressor
     return [
-        (DecisionTreeRegressor(min_info_gain=0.001),
-         [{"max_depth": d, "min_instances_per_node": m}
-          for d in _DEPTH for m in _MIN_INST]),
+        (DecisionTreeRegressor(),
+         [{"max_depth": d, "min_instances_per_node": m, "min_info_gain": g}
+          for d in _DEPTH for m in _MIN_INST for g in _MIN_GAIN]),
         (XGBoostRegressor(),
-         [{"max_depth": d, "eta": e} for d in _DEPTH for e in (0.1, 0.3)]),
+         [{"max_depth": d, "eta": e, "min_child_weight": m}
+          for d in _DEPTH for e in (0.1, 0.3) for m in _MIN_CHILD]),
     ]
