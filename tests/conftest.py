@@ -6,6 +6,7 @@ CPU mesh so multi-chip sharding logic is exercised without TPU hardware.
 Must set flags before jax initializes.
 """
 import os
+import sys
 
 # Force-override: the environment pins JAX_PLATFORMS=axon (the real-TPU
 # tunnel, one chip, slow remote compiles) and a sitecustomize imports jax
@@ -59,12 +60,19 @@ def pytest_configure(config):
 # ---------------------------------------------------------------------------
 
 def _ensure_map_count(minimum: int = 262144) -> None:
+    # system-wide sysctl write — opt out with TX_RAISE_MAP_COUNT=0
+    if os.environ.get("TX_RAISE_MAP_COUNT", "1") == "0":
+        return
     try:
         with open("/proc/sys/vm/max_map_count") as fh:
-            if int(fh.read()) >= minimum:
-                return
+            current = int(fh.read())
+        if current >= minimum:
+            return
         with open("/proc/sys/vm/max_map_count", "w") as fh:
             fh.write(str(minimum))
+        print(f"\n[conftest] raised sysctl vm.max_map_count "
+              f"{current} -> {minimum} (persists on this host; set "
+              f"TX_RAISE_MAP_COUNT=0 to forbid)", file=sys.stderr)
     except (OSError, ValueError, PermissionError):
         pass  # not privileged: the periodic cache clear still bounds growth
 

@@ -33,15 +33,47 @@ class TestSplitters:
         assert np.isclose(np.mean(y[te] == 1), 0.2)
         assert len(np.intersect1d(tr, te)) == 0
 
-    def test_balancer_downsamples(self):
+    def test_balancer_upsamples_minority(self):
+        """Reference getProportions (DataBalancer.scala:86-117): 30 pos
+        vs 900 neg at target 0.25 -> up-sample minority x5 (largest
+        multiplier keeping 5*30*0.75 < 0.25*900), down-sample majority
+        to 0.5 -> 150 pos + 450 neg = exactly the target fraction."""
         y = np.array([0] * 900 + [1] * 30, dtype=float)
         b = DataBalancer(sample_fraction=0.25)
         idx = b.prepare(y)
-        frac = np.mean(y[idx] == 1)
-        assert frac >= 0.24
-        assert b.summary.results["balanced"] is True
-        # all minority rows kept
-        assert np.sum(y[idx] == 1) == 30
+        assert np.isclose(np.mean(y[idx] == 1), 0.25, atol=0.01)
+        assert np.sum(y[idx] == 1) == 150       # 30 x 5, with replacement
+        assert np.sum(y[idx] == 0) == 450       # 900 x 0.5
+        res = b.summary.results
+        assert res["balanced"] is True
+        assert res["upSamplingFraction"] == 5.0
+        assert np.isclose(res["downSamplingFraction"], 0.5)
+
+    def test_balancer_plan_reused_across_prepares(self):
+        """estimate() fixes the plan from global counts; per-fold
+        prepares apply the SAME fractions even when the fold's own
+        label mix differs (reference isSet guard,
+        DataBalancer.scala:132-137)."""
+        y_global = np.array([0] * 900 + [1] * 100, dtype=float)
+        b = DataBalancer(sample_fraction=0.25)
+        b.estimate(y_global)
+        up = b.summary.results["upSamplingFraction"]
+        # a fold with a slightly different mix still gets the global plan
+        y_fold = np.array([0] * 600 + [1] * 80, dtype=float)
+        idx = b.prepare(y_fold)
+        assert np.sum(y_fold[idx] == 1) == int(round(up * 80))
+
+    def test_balancer_downsamples_both_when_capped(self):
+        """When the minority alone exceeds max_training_sample *
+        fraction, both classes shrink (reference getProportions else
+        branch)."""
+        y = np.array([0] * 3000 + [1] * 600, dtype=float)
+        b = DataBalancer(sample_fraction=0.25, max_training_sample=2000)
+        idx = b.prepare(y)
+        n_pos, n_neg = np.sum(y[idx] == 1), np.sum(y[idx] == 0)
+        # up = 2000*0.25/600 = 0.833 -> 500 pos; down = 0.75*2000/3000
+        # -> 1500 neg; total == cap, fraction == target
+        assert n_pos == 500 and n_neg == 1500
 
     def test_balancer_noop_when_balanced(self):
         y = np.array([0] * 50 + [1] * 50, dtype=float)

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from transmogrifai_tpu.checkers import SanityChecker
+from transmogrifai_tpu.models.base import ClassifierModel, Predictor
 from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
 from transmogrifai_tpu.features.builder import FeatureBuilder
 from transmogrifai_tpu.models import LogisticRegression
@@ -134,13 +135,11 @@ def test_workflow_cv_changes_validation_metric(rng):
 
 
 def test_workflow_cv_imbalanced_with_balancer():
-    """Documented deviation (workflow/workflow.py
-    _find_best_with_workflow_cv): the selector's DataBalancer applies
-    only at the final full refit — the per-fold search relies on
-    stratified folds for class balance. On 10:1 imbalanced data the
-    search must still complete, keep every fold's metric finite (no
-    single-class folds), and the final balanced refit must produce a
-    model that actually detects the minority class."""
+    """In-search balancing (reference OpValidator.applyDAG:250-252):
+    the selector's DataBalancer now resamples every fold's train and
+    validation rows inside the workflow-CV search. On 10:1 imbalanced
+    data the search must complete, keep every fold's metric finite,
+    and the final balanced refit must detect the minority class."""
     from transmogrifai_tpu.selector.splitters import DataBalancer
     rng = np.random.default_rng(7)
     recs = []
@@ -177,3 +176,71 @@ def test_workflow_cv_imbalanced_with_balancer():
     # balanced refit must not collapse to the majority class
     assert pred_labels[y == 1].mean() > 0.5
     assert (pred_labels == y).mean() > 0.85
+
+
+class _PickyModel(ClassifierModel):
+    """Scores the strong feature only if its train labels were balanced
+    (otherwise a constant score) — a probe for whether the search saw
+    balanced or raw folds."""
+
+    def __init__(self, balanced=True, uid=None):
+        super().__init__(uid=uid)
+        self.balanced = balanced
+
+    def predict_raw(self, X):
+        s = X[:, 0] if self.balanced else np.zeros(len(X))
+        return np.stack([-s, s], axis=1)
+
+
+class _WeakModel(ClassifierModel):
+    def predict_raw(self, X):
+        s = X[:, 1]
+        return np.stack([-s, s], axis=1)
+
+
+class _BalancePicky(Predictor):
+    def fit_arrays(self, X, y):
+        return _PickyModel(balanced=bool(0.3 <= np.mean(y) <= 0.7))
+
+
+class _Weak(Predictor):
+    def fit_arrays(self, X, y):
+        return _WeakModel()
+
+
+def test_insearch_balancing_flips_winner():
+    """In-search DataBalancer changes candidate RANKING, not just the
+    final refit (reference ModelSelector.scala:140-152 +
+    OpValidator.applyDAG:250-252): a model that exploits the strong
+    feature only on balanced train data loses the stratify-only search
+    (5% positives -> constant scores -> AuPR ~= prevalence) but wins
+    the balanced search (~40% positives -> near-perfect AuPR)."""
+    from transmogrifai_tpu.selector.splitters import DataBalancer
+    rng = np.random.default_rng(11)
+    recs = []
+    for i in range(600):
+        y = float(rng.random() < 0.05)
+        recs.append({"x0": y + 0.2 * rng.normal(),     # strong signal
+                     "x1": y + 2.0 * rng.normal(),     # weak signal
+                     "label": y})
+
+    def run(splitter):
+        label = FeatureBuilder.real_nn("label").extract(
+            lambda r: r["label"]).as_response()
+        xs = [FeatureBuilder.real(n).extract(
+            lambda r, n=n: r[n]).as_predictor() for n in ("x0", "x1")]
+        fv = transmogrify(xs)
+        checked = SanityChecker(check_sample=1.0).set_input(
+            label, fv).get_output()
+        selector = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3, stratify=True, splitter=splitter,
+            models=[(_BalancePicky(), [{}]), (_Weak(), [{}])])
+        pred = selector.set_input(label, checked).get_output()
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(recs).with_workflow_cv().train())
+        sel = [s for s in model.stages()
+               if isinstance(s, SelectedModel)][0]
+        return sel.summary.best_model_name
+
+    assert run(None) == "_Weak"
+    assert run(DataBalancer(sample_fraction=0.4, seed=3)) == "_BalancePicky"

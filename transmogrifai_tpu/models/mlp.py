@@ -12,6 +12,7 @@ one XLA program, all matmuls on the MXU.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -24,6 +25,28 @@ from .solvers import lbfgs_minimize
 
 __all__ = ["MultilayerPerceptronClassifier",
            "MultilayerPerceptronClassifierModel"]
+
+_log = logging.getLogger(__name__)
+
+
+def _group_mlp_grid(grid, with_params):
+    """Group grid points whose batched-solver-relevant params coincide.
+    ``tol`` is inert for the fixed-trip batched solver (a documented
+    deviation from the sequential L-BFGS path — see
+    docs/MIGRATION.md); points differing only in tol share one fit,
+    and the collapse is logged so it never happens silently."""
+    groups = {}
+    for gi, p in enumerate(grid):
+        cand = with_params(**p)
+        key = (cand.hidden_layers, cand.max_iter, cand.seed)
+        groups.setdefault(key, []).append(gi)
+    for key, gis in groups.items():
+        if len(gis) > 1:
+            _log.info(
+                "MLP batched CV: grid points %s differ only in tol and "
+                "share one fixed-trip fit (hidden=%s, max_iter=%s)",
+                gis, key[0], key[1])
+    return groups
 
 
 def _init_params(key, sizes: Tuple[int, ...], dtype):
@@ -231,13 +254,7 @@ class MultilayerPerceptronClassifier(Predictor):
         check_fold_classes(y, masks)
         F = masks.shape[0]
         models = [[None] * len(grid) for _ in range(F)]
-        groups = {}
-        for gi, p in enumerate(grid):
-            cand = self.with_params(**p)
-            # tol is inert for the fixed-trip batched solver: grid
-            # points differing only in tol share one fit
-            key = (cand.hidden_layers, cand.max_iter, cand.seed)
-            groups.setdefault(key, []).append(gi)
+        groups = _group_mlp_grid(grid, self.with_params)
         X_j = jnp.asarray(X)
         y_j = jnp.asarray(y)
         from ..parallel.mesh import to_host
@@ -285,13 +302,7 @@ class MultilayerPerceptronClassifier(Predictor):
         check_fold_classes(y, masks)
         F = masks.shape[0]
         metric_mat = np.full((F, len(grid)), np.nan)
-        groups = {}
-        for gi, p in enumerate(grid):
-            cand = self.with_params(**p)
-            # tol is inert for the fixed-trip batched solver: grid
-            # points differing only in tol share one fit
-            key = (cand.hidden_layers, cand.max_iter, cand.seed)
-            groups.setdefault(key, []).append(gi)
+        groups = _group_mlp_grid(grid, self.with_params)
         X_j, y_j = jnp.asarray(X), jnp.asarray(y)
         Xv_j = jnp.asarray(np.asarray(X_val, dtype=np.float64))
         yv_j = jnp.asarray(np.asarray(y_val, dtype=np.float64))

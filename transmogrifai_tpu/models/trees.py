@@ -42,12 +42,16 @@ device arrays and are safe to call inside ``shard_map``.
 from __future__ import annotations
 
 import functools
+import logging
+import os
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from ..features.columns import PredictionColumn
 from .base import ClassifierModel, Predictor, RegressionModel, num_classes
@@ -95,12 +99,18 @@ class _PackedDesign:
                  "binned", "col_thr", "widths", "max_width", "n", "d",
                  "total_bins")
 
-    def __init__(self, X: np.ndarray, max_bins: int):
+    def __init__(self, X: np.ndarray, max_bins: int,
+                 edge_rows: Optional[np.ndarray] = None):
+        """``edge_rows`` restricts QUANTILE-EDGE estimation to those
+        rows (the fold-train rows under ``TX_TREE_EDGES=fold``) while
+        still binning every row of ``X`` — out-of-fold rows never
+        influence where the splits can fall."""
         X = np.asarray(X, dtype=np.float64)
         n, d = X.shape
+        E = X if edge_rows is None else X[edge_rows]
         binned_cols, thr_parts, widths = [], [], []
         for f in range(d):
-            col = X[:, f]
+            col = E[:, f]
             uniq = np.unique(col)
             if uniq.size <= 2:
                 edges = uniq[:1]                     # one edge, two bins
@@ -115,7 +125,8 @@ class _PackedDesign:
                     edges = np.concatenate(
                         [edges, np.full(width - 1 - edges.size, np.inf)])
             binned_cols.append(
-                np.searchsorted(edges, col, side="left").astype(np.int32))
+                np.searchsorted(edges, X[:, f],
+                                side="left").astype(np.int32))
             thr_parts.append(np.concatenate([edges, [np.inf]]))
             widths.append(width)
         offsets = np.concatenate([[0], np.cumsum(widths)[:-1]]).astype(np.int32)
@@ -292,9 +303,17 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
                feat_map: Optional[jnp.ndarray] = None,
                hist_mode: Optional[str] = None,
                axis_name: Optional[str] = None,
-               row_total: Optional[int] = None):
+               row_total: Optional[int] = None,
+               depth_limit=None):
     """Grow one complete tree of static ``depth`` over a packed binned
     design (see :class:`_PackedDesign`).
+
+    ``depth_limit`` (optional TRACED scalar <= depth) truncates growth:
+    levels >= depth_limit are denied splits, so one compiled program at
+    the grid's max depth serves every depth candidate as a vmapped lane
+    (TX_TREE_DEPTH=mask — the compile-count reduction; a denied split
+    routes all rows left, so shallower trees are exact, just stored in
+    a deeper heap of +inf thresholds).
 
     gain_fn(left, right, total) -> (..., ) gains with -inf where a split
     is invalid; ``left/right`` are (C, TB, S) and ``total`` (C, 1, S).
@@ -411,6 +430,8 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
         best = jnp.argmax(gain, axis=1)            # (C,) packed bin index
         best_gain = jnp.take_along_axis(gain, best[:, None], axis=1)[:, 0]
         split_ok = best_gain >= jnp.maximum(min_info_gain, 1e-12)
+        if depth_limit is not None:
+            split_ok &= level < depth_limit
         if identity:
             split_ok &= nonempty
         if level + 1 < depth and not identity:
@@ -669,7 +690,8 @@ def _forest_body(packed, feat_of, block_start, packed_thr,
                  axis_name: Optional[str] = None,
                  row_total: Optional[int] = None,
                  outer_batch: int = 1,
-                 budget_mb: Optional[int] = None):
+                 budget_mb: Optional[int] = None,
+                 depth_limit=None):
     """Shared forest program: ``mask`` (n,) row weights let one body
     serve the single fit (mask=ones), the fold x grid batched kernel
     (mask = fold membership, traced per-candidate hyperparams), and the
@@ -710,14 +732,15 @@ def _forest_body(packed, feat_of, block_start, packed_thr,
                 gain_fn=gain_fn, min_info_gain=min_info_gain,
                 feat_key=fkey, max_features=max_features, feat_map=pool,
                 hist_mode=hist_mode, axis_name=axis_name,
-                row_total=row_total)
+                row_total=row_total, depth_limit=depth_limit)
         else:
             feat, thr, leaf_stats, _ = _grow_tree(
                 packed, feat_of, block_start, packed_thr, stats,
                 depth=depth, gain_fn=gain_fn,
                 min_info_gain=min_info_gain, feat_key=fkey,
                 max_features=max_features, hist_mode=hist_mode,
-                axis_name=axis_name, row_total=row_total)
+                axis_name=axis_name, row_total=row_total,
+                depth_limit=depth_limit)
         if kind == "cls":
             lw = jnp.sum(leaf_stats, axis=-1, keepdims=True)
             leaf = jnp.where(lw > 0, leaf_stats / jnp.maximum(lw, 1e-12),
@@ -797,7 +820,8 @@ def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
               *, depth: int, num_rounds: int, objective: str,
               hist_mode: Optional[str],
               axis_name: Optional[str] = None,
-              row_total: Optional[int] = None):
+              row_total: Optional[int] = None,
+              depth_limit=None):
     """Shared boosting program with row-mask semantics (see
     _forest_body): masked rows get zero grad/hess weight; the base
     margin is the mask-weighted mean. ``axis_name`` row-shards the fit
@@ -834,7 +858,8 @@ def _gbt_body(packed, feat_of, block_start, packed_thr, y, key, mask,
             packed, feat_of, block_start, packed_thr,
             jnp.stack([g, h], axis=1), depth=depth,
             gain_fn=gain_fn, min_info_gain=0.0, hist_mode=hist_mode,
-            axis_name=axis_name, row_total=row_total)
+            axis_name=axis_name, row_total=row_total,
+            depth_limit=depth_limit)
         vals = -step_size * leaf_stats[:, 0] / (leaf_stats[:, 1] + reg_lambda)
         vals = jnp.where(jnp.sum(jnp.abs(leaf_stats), axis=1) > 0, vals, 0.0)
         margins = margins + vals[node]
@@ -958,7 +983,7 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
     (kind, depth, num_classes, num_trees, max_features, pool_cfg,
      impurity, bootstrap, hist_mode, budget_mb) = statics
 
-    def one(ob, mask, mi, mg, sr, packed, feat_of, block_start,
+    def one(ob, mask, mi, mg, sr, dl, packed, feat_of, block_start,
             packed_thr, binned, col_thr, narrow, wide, y, key):
         return _forest_body(
             packed, feat_of, block_start, packed_thr, binned, col_thr,
@@ -966,13 +991,13 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
             depth=depth, num_classes=num_classes, num_trees=num_trees,
             max_features=max_features, pool_cfg=pool_cfg,
             impurity=impurity, bootstrap=bootstrap, hist_mode=hist_mode,
-            outer_batch=ob, budget_mb=budget_mb)
+            outer_batch=ob, budget_mb=budget_mb, depth_limit=dl)
 
-    def batched(masks, mi, mg, sr, *rest):
+    def batched(masks, mi, mg, sr, dl, *rest):
         ob = masks.shape[0]     # candidate lanes share the block budget
         return jax.vmap(functools.partial(one, ob),
-                        in_axes=(0, 0, 0, 0) + (None,) * 10
-                        )(masks, mi, mg, sr, *rest)
+                        in_axes=(0, 0, 0, 0, 0) + (None,) * 10
+                        )(masks, mi, mg, sr, dl, *rest)
 
     if mesh is None:
         return jax.jit(batched)
@@ -982,7 +1007,7 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
     return jax.jit(jax.shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P("models"),
-                  P("models")) + (P(),) * 10,
+                  P("models"), P("models")) + (P(),) * 10,
         out_specs=(P("models", None, None), P("models", None, None),
                    leaves_spec), check_vma=False))
 
@@ -991,23 +1016,23 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
 def _gbt_fg_kernel(statics: tuple, mesh=None):
     depth, num_rounds, objective, hist_mode = statics
 
-    def one(mask, ss, rl, ga, mcw, sub, packed, feat_of, block_start,
+    def one(mask, ss, rl, ga, mcw, sub, dl, packed, feat_of, block_start,
             packed_thr, y, key):
         return _gbt_body(packed, feat_of, block_start, packed_thr, y,
                          key, mask, ss, rl, ga, mcw, sub, depth=depth,
                          num_rounds=num_rounds, objective=objective,
-                         hist_mode=hist_mode)
+                         hist_mode=hist_mode, depth_limit=dl)
 
-    def batched(masks, ss, rl, ga, mcw, sub, *rest):
-        return jax.vmap(one, in_axes=(0,) * 6 + (None,) * 6
-                        )(masks, ss, rl, ga, mcw, sub, *rest)
+    def batched(masks, ss, rl, ga, mcw, sub, dl, *rest):
+        return jax.vmap(one, in_axes=(0,) * 7 + (None,) * 6
+                        )(masks, ss, rl, ga, mcw, sub, dl, *rest)
 
     if mesh is None:
         return jax.jit(batched)
     from jax.sharding import PartitionSpec as P
     return jax.jit(jax.shard_map(
         batched, mesh=mesh,
-        in_specs=(P("models", None),) + (P("models"),) * 5 + (P(),) * 6,
+        in_specs=(P("models", None),) + (P("models"),) * 6 + (P(),) * 6,
         out_specs=(P("models", None, None), P("models", None, None),
                    P("models", None, None), P("models")),
         check_vma=False))
@@ -1048,7 +1073,7 @@ def _forest_eval_kernel(statics: tuple, spec: tuple, mesh=None):
     from ..evaluators.device_metrics import metric_fn
     mfn = metric_fn(*spec)
 
-    def one(ob, mask, mi, mg, sr, fi, Xv, yv, packed, feat_of,
+    def one(ob, mask, mi, mg, sr, dl, fi, Xv, yv, packed, feat_of,
             block_start, packed_thr, binned, col_thr, narrow, wide, y,
             key):
         feats, thrs, leaves = _forest_body(
@@ -1057,17 +1082,17 @@ def _forest_eval_kernel(statics: tuple, spec: tuple, mesh=None):
             depth=depth, num_classes=num_classes, num_trees=num_trees,
             max_features=max_features, pool_cfg=pool_cfg,
             impurity=impurity, bootstrap=bootstrap, hist_mode=hist_mode,
-            outer_batch=ob, budget_mb=budget_mb)
+            outer_batch=ob, budget_mb=budget_mb, depth_limit=dl)
         scores = _candidate_scores("forest", spec[0], depth, feats, thrs,
                                    leaves, 0.0, Xv[fi])
         return mfn(yv[fi], scores)
 
-    def batched(masks, mi, mg, sr, fi, Xv, yv, *rest):
+    def batched(masks, mi, mg, sr, dl, fi, Xv, yv, *rest):
         ob = masks.shape[0]
         return jax.vmap(functools.partial(one, ob),
-                        in_axes=(0, 0, 0, 0, 0, None, None)
+                        in_axes=(0, 0, 0, 0, 0, 0, None, None)
                         + (None,) * 10
-                        )(masks, mi, mg, sr, fi, Xv, yv, *rest)
+                        )(masks, mi, mg, sr, dl, fi, Xv, yv, *rest)
 
     if mesh is None:
         return jax.jit(batched)
@@ -1075,7 +1100,7 @@ def _forest_eval_kernel(statics: tuple, spec: tuple, mesh=None):
     return jax.jit(jax.shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P("models"),
-                  P("models"), P("models")) + (P(),) * 12,
+                  P("models"), P("models"), P("models")) + (P(),) * 12,
         out_specs=P("models"), check_vma=False))
 
 
@@ -1086,27 +1111,28 @@ def _gbt_eval_kernel(statics: tuple, spec: tuple, mesh=None):
     from ..evaluators.device_metrics import metric_fn
     mfn = metric_fn(*spec)
 
-    def one(mask, ss, rl, ga, mcw, sub, fi, Xv, yv, packed, feat_of,
+    def one(mask, ss, rl, ga, mcw, sub, dl, fi, Xv, yv, packed, feat_of,
             block_start, packed_thr, y, key):
         feats, thrs, leaves, base = _gbt_body(
             packed, feat_of, block_start, packed_thr, y, key, mask, ss,
             rl, ga, mcw, sub, depth=depth, num_rounds=num_rounds,
-            objective=objective, hist_mode=hist_mode)
+            objective=objective, hist_mode=hist_mode, depth_limit=dl)
         scores = _candidate_scores("gbt", spec[0], depth, feats, thrs,
                                    leaves, base, Xv[fi])
         return mfn(yv[fi], scores)
 
-    def batched(masks, ss, rl, ga, mcw, sub, fi, Xv, yv, *rest):
-        return jax.vmap(one, in_axes=(0,) * 7 + (None, None)
+    def batched(masks, ss, rl, ga, mcw, sub, dl, fi, Xv, yv, *rest):
+        return jax.vmap(one, in_axes=(0,) * 8 + (None, None)
                         + (None,) * 6
-                        )(masks, ss, rl, ga, mcw, sub, fi, Xv, yv, *rest)
+                        )(masks, ss, rl, ga, mcw, sub, dl, fi, Xv, yv,
+                          *rest)
 
     if mesh is None:
         return jax.jit(batched)
     from jax.sharding import PartitionSpec as P
     return jax.jit(jax.shard_map(
         batched, mesh=mesh,
-        in_specs=(P("models", None),) + (P("models"),) * 6 + (P(),) * 8,
+        in_specs=(P("models", None),) + (P("models"),) * 7 + (P(),) * 8,
         out_specs=P("models"), check_vma=False))
 
 
@@ -1204,6 +1230,12 @@ def _pad_rows(arrays, shards: int):
     mask = np.concatenate([np.ones(n), np.zeros(pad)])
     if not pad:
         return list(arrays), mask
+    # padding changes the global bootstrap-draw vector length, so a
+    # sharded fit is no longer bit-identical to the local fit (both
+    # remain valid draws) — surface it instead of silently diverging
+    _log.debug("_pad_rows: %d rows padded to %d for %d shards; sharded "
+               "bootstrap draws will differ from an unpadded local fit",
+               n, n + pad, shards)
     out = []
     for a in arrays:
         a = np.asarray(a)
@@ -1481,24 +1513,73 @@ _DESIGN_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _DESIGN_CACHE_SIZE = 8
 
 
-def _design_args(X: np.ndarray, max_bins: int):
+def _design_args(X: np.ndarray, max_bins: int,
+                 edge_rows: Optional[np.ndarray] = None):
     """Host-bin X and return ((packed, feat_of, block_start, packed_thr,
-    binned, col_thr) device arrays, widths host array)."""
-    key = (id(X), getattr(X, "shape", None), max_bins)
+    binned, col_thr) device arrays, widths host array). ``edge_rows``
+    restricts quantile-edge estimation (TX_TREE_EDGES=fold)."""
+    key = (id(X), getattr(X, "shape", None), max_bins,
+           None if edge_rows is None else id(edge_rows))
     hit = _DESIGN_CACHE.get(key)
-    if hit is not None and hit[0] is X:
+    if hit is not None and hit[0] is X and hit[1] is edge_rows:
         _DESIGN_CACHE.move_to_end(key)
-        return hit[1]
-    design = _PackedDesign(X, max_bins)
+        return hit[2]
+    design = _PackedDesign(X, max_bins, edge_rows=edge_rows)
     args = ((jnp.asarray(design.packed), jnp.asarray(design.feat_of),
              jnp.asarray(design.block_start),
              jnp.asarray(design.packed_thr),
              jnp.asarray(design.binned), jnp.asarray(design.col_thr)),
             design.widths)
-    _DESIGN_CACHE[key] = (X, args)
+    _DESIGN_CACHE[key] = (X, edge_rows, args)
     while len(_DESIGN_CACHE) > _DESIGN_CACHE_SIZE:
         _DESIGN_CACHE.popitem(last=False)
     return args
+
+
+def _fold_edges_mode() -> bool:
+    """Whether fold×grid searches compute bin edges from each fold's
+    train rows only (TX_TREE_EDGES=fold) instead of the whole prepared
+    matrix (default; standard histogram-GBM CV practice — the edges
+    carry feature-distribution information only, audited at scale in
+    BASELINE.md)."""
+    return os.environ.get("TX_TREE_EDGES", "matrix") == "fold"
+
+
+def _depth_mode() -> str:
+    """How the fold×grid search handles the max_depth sweep:
+
+    - "mask" (default on accelerators): ONE compiled program per tree
+      family at the grid's deepest depth; each candidate's depth is a
+      traced per-lane limit (_grow_tree depth_limit). Cuts tree-family
+      compile count ~3x (the depth axis of the default grids) at the
+      price of shallow lanes running the deep lane's masked levels —
+      the right trade where compile latency dominates (TPU cold start,
+      SURVEY §6 / VERDICT r4 #3).
+    - "static" (default on CPU): one program per distinct depth (lanes
+      do exactly their own work — CPU compiles are cheap and the
+      flagship search is compute-bound there).
+
+    TX_TREE_DEPTH overrides either way."""
+    mode = os.environ.get("TX_TREE_DEPTH")
+    if mode in ("mask", "static"):
+        return mode
+    return "mask" if jax.default_backend() != "cpu" else "static"
+
+
+#: (kernel kind, statics, call shape) triples seen — each is one XLA
+#: compile (the jit caches on shapes too, so factory-cache hits with new
+#: lane counts still compile)
+_COMPILE_KEYS: set = set()
+
+
+def _note_compile(kind: str, statics: tuple, shape: tuple) -> None:
+    _COMPILE_KEYS.add((kind, statics, shape))
+
+
+def tree_kernel_compiles() -> int:
+    """Distinct compiled fold×grid tree programs so far in this process
+    (the compile-count diagnostic bench.py reports)."""
+    return len(_COMPILE_KEYS)
 
 
 def _pool_size(d: int, mf: Optional[int]) -> Optional[int]:
@@ -1534,8 +1615,46 @@ _GBT_TRACED = ("step_size", "reg_lambda", "gamma", "min_child_weight",
 _GBT_STATIC = ("max_depth", "num_rounds", "max_bins", "seed", "num_round")
 
 
+def _trim_tree_arrays(feats, thrs, leaves, depth_cap: int, depth: int):
+    """Slice a depth_cap-shaped (heap, leaves) candidate back to its own
+    ``depth`` (TX_TREE_DEPTH=mask materialization): levels >= depth hold
+    only (0, +inf) denied splits, and a truncated node ``l``'s rows all
+    sit in its leftmost descendant leaf ``l << (cap - depth)`` — so the
+    heap prefix plus a strided leaf gather reproduce the static-depth
+    model bit-exactly (up to 512x less host memory for a depth-3 lane
+    in a depth-12 group)."""
+    if depth == depth_cap:
+        return feats, thrs, leaves
+    h = 2 ** depth - 1
+    return (feats[:, :h], thrs[:, :h],
+            leaves[:, ::2 ** (depth_cap - depth)])
+
+
+def _fold_edge_recurse(fold_grid_fn, est, X, y, masks, grid, mesh,
+                       eval_ctx, **kw):
+    """TX_TREE_EDGES=fold driver: one recursive single-fold call per
+    fold, each binning with edges from THAT fold's train rows only.
+    Returns the same (F, G) matrix / per-fold model lists the fold-major
+    call would. Costs one extra compile per static group (single-fold
+    candidate shape) but removes the only place validation rows could
+    influence training (quantile edges)."""
+    F = masks.shape[0]
+    outs = []
+    for f in range(F):
+        rows = np.nonzero(masks[f] > 0)[0]
+        sub_eval = None
+        if eval_ctx is not None:
+            sub_eval = (eval_ctx[0][f:f + 1], eval_ctx[1][f:f + 1],
+                        eval_ctx[2])
+        outs.append(fold_grid_fn(est, X, y, masks[f:f + 1], grid, mesh,
+                                 eval_ctx=sub_eval, edge_rows=rows, **kw))
+    if eval_ctx is not None:
+        return np.concatenate(outs, axis=0)
+    return [o[0] for o in outs]
+
+
 def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
-                      eval_ctx=None):
+                      eval_ctx=None, edge_rows=None):
     """All (fold, grid point) forest candidates in vmapped programs (one
     per static shape group), optionally sharded over a mesh ``models``
     axis — see the kernel docstrings for the bin-edge deviation.
@@ -1543,6 +1662,11 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
     With ``eval_ctx = (X_val (F,nv,d), y_val (F,nv), spec)`` the fused
     fit+metric kernels run instead and the return value is the (F, G)
     validation-metric matrix — fitted trees never reach the host."""
+    masks = np.asarray(masks, dtype=np.float64)
+    if edge_rows is None and _fold_edges_mode():
+        return _fold_edge_recurse(
+            _forest_fold_grid, est, X, y, masks, grid, mesh, eval_ctx,
+            classification=classification)
     grid = [dict(p) for p in (list(grid) or [{}])]
     allowed = set(_FOREST_TRACED) | set(_FOREST_STATIC)
     for p in grid:
@@ -1550,7 +1674,6 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
         if extra:
             raise NotImplementedError(
                 f"batched tree kernel cannot vary {sorted(extra)}")
-    masks = np.asarray(masks, dtype=np.float64)
     F, n = masks.shape
     G = len(grid)
     d = X.shape[1]
@@ -1562,16 +1685,19 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
         Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
         yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
         spec = eval_ctx[2]
+    mask_depth = _depth_mode() == "mask"
     groups: Dict[tuple, list] = {}
     for gi, p in enumerate(grid):
         cand = est.with_params(**p)
-        skey = (cand.max_depth, cand.num_trees, cand.max_bins,
-                getattr(cand, "impurity", ""),
+        skey = (None if mask_depth else cand.max_depth, cand.num_trees,
+                cand.max_bins, getattr(cand, "impurity", ""),
                 cand.feature_subset_strategy, cand.seed)
         groups.setdefault(skey, []).append((gi, cand))
     for members in groups.values():
         cand0 = members[0][1]
-        design, widths = _design_args(X, cand0.max_bins)
+        depth_cap = max(c.max_depth for _, c in members)
+        design, widths = _design_args(X, cand0.max_bins,
+                                      edge_rows=edge_rows)
         mf = _resolve_max_features(cand0.feature_subset_strategy, d,
                                    classification) \
             if cand0.bootstrap else None
@@ -1581,24 +1707,26 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
                       for _, c in members], F)
         mg = np.tile([float(c.min_info_gain) for _, c in members], F)
         sr = np.tile([float(c.subsampling_rate) for _, c in members], F)
+        dl = np.tile([float(c.max_depth) for _, c in members], F)
         masks_c = np.repeat(masks, gk, axis=0)   # fold-major candidates
         fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
-        (masks_p, mi, mg, sr), count = _pad_candidates(
-            mesh, [masks_c, mi, mg, sr], n)
+        (masks_p, mi, mg, sr, dl), count = _pad_candidates(
+            mesh, [masks_c, mi, mg, sr, dl], n)
         fidx = np.concatenate(
             [fidx, np.zeros(len(mi) - count, dtype=np.int32)])
-        statics = ("cls" if classification else "reg", cand0.max_depth,
+        statics = ("cls" if classification else "reg", depth_cap,
                    k if classification else 0, cand0.num_trees, mf,
                    pool_cfg, getattr(cand0, "impurity", ""),
                    cand0.bootstrap,
                    _hist_mode(n, int(design[1].shape[0])),
                    _tree_budget_mb())
+        _note_compile("forest", statics, masks_p.shape)
         if eval_ctx is not None:
             fn = _forest_eval_kernel(statics, spec, mesh)
             mm = to_host(fn(
                 jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
-                jnp.asarray(sr), jnp.asarray(fidx), Xv_j, yv_j, *design,
-                narrow, wide, y_j,
+                jnp.asarray(sr), jnp.asarray(dl), jnp.asarray(fidx),
+                Xv_j, yv_j, *design, narrow, wide, y_j,
                 jax.random.PRNGKey(cand0.seed)))[:count]
             for f in range(F):
                 for j, (gi, _) in enumerate(members):
@@ -1607,8 +1735,8 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
         fn = _forest_fg_kernel(statics, mesh)
         feats, thrs, leaves = fn(
             jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
-            jnp.asarray(sr), *design, narrow, wide, y_j,
-            jax.random.PRNGKey(cand0.seed))
+            jnp.asarray(sr), jnp.asarray(dl), *design, narrow, wide,
+            y_j, jax.random.PRNGKey(cand0.seed))
         feats = to_host(feats)[:count]
         thrs = to_host(thrs)[:count]
         leaves = to_host(leaves)[:count]
@@ -1617,17 +1745,24 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
         for f in range(F):
             for j, (gi, cand) in enumerate(members):
                 c = f * gk + j
+                fe, th, le = _trim_tree_arrays(
+                    feats[c], thrs[c], leaves[c], depth_cap,
+                    cand.max_depth)
                 models[f][gi] = model_cls(
-                    feats[c], thrs[c], leaves[c],
-                    depth=cand0.max_depth, n_features=d)
+                    fe, th, le, depth=cand.max_depth, n_features=d)
     return metric_mat if eval_ctx is not None else models
 
 
 def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str,
-                   eval_ctx=None):
+                   eval_ctx=None, edge_rows=None):
     # mirrors _forest_fold_grid's candidate contract (fold-major
-    # flattening, static-group partitioning, padding, eval_ctx fusion)
-    # — change both together
+    # flattening, static-group partitioning, padding, eval_ctx fusion,
+    # TX_TREE_EDGES=fold recursion) — change both together
+    masks = np.asarray(masks, dtype=np.float64)
+    if edge_rows is None and _fold_edges_mode():
+        return _fold_edge_recurse(
+            _gbt_fold_grid, est, X, y, masks, grid, mesh, eval_ctx,
+            objective=objective)
     grid = [dict(p) for p in (list(grid) or [{}])]
     allowed = set(_GBT_TRACED) | set(_GBT_STATIC)
     for p in grid:
@@ -1635,7 +1770,6 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str,
         if extra:
             raise NotImplementedError(
                 f"batched GBT kernel cannot vary {sorted(extra)}")
-    masks = np.asarray(masks, dtype=np.float64)
     F, n = masks.shape
     G = len(grid)
     d = X.shape[1]
@@ -1646,36 +1780,43 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str,
         Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
         yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
         spec = eval_ctx[2]
+    mask_depth = _depth_mode() == "mask"
     groups: Dict[tuple, list] = {}
     for gi, p in enumerate(grid):
         cand = est.with_params(**p)
-        skey = (cand.max_depth, cand.num_rounds, cand.max_bins, cand.seed)
+        skey = (None if mask_depth else cand.max_depth,
+                cand.num_rounds, cand.max_bins, cand.seed)
         groups.setdefault(skey, []).append((gi, cand))
     model_cls = (GBTClassifierModel if objective == "logistic"
                  else GBTRegressorModel)
     for members in groups.values():
         cand0 = members[0][1]
-        design, _ = _design_args(X, cand0.max_bins)
+        depth_cap = max(c.max_depth for _, c in members)
+        design, _ = _design_args(X, cand0.max_bins,
+                                 edge_rows=edge_rows)
         gk = len(members)
         ss = np.tile([float(c.step_size) for _, c in members], F)
         rl = np.tile([float(c.reg_lambda) for _, c in members], F)
         ga = np.tile([float(c.gamma) for _, c in members], F)
         mcw = np.tile([float(c.min_child_weight) for _, c in members], F)
         sub = np.tile([float(c.subsample) for _, c in members], F)
+        dl = np.tile([float(c.max_depth) for _, c in members], F)
         masks_c = np.repeat(masks, gk, axis=0)
         fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
-        (masks_p, ss, rl, ga, mcw, sub), count = _pad_candidates(
-            mesh, [masks_c, ss, rl, ga, mcw, sub], n)
+        (masks_p, ss, rl, ga, mcw, sub, dl), count = _pad_candidates(
+            mesh, [masks_c, ss, rl, ga, mcw, sub, dl], n)
         fidx = np.concatenate(
             [fidx, np.zeros(len(ss) - count, dtype=np.int32)])
-        statics = (cand0.max_depth, cand0.num_rounds, objective,
+        statics = (depth_cap, cand0.num_rounds, objective,
                    _hist_mode(n, int(design[1].shape[0])))
+        _note_compile("gbt", statics, masks_p.shape)
         if eval_ctx is not None:
             fn = _gbt_eval_kernel(statics, spec, mesh)
             mm = to_host(fn(
                 jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
                 jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
-                jnp.asarray(fidx), Xv_j, yv_j, *design[:4], y_j,
+                jnp.asarray(dl), jnp.asarray(fidx), Xv_j, yv_j,
+                *design[:4], y_j,
                 jax.random.PRNGKey(cand0.seed)))[:count]
             for f in range(F):
                 for j, (gi, _) in enumerate(members):
@@ -1685,7 +1826,8 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str,
         feats, thrs, leaves, base = fn(
             jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
             jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
-            *design[:4], y_j, jax.random.PRNGKey(cand0.seed))
+            jnp.asarray(dl), *design[:4], y_j,
+            jax.random.PRNGKey(cand0.seed))
         feats = to_host(feats)[:count]
         thrs = to_host(thrs)[:count]
         leaves = to_host(leaves)[:count]
@@ -1693,8 +1835,11 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str,
         for f in range(F):
             for j, (gi, cand) in enumerate(members):
                 c = f * gk + j
+                fe, th, le = _trim_tree_arrays(
+                    feats[c], thrs[c], leaves[c], depth_cap,
+                    cand.max_depth)
                 models[f][gi] = model_cls(
-                    feats[c], thrs[c], leaves[c], depth=cand0.max_depth,
+                    fe, th, le, depth=cand.max_depth,
                     base=float(base[c]), n_features=d)
     return metric_mat if eval_ctx is not None else models
 

@@ -133,6 +133,14 @@ class StreamingReader:
                     if sig is None:
                         continue
                     prev = pending.get(p)
+                    if prev is None:
+                        # a NEW file resets the idle clock so a complete
+                        # file landing just inside the window still gets
+                        # its one stabilization interval; subsequent
+                        # (size, mtime) churn does NOT reset it, so a
+                        # perpetually-growing file cannot hold the
+                        # stream open past the timeout
+                        last_new = now
                     if prev is None or prev[0] != sig:
                         # first sighting or still growing: the
                         # (size, mtime) must hold for a full poll
@@ -159,8 +167,18 @@ class StreamingReader:
                         continue
                     yield batch
                 if not delivered:
-                    if idle_timeout_s is not None and not pending and \
+                    # timeout is measured from the last DELIVERY only: a
+                    # file that keeps growing (or is touched forever)
+                    # stays pending but must not hold the stream open
+                    # past the idle window
+                    if idle_timeout_s is not None and \
                             _time.monotonic() - last_new > idle_timeout_s:
+                        if pending:
+                            log.warning(
+                                "tail_directory: idle timeout with %d "
+                                "never-stabilizing file(s) undelivered: "
+                                "%s", len(pending),
+                                sorted(pending)[:5])
                         return
                     _time.sleep(poll_interval_s)
         return StreamingReader(gen)

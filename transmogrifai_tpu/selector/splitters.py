@@ -32,6 +32,15 @@ def stratified_split(y: np.ndarray, test_fraction: float,
     return np.nonzero(~mask)[0], np.nonzero(mask)[0]
 
 
+def _sample_frac(idx: np.ndarray, fraction: float,
+                 rng: np.random.Generator) -> np.ndarray:
+    """Without-replacement sample of ``round(fraction * len)`` rows —
+    the numpy stand-in for Spark ``Dataset.sample(false, fraction)``."""
+    if fraction >= 1.0 or not len(idx):
+        return idx
+    return rng.choice(idx, int(round(fraction * len(idx))), replace=False)
+
+
 @dataclass
 class SplitterSummary:
     """Data-prep record attached to ModelSelectorSummary
@@ -91,8 +100,15 @@ class DataSplitter(Splitter):
 
 class DataBalancer(Splitter):
     """Binary-label balancer: up-sample the minority / down-sample the
-    majority until the positive fraction reaches ``sample_fraction``
-    (reference DataBalancer.scala:72,125)."""
+    majority until the minority fraction reaches ``sample_fraction``
+    (reference DataBalancer.scala:72,125).
+
+    Sampling proportions are a reusable *plan* (reference param state,
+    DataBalancer.scala:132-137 ``isSet`` guards): :meth:`estimate`
+    computes them once from global label counts and every subsequent
+    :meth:`prepare` — including the per-fold calls inside the
+    workflow-level-CV search (OpValidator.scala:250-252) — applies the
+    same plan, so fold resampling matches the final-refit resampling."""
 
     def __init__(self, sample_fraction: float = 0.1,
                  max_training_sample: int = 1_000_000,
@@ -102,42 +118,87 @@ class DataBalancer(Splitter):
             raise ValueError("sample_fraction must be in (0, 0.5)")
         self.sample_fraction = sample_fraction
         self.max_training_sample = max_training_sample
+        #: (is_positive_small, down, up, already_balanced_fraction) —
+        #: set by estimate(); None until then
+        self._plan: Optional[Tuple[bool, float, float,
+                                   Optional[float]]] = None
 
-    def prepare(self, y: np.ndarray) -> np.ndarray:
-        rng = np.random.default_rng(self.seed)
-        pos_idx = np.nonzero(y == 1)[0]
-        neg_idx = np.nonzero(y != 1)[0]
-        n_pos, n_neg = len(pos_idx), len(neg_idx)
-        small, big = ((pos_idx, neg_idx) if n_pos <= n_neg
-                      else (neg_idx, pos_idx))
-        frac = len(small) / max(len(y), 1)
-        already_balanced = frac >= self.sample_fraction
-        if already_balanced:
-            idx = np.arange(len(y))
-            if len(idx) > self.max_training_sample:
-                idx = rng.choice(idx, self.max_training_sample,
-                                 replace=False)
-            self.summary = SplitterSummary(
-                splitter="DataBalancer",
-                parameters=self.get_params(),
-                results={"positiveCount": n_pos, "negativeCount": n_neg,
-                         "balanced": False})
-            return np.sort(idx)
-        # down-sample the majority class so the minority reaches the
-        # target fraction (reference keeps all minority rows)
-        target_big = int(len(small) * (1.0 - self.sample_fraction)
-                         / self.sample_fraction)
-        big_sampled = rng.choice(big, min(target_big, len(big)),
-                                 replace=False)
-        idx = np.concatenate([small, big_sampled])
-        if len(idx) > self.max_training_sample:
-            idx = rng.choice(idx, self.max_training_sample, replace=False)
+    def _proportions(self, small: int, big: int
+                     ) -> Tuple[float, float]:
+        """(downSample, upSample) fractions
+        (reference getProportions, DataBalancer.scala:86-117): prefer
+        integer up-sampling of the minority, capped so the balanced set
+        stays under max_training_sample; otherwise down-sample both."""
+        f = self.sample_fraction
+        max_train = self.max_training_sample
+
+        def up_ok(m: int) -> bool:
+            return (m * small * (1.0 - f) < f * big
+                    and max_train * f > small * m)
+
+        if small < max_train * f:
+            up = next((float(m) for m in (100, 50, 10, 5, 4, 3, 2)
+                       if up_ok(m)), 1.0)
+            return (small * up / f - small * up) / big, up
+        up = (max_train * f) / small
+        return (1.0 - f) * max_train / big, up
+
+    def estimate(self, y: np.ndarray) -> None:
+        """Compute and store the sampling plan from label counts
+        (reference estimate, DataBalancer.scala:319-358). Called once on
+        the full training labels before per-fold prepares."""
+        n_pos = int(np.sum(y == 1))
+        n_neg = int(len(y) - n_pos)
+        total = max(n_pos + n_neg, 1)
+        is_pos_small = n_pos < n_neg
+        small, big = ((n_pos, n_neg) if is_pos_small else (n_neg, n_pos))
+        if big == 0 or small / total >= self.sample_fraction:
+            frac = (self.max_training_sample / total
+                    if self.max_training_sample < total else 1.0)
+            self._plan = (is_pos_small, frac, 0.0, frac)
+            up, down = 0.0, frac
+        else:
+            down, up = self._proportions(small, big)
+            self._plan = (is_pos_small, down, up, None)
         self.summary = SplitterSummary(
             splitter="DataBalancer", parameters=self.get_params(),
             results={"positiveCount": n_pos, "negativeCount": n_neg,
-                     "balanced": True,
-                     "downSampleFraction": len(big_sampled) / max(len(big), 1)})
-        return np.sort(idx)
+                     "desiredFraction": self.sample_fraction,
+                     "upSamplingFraction": up,
+                     "downSamplingFraction": down,
+                     "balanced": self._plan[3] is None})
+
+    def prepare(self, y: np.ndarray) -> np.ndarray:
+        """Resampled row indices. Uses the stored plan when
+        :meth:`estimate` already ran (reference ``isSet`` guard,
+        DataBalancer.scala:132); estimates from ``y`` otherwise. The
+        returned indices may repeat (minority up-sampling is WITH
+        replacement, reference rebalance, DataBalancer.scala:263-268)."""
+        if self._plan is None:
+            self.estimate(y)
+        is_pos_small, down, up, already_frac = self._plan
+        rng = np.random.default_rng(self.seed)
+        pos_idx = np.nonzero(y == 1)[0]
+        neg_idx = np.nonzero(y != 1)[0]
+        if already_frac is not None:
+            # per-class subsample (reference sampleBalancedData)
+            if already_frac >= 1.0:
+                return np.arange(len(y))
+            return np.sort(np.concatenate([
+                _sample_frac(neg_idx, already_frac, rng),
+                _sample_frac(pos_idx, already_frac, rng)]))
+        small, big = ((pos_idx, neg_idx) if is_pos_small
+                      else (neg_idx, pos_idx))
+        big_take = _sample_frac(big, min(down, 1.0), rng)
+        if up > 1.0:
+            small_take = rng.choice(
+                small, int(round(up * len(small))), replace=True) \
+                if len(small) else small
+        elif up == 1.0:
+            small_take = small
+        else:
+            small_take = _sample_frac(small, up, rng)
+        return np.sort(np.concatenate([small_take, big_take]))
 
     def get_params(self) -> Dict:
         p = super().get_params()
@@ -160,7 +221,10 @@ class DataCutter(Splitter):
         self.max_label_categories = max_label_categories
         self.labels_kept: Optional[np.ndarray] = None
 
-    def prepare(self, y: np.ndarray) -> np.ndarray:
+    def estimate(self, y: np.ndarray) -> None:
+        """Decide which labels survive, from global label counts
+        (reference estimate, DataCutter.scala:85 — called once via
+        prepareStratification before per-fold prepares)."""
         labels, counts = np.unique(y, return_counts=True)
         frac = counts / max(len(y), 1)
         keep = labels[frac >= self.min_label_fraction]
@@ -180,4 +244,11 @@ class DataCutter(Splitter):
                         "max_label_categories": self.max_label_categories},
             results={"labelsKept": self.labels_kept.tolist(),
                      "labelsDropped": dropped})
+
+    def prepare(self, y: np.ndarray) -> np.ndarray:
+        """Row indices of surviving labels. Reuses the labels picked by
+        a prior :meth:`estimate` so per-fold cuts agree with the final
+        refit cut; estimates from ``y`` when none ran."""
+        if self.labels_kept is None:
+            self.estimate(y)
         return np.nonzero(np.isin(y, self.labels_kept))[0]

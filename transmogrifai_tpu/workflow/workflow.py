@@ -419,10 +419,14 @@ class Workflow:
         on full data) so the final pass reuses instead of refitting
         them; the in-CV segment itself IS refit on full data there.
 
-        Documented deviation: the selector's splitter (balancer/cutter)
-        resampling applies only to the final full refit, not inside the
-        per-fold search — fold stratification covers class balance
-        during the search."""
+        The selector's splitter participates in the search exactly as in
+        the reference: the holdout is reserved BEFORE folding
+        (OpWorkflow.scala:372-376), the balancer/cutter plan is
+        estimated once from the search labels
+        (OpValidator.prepareStratification:203-226), and each fold's
+        train AND validation rows are resampled with that plan after
+        the in-CV DAG refit (OpValidator.applyDAG:250-252) — candidate
+        ranking happens on balanced data, not just stratified folds."""
         selector, during = cut_dag(result_features)
         if selector is None or not during:
             return None  # nothing label-consuming feeds the selector
@@ -442,9 +446,21 @@ class Workflow:
                 "workflow-level CV skipped: label %r is produced inside "
                 "the in-CV DAG segment", label_f.name)
             return prefitted
-        # 2. per fold: refit the in-CV segment on the fold's train rows,
-        #    transform its validation rows with those fitted stages
+        # 2. reserve the holdout BEFORE folding so the search never sees
+        #    it; splitter.split is deterministic in (y, seed), so the
+        #    selector's own final-fit reservation picks the same rows
         y_pre = np.asarray(pre[label_f.name].data, dtype=np.float64)
+        splitter = selector.splitter
+        if splitter is not None:
+            tr_idx, te_idx = splitter.split(y_pre)
+            if len(te_idx):
+                pre, y_pre = pre.take(tr_idx), y_pre[tr_idx]
+            est = getattr(splitter, "estimate", None)
+            if est is not None:   # one global resampling plan
+                est(y_pre)
+        # 3. per fold: refit the in-CV segment on the fold's train rows,
+        #    transform its validation rows with those fitted stages,
+        #    then apply the splitter's resampling plan to both
         validator = selector.validator
         folds = []
         for train_idx, val_idx in validator._splits(y_pre):
@@ -452,11 +468,17 @@ class Workflow:
                 during, pre.take(train_idx), fit=True)
             val_ds = _transform_with_fitted(during, fitted_cv,
                                             pre.take(val_idx))
-            folds.append((
+            fold = [
                 np.asarray(tr_ds[features_f.name].data, dtype=np.float64),
                 np.asarray(tr_ds[label_f.name].data, dtype=np.float64),
                 np.asarray(val_ds[features_f.name].data, dtype=np.float64),
-                np.asarray(val_ds[label_f.name].data, dtype=np.float64)))
+                np.asarray(val_ds[label_f.name].data, dtype=np.float64)]
+            if splitter is not None:
+                ridx = splitter.prepare(fold[1])
+                vidx = splitter.prepare(fold[3])
+                fold = [fold[0][ridx], fold[1][ridx],
+                        fold[2][vidx], fold[3][vidx]]
+            folds.append(tuple(fold))
         selector.best_estimator = validator.validate_prepared(
             selector.models, folds)
         return prefitted
