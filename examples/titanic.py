@@ -147,12 +147,13 @@ def default_selector(num_folds: int = 3, seed: int = 42):
 
 
 def run(csv_path: str = None, model_stage=None, verbose: bool = True,
-        workflow_cv: bool = False):
+        workflow_cv: bool = False, listener=None):
     """Train on a 75% split, evaluate on the 25% holdout.
 
     ``workflow_cv=True`` enables leakage-free workflow-level CV (every
     label-consuming selector ancestor refit per fold; reference
-    withWorkflowCV). Returns (metrics, wall_clock_seconds, model).
+    withWorkflowCV). ``listener`` (a WorkflowListener) collects the
+    per-stage profile. Returns (metrics, wall_clock_seconds, model).
     """
     records = load_titanic(csv_path)
     train, test = stratified_split(records)
@@ -166,6 +167,8 @@ def run(csv_path: str = None, model_stage=None, verbose: bool = True,
           .set_input_records(train))
     if workflow_cv:
         wf = wf.with_workflow_cv()
+    if listener is not None:
+        wf = wf.with_listener(listener)
     model = wf.train()
     evaluator = BinaryClassificationEvaluator(
         label_col="survived", prediction_col=prediction.name)

@@ -60,6 +60,31 @@ class TestGenerateProject:
         assert r.returncode == 0, r.stderr[-2000:]
         assert "Selected model" in r.stdout
 
+    def test_generated_project_own_test_passes(self, tmp_path):
+        """The scaffold ships its own test + config (reference
+        templates/simple shape) and that test passes under pytest."""
+        p = tmp_path / "d.csv"
+        rng = __import__("numpy").random.default_rng(0)
+        rows = "\n".join(
+            f"{i},{x:.3f},{'AB'[i % 2]},{int(x > 0)}"
+            for i, x in enumerate(rng.normal(size=60)))
+        p.write_text("id,x,grp,won\n" + rows)
+        out = str(tmp_path / "proj")
+        generate_project(str(p), response="won", output=out,
+                         id_field="id")
+        for f in ("test_main.py", "pyproject.toml"):
+            assert os.path.exists(os.path.join(out, f)), f
+        env = dict(os.environ,
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))),
+                   JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+             "test_main.py"],
+            cwd=out, capture_output=True, text=True, timeout=600, env=env)
+        assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+        assert "2 passed" in r.stdout
+
 
 class TestCliAvroAndKind:
     def test_gen_from_avro_with_avsc_and_kind(self, tmp_path):

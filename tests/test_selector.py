@@ -436,3 +436,32 @@ class TestBatchedEvaluation:
         assert best.name == "LogisticRegression"
         nb = [r for r in best.results if r.model_name == "NaiveBayes"]
         assert nb and all(np.isnan(v) for v in nb[0].metric_values)
+
+    def test_reused_selector_reestimates_plan(self):
+        """A reused selector must not recycle a resampling plan
+        estimated on an earlier dataset (the fit entry calls
+        splitter.reset_plan; reference re-instantiates selectors)."""
+        from transmogrifai_tpu.evaluators import \
+            BinaryClassificationEvaluator
+        from transmogrifai_tpu.models import LogisticRegression
+        from transmogrifai_tpu.selector.selector import ModelSelector
+        from transmogrifai_tpu.selector.validator import CrossValidation
+        rng = np.random.default_rng(0)
+        sel = ModelSelector(
+            models=[(LogisticRegression(max_iter=10), [{}])],
+            validator=CrossValidation(BinaryClassificationEvaluator(),
+                                      num_folds=2, stratify=True),
+            splitter=DataBalancer(sample_fraction=0.25))
+        # fit 1: 10:1 imbalanced -> plan balances
+        X1 = rng.normal(size=(440, 3))
+        y1 = (rng.random(440) < 0.09).astype(float)
+        X1[:, 0] += 2 * y1
+        sel.fit_arrays(X1, y1)
+        assert sel.splitter.summary.results["balanced"] is True
+        # fit 2 on ALREADY balanced data: the stale up/down plan must
+        # NOT apply — estimate runs fresh and no-ops
+        X2 = rng.normal(size=(200, 3))
+        y2 = (np.arange(200) % 2).astype(float)
+        X2[:, 0] += 2 * y2
+        sel.fit_arrays(X2, y2)
+        assert sel.splitter.summary.results["balanced"] is False

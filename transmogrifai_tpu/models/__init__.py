@@ -7,6 +7,7 @@ from .linear import (LinearRegression, LinearRegressionModel, LinearSVC,
                      LinearSVCModel, LogisticRegression,
                      LogisticRegressionModel)
 from .bayes import NaiveBayes, NaiveBayesModel
+from .external import ExternalEstimator, ExternalModel, wrap_estimator
 from .glm import (GeneralizedLinearRegression,
                   GeneralizedLinearRegressionModel)
 from .isotonic import (IsotonicRegressionCalibrator,
@@ -37,6 +38,7 @@ __all__ = [
     "GBTMulticlassClassifierModel",
     "TreeEnsembleClassifierModel", "TreeEnsembleRegressorModel",
     "NaiveBayes", "NaiveBayesModel",
+    "ExternalEstimator", "ExternalModel", "wrap_estimator",
     "GeneralizedLinearRegression", "GeneralizedLinearRegressionModel",
     "MultilayerPerceptronClassifier", "MultilayerPerceptronClassifierModel",
 ]

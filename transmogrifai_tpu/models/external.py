@@ -1,0 +1,128 @@
+"""External-estimator adapter: plug ANY host estimator into the DAG.
+
+The reference's generic Spark-wrapper layer lets arbitrary third-party
+``Transformer``/``Estimator`` objects ride the pipeline as typed,
+persistable stages (features/src/main/scala/com/salesforce/op/stages/
+sparkwrappers/generic/{SparkWrapperParams.scala:43, SwUnaryTransformer,
+SwBinaryEstimator}). This module is that bridge for the TPU-native
+stack: :func:`wrap_estimator` turns a pair of plain functions — or any
+object with the fit/predict duck type — into a :class:`Predictor` that
+works with the ModelSelector (grids via ``with_params``), the workflow
+DAG, and model save/load.
+
+Persistence contract: the fitted *state* must be a dict of numpy arrays
+and JSON-able scalars (exactly what ``persistence.encode_value``
+round-trips), and the fit/predict functions must be importable
+(``module:qualname``) — the same rule the rest of the framework applies
+to lambdas. No pickle anywhere.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..features.columns import PredictionColumn
+from .base import PredictionModel, Predictor
+
+__all__ = ["ExternalEstimator", "ExternalModel", "wrap_estimator"]
+
+
+class ExternalModel(PredictionModel):
+    """Fitted external model: ``predict_fn(state, X)`` drives scoring.
+
+    ``kind``:
+    - "classification": predict_fn returns (n, k) class probabilities
+      (rows need not be normalized; they are clipped + renormalized);
+    - "regression": predict_fn returns (n,) values.
+    """
+
+    def __init__(self, state: Dict = None, predict_fn: Callable = None,
+                 kind: str = "classification",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name="externalModel", uid=uid)
+        self.state = dict(state or {})
+        self.predict_fn = predict_fn
+        self.kind = kind
+
+    def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
+        if self.predict_fn is None:
+            raise ValueError(
+                "ExternalModel has no predict_fn (was it importable at "
+                "save time? see workflow/persistence.py encode_value)")
+        out = np.asarray(self.predict_fn(self.state, np.asarray(X)),
+                         dtype=np.float64)
+        if self.kind == "regression":
+            return PredictionColumn.from_arrays(out.reshape(-1))
+        if out.ndim != 2:
+            raise ValueError(
+                f"classification predict_fn must return (n, k) "
+                f"probabilities; got shape {out.shape}")
+        prob = np.clip(out, 0.0, None)
+        prob = prob / np.maximum(prob.sum(axis=1, keepdims=True), 1e-12)
+        pred = np.argmax(prob, axis=1).astype(np.float64)
+        # raw = log-probabilities (monotone in prob, finite)
+        raw = np.log(np.maximum(prob, 1e-12))
+        return PredictionColumn.from_arrays(pred, probability=prob,
+                                            raw_prediction=raw)
+
+
+class ExternalEstimator(Predictor):
+    """See module docstring. ``params`` are the hyperparameters handed
+    to ``fit_fn`` — the selector's grid points override them via
+    ``with_params`` (merged, not replaced), so an external family
+    competes in the model race exactly like a native one."""
+
+    def __init__(self, fit_fn: Callable = None,
+                 predict_fn: Callable = None,
+                 kind: str = "classification",
+                 params: Dict = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        if kind not in ("classification", "regression"):
+            raise ValueError(f"kind must be classification|regression, "
+                             f"got {kind!r}")
+        self.fit_fn = fit_fn
+        self.predict_fn = predict_fn
+        self.kind = kind
+        self.params = dict(params or {})
+
+    def with_params(self, **params) -> "ExternalEstimator":
+        merged = dict(self.params)
+        merged.update(params)
+        return type(self)(fit_fn=self.fit_fn, predict_fn=self.predict_fn,
+                          kind=self.kind, params=merged)
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> ExternalModel:
+        if self.fit_fn is None:
+            raise ValueError("ExternalEstimator requires fit_fn")
+        state = self.fit_fn(np.asarray(X), np.asarray(y), **self.params)
+        if not isinstance(state, dict):
+            raise ValueError(
+                f"external fit_fn must return a dict state (got "
+                f"{type(state).__name__}) — arrays + JSON-able scalars, "
+                f"the persistable contract")
+        return ExternalModel(state=state, predict_fn=self.predict_fn,
+                             kind=self.kind)
+
+
+def wrap_estimator(fit_fn: Callable, predict_fn: Callable,
+                   kind: str = "classification",
+                   **params) -> ExternalEstimator:
+    """Wrap ``fit_fn(X, y, **params) -> state`` and
+    ``predict_fn(state, X) -> scores`` into a typed, persistable
+    Predictor stage (the SwUnaryTransformer role).
+
+    >>> est = wrap_estimator(my_fit, my_predict, kind="regression",
+    ...                      alpha=0.1)
+    >>> pred = est.set_input(label, features).get_output()
+
+    Duck-typed objects adapt in one line each::
+
+        wrap_estimator(lambda X, y, **p: {"est": ...},  # NOT persistable
+                       ...)
+
+    — but note the persistence rule: only *importable* functions and
+    dict-of-array states survive save/load."""
+    return ExternalEstimator(fit_fn=fit_fn, predict_fn=predict_fn,
+                             kind=kind, params=params)

@@ -215,14 +215,20 @@ def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
     on accelerators (XLA scatters serialize there); scatter on CPU —
     r4 re-measured the flagship search ~10% faster under scatter even
     at small n*TB, retiring r3's small-problem matmul threshold (the
-    fused eval kernels changed the balance). TX_TREE_HIST overrides.
-    Decided at trace time (platform only for now — the n/total_bins
-    parameters stay in the signature so a size-based policy can return
-    without touching every call site), so all modes stay available
-    side by side."""
-    import os
+    fused eval kernels changed the balance). "matmul_bf16" is the
+    MXU-native variant: both one-hot indicators AND the per-row stats
+    cast to bfloat16, contraction accumulates in float32
+    (preferred_element_type) — 0/1 indicators are exact in bf16, so the
+    only approximation is ~3-decimal-digit rounding of individual
+    grad/hess/count contributions before the fp32 accumulation; split
+    decisions can flip on near-ties, which is why it is opt-in rather
+    than the TPU default until measured (VERDICT r4 #2).
+    TX_TREE_HIST overrides. Decided at trace time (platform only for
+    now — the n/total_bins parameters stay in the signature so a
+    size-based policy can return without touching every call site), so
+    all modes stay available side by side."""
     mode = os.environ.get("TX_TREE_HIST")
-    if mode in ("scatter", "matmul", "pallas"):
+    if mode in ("scatter", "matmul", "pallas", "matmul_bf16"):
         return mode
     try:
         platform = jax.default_backend()
@@ -269,6 +275,16 @@ def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
             from transmogrifai_tpu.models.pallas_hist import (
                 pallas_level_hist)
             hist = pallas_level_hist(bin_oh, slot, stats, num_slots)
+        elif mode == "matmul_bf16":
+            # MXU-native: bf16 operands, fp32 accumulation. bin_oh is
+            # already bf16 (built once per tree); the per-row stats
+            # round to bf16 here — the one approximation of this mode
+            # (see _hist_mode docstring).
+            slot_oh = jax.nn.one_hot(slot, num_slots, dtype=jnp.bfloat16)
+            hist = jnp.einsum(
+                "nc,ns,nb->cbs", slot_oh, stats.astype(jnp.bfloat16),
+                bin_oh, preferred_element_type=jnp.float32
+            ).astype(stats.dtype)
         else:
             slot_oh = jax.nn.one_hot(slot, num_slots, dtype=stats.dtype)
             hist = jnp.einsum("nc,ns,nb->cbs", slot_oh, stats, bin_oh)
@@ -349,8 +365,12 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     # resolved here only when the caller did not pin it; jitted entry
     # points MUST pin it (static arg) or mode switches won't retrace
     hist_mode = hist_mode or _hist_mode(n, TB)
-    bin_oh = (_bin_indicator(packed, TB, stats.dtype)
-              if hist_mode in ("matmul", "pallas") else None)
+    if hist_mode == "matmul_bf16":
+        bin_oh = _bin_indicator(packed, TB, jnp.bfloat16)
+    elif hist_mode in ("matmul", "pallas"):
+        bin_oh = _bin_indicator(packed, TB, stats.dtype)
+    else:
+        bin_oh = None
     key = feat_key
     for level in range(depth):
         # identity fast path: while every within-level node id fits the
@@ -668,7 +688,7 @@ def _tree_block_size(n: int, total_bins: int, depth: int, s_dim: int,
     cap = min(n, _DEFAULT_NODE_CAP)
     c_max = min(2 ** max(depth - 1, 0), cap)
     per_tree = 2 * n * 8 + 2 * c_max * total_bins * s_dim * 8
-    if hist_mode in ("matmul", "pallas"):
+    if hist_mode in ("matmul", "pallas", "matmul_bf16"):
         # the (n, c_max) slot one-hot is the dominant per-tree transient
         # of the einsum strategy at depth
         per_tree += n * c_max * 8
@@ -1548,22 +1568,26 @@ def _fold_edges_mode() -> bool:
 def _depth_mode() -> str:
     """How the fold×grid search handles the max_depth sweep:
 
-    - "mask" (default on accelerators): ONE compiled program per tree
-      family at the grid's deepest depth; each candidate's depth is a
-      traced per-lane limit (_grow_tree depth_limit). Cuts tree-family
-      compile count ~3x (the depth axis of the default grids) at the
-      price of shallow lanes running the deep lane's masked levels —
-      the right trade where compile latency dominates (TPU cold start,
-      SURVEY §6 / VERDICT r4 #3).
-    - "static" (default on CPU): one program per distinct depth (lanes
-      do exactly their own work — CPU compiles are cheap and the
-      flagship search is compute-bound there).
+    - "static" (default): one program per distinct depth — lanes do
+      exactly their own work.
+    - "mask": ONE compiled program per tree family at the grid's
+      deepest depth; each candidate's depth is a traced per-lane limit
+      (_grow_tree depth_limit). Cuts tree-family compile count 3x on
+      the default grids (flagship: 6 -> 2 programs) at the price of
+      shallow lanes running the deep lane's masked levels.
 
-    TX_TREE_DEPTH overrides either way."""
+    Measured (BASELINE.md r5): identical metrics; on single-core CPU
+    the flagship search ran 97 s static vs 380 s mask warm — compute
+    inflation swamps the saved compiles, so static is the default
+    everywhere until the trade is measured on a real TPU (where the
+    inflation is larger still under matmul histograms — per-level cost
+    scales with the slot count — but compiles cost 100+ s). mask is
+    the cold-start lever (VERDICT r4 #3): flip TX_TREE_DEPTH=mask when
+    first-result latency matters more than steady-state throughput."""
     mode = os.environ.get("TX_TREE_DEPTH")
     if mode in ("mask", "static"):
         return mode
-    return "mask" if jax.default_backend() != "cpu" else "static"
+    return "static"
 
 
 #: (kernel kind, statics, call shape) triples seen — each is one XLA

@@ -102,6 +102,18 @@ class SelectedModel(PredictionModel):
         return self.inner.predict_arrays(X)
 
 
+def models_x_folds(model) -> int:
+    """Total (candidate, fold) evaluations recorded by the selector(s)
+    in a fitted workflow model — the unit of the north-star throughput
+    metric (BASELINE.md). Shared by bench.py and
+    examples/multicore_bench.py so their rows stay comparable."""
+    return sum(
+        len(r.metric_values)
+        for s in model.stages()
+        if isinstance(s, SelectedModel) and s.summary is not None
+        for r in s.summary.validation_results)
+
+
 class ModelSelector(Predictor):
     """Run candidates x grids under a validator, pick the winner
     (reference ModelSelector.scala:74)."""
@@ -121,6 +133,10 @@ class ModelSelector(Predictor):
         #: findBestEstimator, ModelSelector.scala:113): when set, fit
         #: skips validation and refits this estimator on the full data
         self.best_estimator: Optional[BestEstimator] = None
+        #: (train_idx, test_idx) reserved by workflow-level CV BEFORE
+        #: the fold search — consumed by fit so search and final fit
+        #: share ONE split structurally (not by re-derivation)
+        self.preset_split = None
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> SelectedModel:
         if not self.models:
@@ -135,7 +151,16 @@ class ModelSelector(Predictor):
         prep_results: Dict = {}
         X_hold = y_hold = None
         if self.splitter is not None:
-            train_idx, test_idx = self.splitter.split(y)
+            if self.preset_split is not None:
+                # workflow-level CV already reserved the holdout; reuse
+                # its exact indices (and its estimated resampling plan)
+                train_idx, test_idx = self.preset_split
+                self.preset_split = None
+            else:
+                # a fresh fit must not recycle a plan estimated on some
+                # earlier dataset (reused selector instances re-validate)
+                self.splitter.reset_plan()
+                train_idx, test_idx = self.splitter.split(y)
             if len(test_idx):
                 X_hold, y_hold = X[test_idx], y[test_idx]
             X_tr, y_tr = X[train_idx], y[train_idx]

@@ -78,6 +78,14 @@ class Splitter:
         self.summary = SplitterSummary(splitter=type(self).__name__)
         return np.arange(len(y))
 
+    def reset_plan(self) -> None:
+        """Forget any stored resampling plan so the next fit estimates
+        fresh from ITS data. The plan intentionally persists across the
+        prepares of ONE selector fit (global estimate -> per-fold
+        prepares -> final refit, reference isSet semantics); a REUSED
+        selector instance must not recycle it across datasets — the
+        selector calls this at the top of every fit."""
+
     def get_params(self) -> Dict:
         return {"reserve_test_fraction": self.reserve_test_fraction,
                 "seed": self.seed}
@@ -122,6 +130,9 @@ class DataBalancer(Splitter):
         #: set by estimate(); None until then
         self._plan: Optional[Tuple[bool, float, float,
                                    Optional[float]]] = None
+
+    def reset_plan(self) -> None:
+        self._plan = None
 
     def _proportions(self, small: int, big: int
                      ) -> Tuple[float, float]:
@@ -220,6 +231,9 @@ class DataCutter(Splitter):
         self.min_label_fraction = min_label_fraction
         self.max_label_categories = max_label_categories
         self.labels_kept: Optional[np.ndarray] = None
+
+    def reset_plan(self) -> None:
+        self.labels_kept = None
 
     def estimate(self, y: np.ndarray) -> None:
         """Decide which labels survive, from global label counts

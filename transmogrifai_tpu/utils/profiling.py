@@ -1,0 +1,95 @@
+"""Device-trace post-processing: per-op timing from a jax.profiler run.
+
+`jax.profiler.start_trace` writes a Chrome-trace JSON
+(``plugins/profile/<ts>/<host>.trace.json.gz``) whose DEVICE lanes carry
+one complete event per XLA op execution — the accelerator-level
+profile the reference delegates to the Spark UI (SURVEY §5.5 aux).
+:func:`summarize_device_trace` reduces it to the top time-sink ops and a
+device-busy figure so benchmarks can report utilization, not just
+wall-clock (VERDICT r4 next-round #1).
+
+On the CPU backend the trace contains only host python frames (no
+device lanes) — callers fall back to the workflow listener's per-stage
+profile there.
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["summarize_device_trace", "trace_and_summarize"]
+
+
+def _newest_trace(log_dir: str) -> Optional[str]:
+    paths = glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def summarize_device_trace(log_dir: str, top: int = 5) -> Optional[Dict]:
+    """Aggregate the newest trace under ``log_dir``.
+
+    Returns ``{"top_ops": [(name, total_ms), ...], "device_busy_ms",
+    "device_span_ms", "device_busy_pct", "device_lanes"}`` or None when
+    the trace has no device lanes (CPU backend) or no trace exists."""
+    path = _newest_trace(log_dir)
+    if path is None:
+        return None
+    data = json.loads(gzip.open(path).read())
+    events = data.get("traceEvents", [])
+    # pid -> process name metadata; device lanes are "/device:..." (TPU)
+    proc_names = {e.get("pid"): (e.get("args") or {}).get("name", "")
+                  for e in events
+                  if e.get("ph") == "M" and e.get("name") == "process_name"}
+    device_pids = {pid for pid, name in proc_names.items()
+                   if "/device:" in name and "CPU" not in name}
+    if not device_pids:
+        return None
+    agg: collections.Counter = collections.Counter()
+    t_min, t_max = float("inf"), 0.0
+    busy = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        dur = float(e.get("dur", 0.0))          # microseconds
+        agg[e.get("name", "?")] += dur
+        busy += dur
+        ts = float(e.get("ts", 0.0))
+        t_min = min(t_min, ts)
+        t_max = max(t_max, ts + dur)
+    span = max(t_max - t_min, 1e-9)
+    return {
+        "top_ops": [(name, round(dur / 1000.0, 3))
+                    for name, dur in agg.most_common(top)],
+        "device_busy_ms": round(busy / 1000.0, 3),
+        "device_span_ms": round(span / 1000.0, 3),
+        # busy sums over every device lane; normalize by lane count so
+        # an 8-chip mesh at full tilt reads 100, not 800
+        "device_busy_pct": round(
+            100.0 * busy / (span * len(device_pids)), 2),
+        "device_lanes": sorted(proc_names[p] for p in device_pids),
+    }
+
+
+def trace_and_summarize(fn, log_dir: str, top: int = 5
+                        ) -> Tuple[object, Optional[Dict]]:
+    """Run ``fn()`` under a device trace rooted at a FRESH subdirectory
+    of ``log_dir`` and summarize it. Returns (fn result,
+    summary-or-None). The per-run subdirectory guarantees a run that
+    writes no trace reports None instead of silently summarizing a
+    previous run's files."""
+    import tempfile
+
+    import jax
+    os.makedirs(log_dir, exist_ok=True)
+    run_dir = tempfile.mkdtemp(prefix="run_", dir=log_dir)
+    jax.profiler.start_trace(run_dir)
+    try:
+        out = fn()
+    finally:
+        jax.profiler.stop_trace()
+    return out, summarize_device_trace(run_dir, top=top)

@@ -447,12 +447,15 @@ class Workflow:
                 "the in-CV DAG segment", label_f.name)
             return prefitted
         # 2. reserve the holdout BEFORE folding so the search never sees
-        #    it; splitter.split is deterministic in (y, seed), so the
-        #    selector's own final-fit reservation picks the same rows
+        #    it; the exact indices are preset on the selector so its
+        #    final fit reuses THIS split rather than re-deriving one
+        #    (structural agreement — no determinism convention to break)
         y_pre = np.asarray(pre[label_f.name].data, dtype=np.float64)
         splitter = selector.splitter
         if splitter is not None:
+            splitter.reset_plan()
             tr_idx, te_idx = splitter.split(y_pre)
+            selector.preset_split = (tr_idx, te_idx)
             if len(te_idx):
                 pre, y_pre = pre.take(tr_idx), y_pre[tr_idx]
             est = getattr(splitter, "estimate", None)
