@@ -104,3 +104,72 @@ class TestSoftmaxBoosting:
                   for v in r.metric_values
                   if r.model_name == "XGBoostClassifier"]
         assert all(np.isfinite(v) for v in finite)
+
+
+class TestSoftmaxFoldGrid:
+    """Fused multiclass fold×grid kernels (r5): the softmax booster now
+    has the same device-resident search path as every other family."""
+
+    def _data(self, n=240, d=5, F=3):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(n, d))
+        y = np.clip((X[:, 0] > -0.5).astype(int) + (X[:, 1] > 0.5),
+                    0, 2).astype(float)
+        masks = np.ones((F, n))
+        for f in range(F):
+            masks[f, f::F] = 0.0
+        nv = n // F
+        Xv = np.stack([X[masks[f] == 0][:nv] for f in range(F)])
+        yv = np.stack([y[masks[f] == 0][:nv] for f in range(F)])
+        return X, y, masks, Xv, yv
+
+    def test_eval_matches_host_exactly_under_fold_edges(self, monkeypatch):
+        from transmogrifai_tpu.evaluators import \
+            MultiClassificationEvaluator
+        from transmogrifai_tpu.models.trees import XGBoostClassifier
+        monkeypatch.setenv("TX_TREE_EDGES", "fold")
+        X, y, masks, Xv, yv = self._data()
+        ev = MultiClassificationEvaluator()
+        est = XGBoostClassifier(num_round=4)
+        grid = [{"max_depth": dd, "min_child_weight": m}
+                for dd in (3, 4) for m in (1.0, 5.0)]
+        mm = est.eval_fold_grid_arrays(X, y, masks, grid, Xv, yv,
+                                       ev.device_metric_spec())
+        assert mm.shape == (3, 4) and np.isfinite(mm).all()
+        for f in range(3):
+            tr = masks[f] > 0
+            for gi, p in enumerate(grid):
+                model = est.with_params(**p).fit_arrays(X[tr], y[tr])
+                host = ev.metric_from(
+                    ev.evaluate_arrays(yv[f],
+                                       model.predict_arrays(Xv[f])))
+                assert abs(host - mm[f, gi]) < 1e-9
+
+    def test_fold_grid_models_match_sequential(self, monkeypatch):
+        from transmogrifai_tpu.models.trees import XGBoostClassifier
+        monkeypatch.setenv("TX_TREE_EDGES", "fold")
+        X, y, masks, _, _ = self._data()
+        est = XGBoostClassifier(num_round=4)
+        grid = [{"max_depth": 3}, {"max_depth": 4}]
+        ms = est.fit_fold_grid_arrays(X, y, masks, grid)
+        tr = masks[1] > 0
+        seq = est.with_params(**grid[0]).fit_arrays(X[tr], y[tr])
+        np.testing.assert_array_equal(ms[1][0].feats, seq.feats)
+        np.testing.assert_array_equal(ms[1][0].leaves, seq.leaves)
+
+    def test_mask_depth_models_match_static(self, monkeypatch):
+        """Softmax lanes under TX_TREE_DEPTH=mask trim back to their own
+        depth bit-exactly (leaf_axis=2 stride)."""
+        from transmogrifai_tpu.models.trees import XGBoostClassifier
+        X, y, masks, _, _ = self._data()
+        est = XGBoostClassifier(num_round=3)
+        grid = [{"max_depth": 2}, {"max_depth": 4}]
+        monkeypatch.setenv("TX_TREE_DEPTH", "static")
+        ms = est.fit_fold_grid_arrays(X, y, masks[:1], grid)
+        monkeypatch.setenv("TX_TREE_DEPTH", "mask")
+        mk = est.fit_fold_grid_arrays(X, y, masks[:1], grid)
+        for gi in range(2):
+            np.testing.assert_array_equal(ms[0][gi].feats, mk[0][gi].feats)
+            np.testing.assert_array_equal(ms[0][gi].leaves,
+                                          mk[0][gi].leaves)
+            assert ms[0][gi].depth == mk[0][gi].depth

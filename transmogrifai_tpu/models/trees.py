@@ -54,7 +54,8 @@ import numpy as np
 _log = logging.getLogger(__name__)
 
 from ..features.columns import PredictionColumn
-from .base import ClassifierModel, Predictor, RegressionModel, num_classes
+from .base import (ClassifierModel, Predictor, RegressionModel,
+                   check_fold_classes, num_classes)
 from ..parallel.mesh import to_host
 
 __all__ = [
@@ -368,6 +369,15 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     if hist_mode == "matmul_bf16":
         bin_oh = _bin_indicator(packed, TB, jnp.bfloat16)
     elif hist_mode in ("matmul", "pallas"):
+        ind_gb = n * TB * jnp.dtype(stats.dtype).itemsize / 2 ** 30
+        if ind_gb > 4.0:
+            # the (n, TB) indicator is re-read every level; at this
+            # size it dominates HBM (BASELINE.md roofline) — bf16
+            # operands halve it with fp32 accumulation
+            _log.warning(
+                "matmul histogram indicator is %.1f GiB (%d rows x %d "
+                "packed bins, %s); consider TX_TREE_HIST=matmul_bf16",
+                ind_gb, n, TB, jnp.dtype(stats.dtype).name)
         bin_oh = _bin_indicator(packed, TB, stats.dtype)
     else:
         bin_oh = None
@@ -909,7 +919,8 @@ def _gbt_softmax_body(packed, feat_of, block_start, packed_thr, y, key,
                       num_rounds: int, num_classes: int,
                       hist_mode: Optional[str],
                       axis_name: Optional[str] = None,
-                      row_total: Optional[int] = None):
+                      row_total: Optional[int] = None,
+                      depth_limit=None):
     """K-class softmax boosting: each round fits one tree PER CLASS on
     the softmax gradients/hessians (g_k = p_k - 1[y=k],
     h_k = p_k(1-p_k)) — the ``multi:softprob`` objective the reference
@@ -947,7 +958,8 @@ def _gbt_softmax_body(packed, feat_of, block_start, packed_thr, y, key,
                 packed, feat_of, block_start, packed_thr,
                 jnp.stack([gk * m, hk * m], axis=1), depth=depth,
                 gain_fn=gain_fn, min_info_gain=0.0, hist_mode=hist_mode,
-                axis_name=axis_name, row_total=row_total)
+                axis_name=axis_name, row_total=row_total,
+                depth_limit=depth_limit)
             vals = (-step_size * leaf_stats[:, 0]
                     / (leaf_stats[:, 1] + reg_lambda))
             vals = jnp.where(
@@ -1154,6 +1166,170 @@ def _gbt_eval_kernel(statics: tuple, spec: tuple, mesh=None):
         batched, mesh=mesh,
         in_specs=(P("models", None),) + (P("models"),) * 7 + (P(),) * 8,
         out_specs=P("models"), check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
+def _gbt_softmax_fg_kernel(statics: tuple, mesh=None):
+    """Fold×grid kernel for K-class softmax boosting (the multiclass
+    XGBoost path, _gbt_softmax_body) — mirrors _gbt_fg_kernel's
+    candidate contract."""
+    depth, num_rounds, num_classes, hist_mode = statics
+
+    def one(mask, ss, rl, ga, mcw, sub, dl, packed, feat_of, block_start,
+            packed_thr, y, key):
+        return _gbt_softmax_body(
+            packed, feat_of, block_start, packed_thr, y, key, mask, ss,
+            rl, ga, mcw, sub, depth=depth, num_rounds=num_rounds,
+            num_classes=num_classes, hist_mode=hist_mode, depth_limit=dl)
+
+    def batched(masks, ss, rl, ga, mcw, sub, dl, *rest):
+        return jax.vmap(one, in_axes=(0,) * 7 + (None,) * 6
+                        )(masks, ss, rl, ga, mcw, sub, dl, *rest)
+
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None),) + (P("models"),) * 6 + (P(),) * 6,
+        out_specs=(P("models", None, None, None),
+                   P("models", None, None, None),
+                   P("models", None, None, None), P("models", None)),
+        check_vma=False))
+
+
+def _softmax_margins(feats, thrs, leaves, base, depth: int, Xv):
+    """(nv, K) margins of one softmax-boosted candidate on device —
+    the exact twin of GBTMulticlassClassifierModel.predict_raw."""
+    R, K, H = feats.shape
+    flat_f = feats.reshape(R * K, H)
+    flat_t = thrs.reshape(R * K, H)
+    leaf = jax.vmap(lambda fh, th: _traverse(Xv, fh, th, depth)
+                    )(flat_f, flat_t)                     # (R*K, nv)
+    flat_l = leaves.reshape(R * K, -1)
+    vals = flat_l[jnp.arange(R * K)[:, None], leaf]
+    return base + vals.reshape(R, K, -1).sum(axis=0).T    # (nv, K)
+
+
+@functools.lru_cache(maxsize=32)
+def _gbt_softmax_eval_kernel(statics: tuple, spec: tuple, mesh=None):
+    """Fit + validation-metric fusion of _gbt_softmax_fg_kernel: the
+    multiclass metric consumes softmax probabilities, matching the host
+    ClassifierModel.raw_to_probability ranking exactly."""
+    depth, num_rounds, num_classes, hist_mode = statics
+    from ..evaluators.device_metrics import metric_fn
+    mfn = metric_fn(*spec)
+
+    def one(mask, ss, rl, ga, mcw, sub, dl, fi, Xv, yv, packed, feat_of,
+            block_start, packed_thr, y, key):
+        feats, thrs, leaves, base = _gbt_softmax_body(
+            packed, feat_of, block_start, packed_thr, y, key, mask, ss,
+            rl, ga, mcw, sub, depth=depth, num_rounds=num_rounds,
+            num_classes=num_classes, hist_mode=hist_mode, depth_limit=dl)
+        margins = _softmax_margins(feats, thrs, leaves, base, depth,
+                                   Xv[fi])
+        return mfn(yv[fi], jax.nn.softmax(margins, axis=1))
+
+    def batched(masks, ss, rl, ga, mcw, sub, dl, fi, Xv, yv, *rest):
+        return jax.vmap(one, in_axes=(0,) * 8 + (None, None)
+                        + (None,) * 6
+                        )(masks, ss, rl, ga, mcw, sub, dl, fi, Xv, yv,
+                          *rest)
+
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import PartitionSpec as P
+    return jax.jit(jax.shard_map(
+        batched, mesh=mesh,
+        in_specs=(P("models", None),) + (P("models"),) * 7 + (P(),) * 8,
+        out_specs=P("models"), check_vma=False))
+
+
+def _gbt_softmax_fold_grid(est, X, y, masks, grid, mesh, num_classes_k,
+                           eval_ctx=None, edge_rows=None):
+    # mirrors _gbt_fold_grid's candidate contract for the K-class
+    # softmax objective — change all three drivers together
+    masks = np.asarray(masks, dtype=np.float64)
+    if edge_rows is None and _fold_edges_mode():
+        return _fold_edge_recurse(
+            _gbt_softmax_fold_grid, est, X, y, masks, grid, mesh,
+            eval_ctx, num_classes_k=num_classes_k)
+    grid = [dict(p) for p in (list(grid) or [{}])]
+    allowed = set(_GBT_TRACED) | set(_GBT_STATIC)
+    for p in grid:
+        extra = set(p) - allowed
+        if extra:
+            raise NotImplementedError(
+                f"batched softmax-GBT kernel cannot vary {sorted(extra)}")
+    F, n = masks.shape
+    G = len(grid)
+    d = X.shape[1]
+    y_j = jnp.asarray(y)
+    models = [[None] * G for _ in range(F)]
+    metric_mat = np.full((F, G), np.nan)
+    if eval_ctx is not None:
+        Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
+        yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
+        spec = eval_ctx[2]
+    mask_depth = _depth_mode() == "mask"
+    groups: Dict[tuple, list] = {}
+    for gi, p in enumerate(grid):
+        cand = est.with_params(**p)
+        skey = (None if mask_depth else cand.max_depth,
+                cand.num_rounds, cand.max_bins, cand.seed)
+        groups.setdefault(skey, []).append((gi, cand))
+    for members in groups.values():
+        cand0 = members[0][1]
+        depth_cap = max(c.max_depth for _, c in members)
+        design, _ = _design_args(X, cand0.max_bins, edge_rows=edge_rows)
+        gk = len(members)
+        ss = np.tile([float(c.step_size) for _, c in members], F)
+        rl = np.tile([float(c.reg_lambda) for _, c in members], F)
+        ga = np.tile([float(c.gamma) for _, c in members], F)
+        mcw = np.tile([float(c.min_child_weight) for _, c in members], F)
+        sub = np.tile([float(c.subsample) for _, c in members], F)
+        dl = np.tile([float(c.max_depth) for _, c in members], F)
+        masks_c = np.repeat(masks, gk, axis=0)
+        fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
+        (masks_p, ss, rl, ga, mcw, sub, dl), count = _pad_candidates(
+            mesh, [masks_c, ss, rl, ga, mcw, sub, dl], n)
+        fidx = np.concatenate(
+            [fidx, np.zeros(len(ss) - count, dtype=np.int32)])
+        statics = (depth_cap, cand0.num_rounds, num_classes_k,
+                   _hist_mode(n, int(design[1].shape[0])))
+        _note_compile("gbt_softmax", statics, masks_p.shape)
+        if eval_ctx is not None:
+            fn = _gbt_softmax_eval_kernel(statics, spec, mesh)
+            mm = to_host(fn(
+                jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
+                jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
+                jnp.asarray(dl), jnp.asarray(fidx), Xv_j, yv_j,
+                *design[:4], y_j,
+                jax.random.PRNGKey(cand0.seed)))[:count]
+            for f in range(F):
+                for j, (gi, _) in enumerate(members):
+                    metric_mat[f, gi] = mm[f * gk + j]
+            continue
+        fn = _gbt_softmax_fg_kernel(statics, mesh)
+        feats, thrs, leaves, base = fn(
+            jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
+            jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
+            jnp.asarray(dl), *design[:4], y_j,
+            jax.random.PRNGKey(cand0.seed))
+        feats = to_host(feats)[:count]
+        thrs = to_host(thrs)[:count]
+        leaves = to_host(leaves)[:count]
+        base = to_host(base)[:count]
+        for f in range(F):
+            for j, (gi, cand) in enumerate(members):
+                c = f * gk + j
+                fe, th, le = _trim_tree_arrays(
+                    feats[c], thrs[c], leaves[c], depth_cap,
+                    cand.max_depth, leaf_axis=2)
+                models[f][gi] = GBTMulticlassClassifierModel(
+                    fe, th, le, depth=cand.max_depth, base=base[c],
+                    n_features=d)
+    return metric_mat if eval_ctx is not None else models
 
 
 # ---------------------------------------------------------------------------
@@ -1639,19 +1815,25 @@ _GBT_TRACED = ("step_size", "reg_lambda", "gamma", "min_child_weight",
 _GBT_STATIC = ("max_depth", "num_rounds", "max_bins", "seed", "num_round")
 
 
-def _trim_tree_arrays(feats, thrs, leaves, depth_cap: int, depth: int):
+def _trim_tree_arrays(feats, thrs, leaves, depth_cap: int, depth: int,
+                      leaf_axis: int = 1):
     """Slice a depth_cap-shaped (heap, leaves) candidate back to its own
     ``depth`` (TX_TREE_DEPTH=mask materialization): levels >= depth hold
     only (0, +inf) denied splits, and a truncated node ``l``'s rows all
     sit in its leftmost descendant leaf ``l << (cap - depth)`` — so the
     heap prefix plus a strided leaf gather reproduce the static-depth
     model bit-exactly (up to 512x less host memory for a depth-3 lane
-    in a depth-12 group)."""
+    in a depth-12 group).
+
+    Heaps put H last everywhere ((T, H) forests, (R, K, H) softmax);
+    the LEAF axis varies — (T, L[, K]) forests/GBT vs (R, K, L) softmax
+    — hence ``leaf_axis``."""
     if depth == depth_cap:
         return feats, thrs, leaves
     h = 2 ** depth - 1
-    return (feats[:, :h], thrs[:, :h],
-            leaves[:, ::2 ** (depth_cap - depth)])
+    sl = [slice(None)] * leaves.ndim
+    sl[leaf_axis] = slice(None, None, 2 ** (depth_cap - depth))
+    return feats[..., :h], thrs[..., :h], leaves[tuple(sl)]
 
 
 def _fold_edge_recurse(fold_grid_fn, est, X, y, masks, grid, mesh,
@@ -2256,6 +2438,41 @@ class XGBoostClassifier(GBTClassifier):
             seed=seed, uid=uid)
         self.eta = eta
         self.num_round = num_round
+
+    @staticmethod
+    def _check_multiclass_labels(y, k: int) -> None:
+        bad = np.setdiff1d(np.unique(y), np.arange(k, dtype=np.float64))
+        if bad.size:
+            raise NotImplementedError(
+                f"softmax booster needs integer class labels 0..{k - 1};"
+                f" got {bad.tolist()}")
+
+    def fit_fold_grid_arrays(self, X, y, masks, grid, mesh=None):
+        """Multiclass grids run the fused softmax fold×grid kernel
+        (binary falls through to the GBT driver)."""
+        k = num_classes(y)
+        if k <= 2:
+            return GBTClassifier.fit_fold_grid_arrays(
+                self, X, y, masks, grid, mesh=mesh)
+        self._check_multiclass_labels(y, k)
+        check_fold_classes(y, masks)
+        return _gbt_softmax_fold_grid(self, X, y, masks, grid, mesh, k)
+
+    def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
+                              spec, mesh=None):
+        """Device-resident multiclass search: fused softmax fit +
+        metric, (F, G) matrix out (_gbt_softmax_eval_kernel)."""
+        k = num_classes(y)
+        if k <= 2:
+            return GBTClassifier.eval_fold_grid_arrays(
+                self, X, y, masks, grid, X_val, y_val, spec, mesh=mesh)
+        if spec[0] != "multiclass":
+            raise NotImplementedError(
+                "softmax-GBT device eval needs a multiclass metric")
+        self._check_multiclass_labels(y, k)
+        check_fold_classes(y, masks)
+        return _gbt_softmax_fold_grid(self, X, y, masks, grid, mesh, k,
+                                      eval_ctx=(X_val, y_val, spec))
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray):
         k = num_classes(y)

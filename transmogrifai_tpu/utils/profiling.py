@@ -49,11 +49,34 @@ def summarize_device_trace(log_dir: str, top: int = 5) -> Optional[Dict]:
                    if "/device:" in name and "CPU" not in name}
     if not device_pids:
         return None
+    # a device pid carries OVERLAPPING thread lanes (module-level spans,
+    # per-op events, step markers); summing them all double-counts — so
+    # per pid keep only the per-op lane ("XLA Ops" thread) when named,
+    # else the single busiest lane
+    thread_names: Dict[Tuple, str] = {
+        (e.get("pid"), e.get("tid")): (e.get("args") or {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    lane_busy: collections.Counter = collections.Counter()
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in device_pids:
+            lane_busy[(e.get("pid"), e.get("tid"))] += float(
+                e.get("dur", 0.0))
+    keep_lanes = set()
+    for pid in device_pids:
+        lanes = [k for k in lane_busy if k[0] == pid]
+        if not lanes:
+            continue
+        named = [k for k in lanes
+                 if "xla ops" in thread_names.get(k, "").lower()]
+        keep_lanes.add(named[0] if named
+                       else max(lanes, key=lane_busy.__getitem__))
     agg: collections.Counter = collections.Counter()
     t_min, t_max = float("inf"), 0.0
     busy = 0.0
     for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+        if e.get("ph") != "X" or \
+                (e.get("pid"), e.get("tid")) not in keep_lanes:
             continue
         dur = float(e.get("dur", 0.0))          # microseconds
         agg[e.get("name", "?")] += dur
