@@ -1271,50 +1271,25 @@ def _gbt_softmax_fold_grid(est, X, y, masks, grid, mesh, num_classes_k,
         Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
         yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
         spec = eval_ctx[2]
-    mask_depth = _depth_mode() == "mask"
-    groups: Dict[tuple, list] = {}
-    for gi, p in enumerate(grid):
-        cand = est.with_params(**p)
-        skey = (None if mask_depth else cand.max_depth,
-                cand.num_rounds, cand.max_bins, cand.seed)
-        groups.setdefault(skey, []).append((gi, cand))
-    for members in groups.values():
-        cand0 = members[0][1]
-        depth_cap = max(c.max_depth for _, c in members)
+    for members, cand0, depth_cap, vecs, masks_p, fidx, count, gk in \
+            _candidate_groups(est, grid, masks, mesh, _GBT_TILED,
+                              _GBT_SKEY):
         design, _ = _design_args(X, cand0.max_bins, edge_rows=edge_rows)
-        gk = len(members)
-        ss = np.tile([float(c.step_size) for _, c in members], F)
-        rl = np.tile([float(c.reg_lambda) for _, c in members], F)
-        ga = np.tile([float(c.gamma) for _, c in members], F)
-        mcw = np.tile([float(c.min_child_weight) for _, c in members], F)
-        sub = np.tile([float(c.subsample) for _, c in members], F)
-        dl = np.tile([float(c.max_depth) for _, c in members], F)
-        masks_c = np.repeat(masks, gk, axis=0)
-        fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
-        (masks_p, ss, rl, ga, mcw, sub, dl), count = _pad_candidates(
-            mesh, [masks_c, ss, rl, ga, mcw, sub, dl], n)
-        fidx = np.concatenate(
-            [fidx, np.zeros(len(ss) - count, dtype=np.int32)])
         statics = (depth_cap, cand0.num_rounds, num_classes_k,
                    _hist_mode(n, int(design[1].shape[0])))
         _note_compile("gbt_softmax", statics, masks_p.shape)
+        vecs_j = [jnp.asarray(v) for v in vecs]
         if eval_ctx is not None:
             fn = _gbt_softmax_eval_kernel(statics, spec, mesh)
             mm = to_host(fn(
-                jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
-                jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
-                jnp.asarray(dl), jnp.asarray(fidx), Xv_j, yv_j,
-                *design[:4], y_j,
+                jnp.asarray(masks_p), *vecs_j, jnp.asarray(fidx),
+                Xv_j, yv_j, *design[:4], y_j,
                 jax.random.PRNGKey(cand0.seed)))[:count]
-            for f in range(F):
-                for j, (gi, _) in enumerate(members):
-                    metric_mat[f, gi] = mm[f * gk + j]
+            _scatter_group_metrics(metric_mat, mm, members, F, gk)
             continue
         fn = _gbt_softmax_fg_kernel(statics, mesh)
         feats, thrs, leaves, base = fn(
-            jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
-            jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
-            jnp.asarray(dl), *design[:4], y_j,
+            jnp.asarray(masks_p), *vecs_j, *design[:4], y_j,
             jax.random.PRNGKey(cand0.seed))
         feats = to_host(feats)[:count]
         thrs = to_host(thrs)[:count]
@@ -1813,6 +1788,11 @@ _FOREST_STATIC = ("max_depth", "num_trees", "max_bins", "impurity",
 _GBT_TRACED = ("step_size", "reg_lambda", "gamma", "min_child_weight",
                "subsample", "eta")
 _GBT_STATIC = ("max_depth", "num_rounds", "max_bins", "seed", "num_round")
+#: the kernel-facing subsets ("eta"/"num_round" are facade aliases of
+#: step_size/num_rounds — valid in grids, not separate lanes/keys)
+_GBT_TILED = ("step_size", "reg_lambda", "gamma", "min_child_weight",
+              "subsample")
+_GBT_SKEY = ("max_depth", "num_rounds", "max_bins", "seed")
 
 
 def _trim_tree_arrays(feats, thrs, leaves, depth_cap: int, depth: int,
@@ -1834,6 +1814,49 @@ def _trim_tree_arrays(feats, thrs, leaves, depth_cap: int, depth: int,
     sl = [slice(None)] * leaves.ndim
     sl[leaf_axis] = slice(None, None, 2 ** (depth_cap - depth))
     return feats[..., :h], thrs[..., :h], leaves[tuple(sl)]
+
+
+def _candidate_groups(est, grid, masks, mesh, traced_fields, skey_fields):
+    """The shared fold-major candidate-batching contract of the three
+    fold×grid drivers (forest / binary-GBT / softmax-GBT): partition
+    grid points into static shape groups, flatten (fold, candidate)
+    lanes fold-major, tile the traced hyperparameter vectors (plus the
+    trailing depth-limit lane for TX_TREE_DEPTH=mask), and pad to the
+    mesh shard count.
+
+    Yields (members, cand0, depth_cap, traced_vecs, masks_p, fidx,
+    count, gk) per group; ``traced_vecs`` follows ``traced_fields``
+    order with the depth-limit vector appended."""
+    mask_depth = _depth_mode() == "mask"
+    F, n = masks.shape
+    groups: Dict[tuple, list] = {}
+    for gi, p in enumerate(grid):
+        cand = est.with_params(**p)
+        key = tuple(None if f == "max_depth" and mask_depth
+                    else getattr(cand, f, "") for f in skey_fields)
+        groups.setdefault(key, []).append((gi, cand))
+    for members in groups.values():
+        cand0 = members[0][1]
+        depth_cap = max(c.max_depth for _, c in members)
+        gk = len(members)
+        vecs = [np.tile([float(getattr(c, f)) for _, c in members], F)
+                for f in traced_fields]
+        vecs.append(np.tile([float(c.max_depth) for _, c in members], F))
+        masks_c = np.repeat(masks, gk, axis=0)
+        fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
+        (masks_p, *vecs), count = _pad_candidates(
+            mesh, [masks_c, *vecs], n)
+        fidx = np.concatenate(
+            [fidx, np.zeros(len(masks_p) - count, dtype=np.int32)])
+        yield members, cand0, depth_cap, vecs, masks_p, fidx, count, gk
+
+
+def _scatter_group_metrics(metric_mat, mm, members, F: int, gk: int):
+    """Write one group's (padded, fold-major) metric vector back into
+    the (F, G) matrix."""
+    for f in range(F):
+        for j, (gi, _) in enumerate(members):
+            metric_mat[f, gi] = mm[f * gk + j]
 
 
 def _fold_edge_recurse(fold_grid_fn, est, X, y, masks, grid, mesh,
@@ -1891,35 +1914,15 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
         Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
         yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
         spec = eval_ctx[2]
-    mask_depth = _depth_mode() == "mask"
-    groups: Dict[tuple, list] = {}
-    for gi, p in enumerate(grid):
-        cand = est.with_params(**p)
-        skey = (None if mask_depth else cand.max_depth, cand.num_trees,
-                cand.max_bins, getattr(cand, "impurity", ""),
-                cand.feature_subset_strategy, cand.seed)
-        groups.setdefault(skey, []).append((gi, cand))
-    for members in groups.values():
-        cand0 = members[0][1]
-        depth_cap = max(c.max_depth for _, c in members)
+    for members, cand0, depth_cap, vecs, masks_p, fidx, count, gk in \
+            _candidate_groups(est, grid, masks, mesh, _FOREST_TRACED,
+                              _FOREST_STATIC):
         design, widths = _design_args(X, cand0.max_bins,
                                       edge_rows=edge_rows)
         mf = _resolve_max_features(cand0.feature_subset_strategy, d,
                                    classification) \
             if cand0.bootstrap else None
         (narrow, wide), pool_cfg, mf = _pool_plan(widths, mf)
-        gk = len(members)
-        mi = np.tile([float(c.min_instances_per_node)
-                      for _, c in members], F)
-        mg = np.tile([float(c.min_info_gain) for _, c in members], F)
-        sr = np.tile([float(c.subsampling_rate) for _, c in members], F)
-        dl = np.tile([float(c.max_depth) for _, c in members], F)
-        masks_c = np.repeat(masks, gk, axis=0)   # fold-major candidates
-        fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
-        (masks_p, mi, mg, sr, dl), count = _pad_candidates(
-            mesh, [masks_c, mi, mg, sr, dl], n)
-        fidx = np.concatenate(
-            [fidx, np.zeros(len(mi) - count, dtype=np.int32)])
         statics = ("cls" if classification else "reg", depth_cap,
                    k if classification else 0, cand0.num_trees, mf,
                    pool_cfg, getattr(cand0, "impurity", ""),
@@ -1927,21 +1930,18 @@ def _forest_fold_grid(est, X, y, masks, grid, mesh, classification: bool,
                    _hist_mode(n, int(design[1].shape[0])),
                    _tree_budget_mb())
         _note_compile("forest", statics, masks_p.shape)
+        vecs_j = [jnp.asarray(v) for v in vecs]
         if eval_ctx is not None:
             fn = _forest_eval_kernel(statics, spec, mesh)
             mm = to_host(fn(
-                jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
-                jnp.asarray(sr), jnp.asarray(dl), jnp.asarray(fidx),
+                jnp.asarray(masks_p), *vecs_j, jnp.asarray(fidx),
                 Xv_j, yv_j, *design, narrow, wide, y_j,
                 jax.random.PRNGKey(cand0.seed)))[:count]
-            for f in range(F):
-                for j, (gi, _) in enumerate(members):
-                    metric_mat[f, gi] = mm[f * gk + j]
+            _scatter_group_metrics(metric_mat, mm, members, F, gk)
             continue
         fn = _forest_fg_kernel(statics, mesh)
         feats, thrs, leaves = fn(
-            jnp.asarray(masks_p), jnp.asarray(mi), jnp.asarray(mg),
-            jnp.asarray(sr), jnp.asarray(dl), *design, narrow, wide,
+            jnp.asarray(masks_p), *vecs_j, *design, narrow, wide,
             y_j, jax.random.PRNGKey(cand0.seed))
         feats = to_host(feats)[:count]
         thrs = to_host(thrs)[:count]
@@ -1986,53 +1986,28 @@ def _gbt_fold_grid(est, X, y, masks, grid, mesh, objective: str,
         Xv_j = jnp.asarray(np.asarray(eval_ctx[0], dtype=np.float64))
         yv_j = jnp.asarray(np.asarray(eval_ctx[1], dtype=np.float64))
         spec = eval_ctx[2]
-    mask_depth = _depth_mode() == "mask"
-    groups: Dict[tuple, list] = {}
-    for gi, p in enumerate(grid):
-        cand = est.with_params(**p)
-        skey = (None if mask_depth else cand.max_depth,
-                cand.num_rounds, cand.max_bins, cand.seed)
-        groups.setdefault(skey, []).append((gi, cand))
     model_cls = (GBTClassifierModel if objective == "logistic"
                  else GBTRegressorModel)
-    for members in groups.values():
-        cand0 = members[0][1]
-        depth_cap = max(c.max_depth for _, c in members)
+    for members, cand0, depth_cap, vecs, masks_p, fidx, count, gk in \
+            _candidate_groups(est, grid, masks, mesh, _GBT_TILED,
+                              _GBT_SKEY):
         design, _ = _design_args(X, cand0.max_bins,
                                  edge_rows=edge_rows)
-        gk = len(members)
-        ss = np.tile([float(c.step_size) for _, c in members], F)
-        rl = np.tile([float(c.reg_lambda) for _, c in members], F)
-        ga = np.tile([float(c.gamma) for _, c in members], F)
-        mcw = np.tile([float(c.min_child_weight) for _, c in members], F)
-        sub = np.tile([float(c.subsample) for _, c in members], F)
-        dl = np.tile([float(c.max_depth) for _, c in members], F)
-        masks_c = np.repeat(masks, gk, axis=0)
-        fidx = np.repeat(np.arange(F, dtype=np.int32), gk)
-        (masks_p, ss, rl, ga, mcw, sub, dl), count = _pad_candidates(
-            mesh, [masks_c, ss, rl, ga, mcw, sub, dl], n)
-        fidx = np.concatenate(
-            [fidx, np.zeros(len(ss) - count, dtype=np.int32)])
         statics = (depth_cap, cand0.num_rounds, objective,
                    _hist_mode(n, int(design[1].shape[0])))
         _note_compile("gbt", statics, masks_p.shape)
+        vecs_j = [jnp.asarray(v) for v in vecs]
         if eval_ctx is not None:
             fn = _gbt_eval_kernel(statics, spec, mesh)
             mm = to_host(fn(
-                jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
-                jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
-                jnp.asarray(dl), jnp.asarray(fidx), Xv_j, yv_j,
-                *design[:4], y_j,
+                jnp.asarray(masks_p), *vecs_j, jnp.asarray(fidx),
+                Xv_j, yv_j, *design[:4], y_j,
                 jax.random.PRNGKey(cand0.seed)))[:count]
-            for f in range(F):
-                for j, (gi, _) in enumerate(members):
-                    metric_mat[f, gi] = mm[f * gk + j]
+            _scatter_group_metrics(metric_mat, mm, members, F, gk)
             continue
         fn = _gbt_fg_kernel(statics, mesh)
         feats, thrs, leaves, base = fn(
-            jnp.asarray(masks_p), jnp.asarray(ss), jnp.asarray(rl),
-            jnp.asarray(ga), jnp.asarray(mcw), jnp.asarray(sub),
-            jnp.asarray(dl), *design[:4], y_j,
+            jnp.asarray(masks_p), *vecs_j, *design[:4], y_j,
             jax.random.PRNGKey(cand0.seed))
         feats = to_host(feats)[:count]
         thrs = to_host(thrs)[:count]

@@ -51,8 +51,9 @@ def summarize_device_trace(log_dir: str, top: int = 5) -> Optional[Dict]:
         return None
     # a device pid carries OVERLAPPING thread lanes (module-level spans,
     # per-op events, step markers); summing them all double-counts — so
-    # per pid keep only the per-op lane ("XLA Ops" thread) when named,
-    # else the single busiest lane
+    # per pid keep the per-op lanes: every thread named "XLA Ops" or
+    # "Stream ..." (genuinely concurrent lanes all count), falling back
+    # to the single busiest lane when nothing is named
     thread_names: Dict[Tuple, str] = {
         (e.get("pid"), e.get("tid")): (e.get("args") or {}).get("name", "")
         for e in events
@@ -68,9 +69,10 @@ def summarize_device_trace(log_dir: str, top: int = 5) -> Optional[Dict]:
         if not lanes:
             continue
         named = [k for k in lanes
-                 if "xla ops" in thread_names.get(k, "").lower()]
-        keep_lanes.add(named[0] if named
-                       else max(lanes, key=lane_busy.__getitem__))
+                 if any(t in thread_names.get(k, "").lower()
+                        for t in ("xla ops", "stream"))]
+        keep_lanes.update(named if named
+                          else [max(lanes, key=lane_busy.__getitem__)])
     agg: collections.Counter = collections.Counter()
     t_min, t_max = float("inf"), 0.0
     busy = 0.0
@@ -90,8 +92,10 @@ def summarize_device_trace(log_dir: str, top: int = 5) -> Optional[Dict]:
                     for name, dur in agg.most_common(top)],
         "device_busy_ms": round(busy / 1000.0, 3),
         "device_span_ms": round(span / 1000.0, 3),
-        # busy sums over every device lane; normalize by lane count so
-        # an 8-chip mesh at full tilt reads 100, not 800
+        # busy sums the kept per-op lanes of every DEVICE; dividing by
+        # span x device count makes an 8-chip mesh at full tilt read
+        # ~100 (it can exceed 100 only through real intra-device lane
+        # concurrency, e.g. overlapped GPU streams)
         "device_busy_pct": round(
             100.0 * busy / (span * len(device_pids)), 2),
         "device_lanes": sorted(proc_names[p] for p in device_pids),

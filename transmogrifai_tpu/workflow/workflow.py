@@ -452,10 +452,11 @@ class Workflow:
         #    (structural agreement — no determinism convention to break)
         y_pre = np.asarray(pre[label_f.name].data, dtype=np.float64)
         splitter = selector.splitter
+        reserved = None
         if splitter is not None:
             splitter.reset_plan()
             tr_idx, te_idx = splitter.split(y_pre)
-            selector.preset_split = (tr_idx, te_idx)
+            reserved = (tr_idx, te_idx)
             if len(te_idx):
                 pre, y_pre = pre.take(tr_idx), y_pre[tr_idx]
             est = getattr(splitter, "estimate", None)
@@ -484,6 +485,10 @@ class Workflow:
             folds.append(tuple(fold))
         selector.best_estimator = validator.validate_prepared(
             selector.models, folds)
+        # preset only once the search SUCCEEDED — a failed search must
+        # not leave stale reserved indices for some future fit
+        if reserved is not None:
+            selector.preset_split = reserved
         return prefitted
 
 
