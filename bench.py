@@ -22,8 +22,10 @@ import time
 
 BASELINE_AUPR = 0.8225
 #: watchdog for the ambient-backend (TPU) attempt; generous enough for
-#: cold remote compiles, small enough to leave room for the CPU fallback
-INNER_TIMEOUT_S = int(os.environ.get("TX_BENCH_TPU_TIMEOUT", "600"))
+#: cold remote compiles of the r5 grid (reference cardinality: 48
+#: points / 144 models x folds — r3's 24-point cold compile already
+#: took 130 s on TPU), small enough to leave room for the CPU fallback
+INNER_TIMEOUT_S = int(os.environ.get("TX_BENCH_TPU_TIMEOUT", "900"))
 #: cheap init probe before committing to the long attempt — a hung
 #: tunnel costs 60 s here instead of the full watchdog
 PROBE_TIMEOUT_S = int(os.environ.get("TX_BENCH_PROBE_TIMEOUT", "60"))
