@@ -167,14 +167,14 @@ class TestHistogramModes:
         X[:, 6:] = (X[:, 6:] > 0).astype(float)   # binary block
         y = (X[:, 0] + X[:, 6] > 0.3).astype(float)
         fits = {}
-        for mode in ("scatter", "matmul", "pallas"):
+        for mode in ("scatter", "matmul", "pallas", "matmul_chunk"):
             monkeypatch.setenv("TX_TREE_HIST", mode)
             fits[mode] = (
                 GBTClassifier(num_rounds=8, max_depth=4).fit_arrays(X, y),
                 RandomForestClassifier(num_trees=4, max_depth=6,
                                        min_instances_per_node=5
                                        ).fit_arrays(X, y))
-        for other in ("matmul", "pallas"):
+        for other in ("matmul", "pallas", "matmul_chunk"):
             for a, b in zip(fits["scatter"], fits[other]):
                 np.testing.assert_allclose(a.thrs, b.thrs, rtol=1e-6,
                                            err_msg=other)
@@ -506,3 +506,24 @@ class TestBf16Histograms:
             acc_a = np.mean(a.predict_arrays(X).data == y)
             acc_b = np.mean(b.predict_arrays(X).data == y)
             assert abs(acc_a - acc_b) < 0.02
+
+
+class TestMatmulChunk:
+    """TX_TREE_HIST=matmul_chunk: the MXU contraction with the bin
+    indicator rebuilt per bin block by gather+compare — exact vs the
+    whole-matrix modes even when multiple blocks are forced."""
+
+    def test_multi_block_exact(self, rng, monkeypatch):
+        import transmogrifai_tpu.models.trees as T
+        X = rng.normal(size=(300, 10))
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(float)
+        monkeypatch.setenv("TX_TREE_HIST", "scatter")
+        ref = T.GBTClassifier(num_rounds=6, max_depth=4).fit_arrays(X, y)
+        monkeypatch.setenv("TX_TREE_HIST", "matmul_chunk")
+        # force many bin blocks: step = max(8, 1000//300) = 8 bins per
+        # block -> dozens of blocks over this design's packed bins
+        monkeypatch.setattr(T, "_HIST_CHUNK_ELEMS", 1000)
+        chk = T.GBTClassifier(num_rounds=6, max_depth=4).fit_arrays(X, y)
+        np.testing.assert_allclose(ref.thrs, chk.thrs, rtol=1e-6)
+        np.testing.assert_array_equal(ref.feats, chk.feats)
+        np.testing.assert_allclose(ref.leaves, chk.leaves, rtol=1e-5)

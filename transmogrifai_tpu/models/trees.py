@@ -224,12 +224,17 @@ def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
     grad/hess/count contributions before the fp32 accumulation; split
     decisions can flip on near-ties, which is why it is opt-in rather
     than the TPU default until measured (VERDICT r4 #2).
+    "matmul_chunk" is exact like "matmul" but rebuilds the bin
+    indicator per bin block (gather+compare, scatter-free) every level
+    instead of holding the whole (n, TB) matrix — the big-n mode where
+    that matrix would blow HBM.
     TX_TREE_HIST overrides. Decided at trace time (platform only for
     now — the n/total_bins parameters stay in the signature so a
     size-based policy can return without touching every call site), so
     all modes stay available side by side."""
     mode = os.environ.get("TX_TREE_HIST")
-    if mode in ("scatter", "matmul", "pallas", "matmul_bf16"):
+    if mode in ("scatter", "matmul", "pallas", "matmul_bf16",
+                "matmul_chunk"):
         return mode
     try:
         platform = jax.default_backend()
@@ -238,16 +243,23 @@ def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
     return "matmul" if platform != "cpu" else "scatter"
 
 
-def _bin_indicator(packed: jnp.ndarray, total_bins: int,
-                   dtype) -> jnp.ndarray:
-    """(n, TB) 0/1 bin-membership matrix: feature bin ranges are
-    DISJOINT in the packed axis, so each row has exactly d ones. Built
-    with ONE scatter per tree and reused by every level's matmul-mode
-    histogram (amortizing scatter cost that would otherwise recur per
-    level on TPU, where XLA scatters serialize)."""
-    n = packed.shape[0]
-    return jnp.zeros((n, total_bins), dtype).at[
-        jnp.arange(n)[:, None], packed].set(1.0)
+def _bin_indicator(packed: jnp.ndarray, total_bins: int, dtype,
+                   feat_of: jnp.ndarray,
+                   lo: int = 0, hi: Optional[int] = None) -> jnp.ndarray:
+    """(n, hi-lo) 0/1 bin-membership matrix for packed bins [lo, hi)
+    (default: all TB bins): feature bin ranges are DISJOINT in the
+    packed axis, so each row has exactly one 1 per feature block.
+
+    The build is a GATHER + COMPARE — ``packed[:, feat_of[b]] == b`` —
+    which is scatter-free (XLA serializes scatters on TPU; a column
+    gather + VPU compare is not). Built once per tree for the
+    whole-matrix modes, or per (level, bin-block) under
+    ``matmul_chunk`` where the full (n, TB) matrix would blow HBM (the
+    12.8 GB case of the BASELINE roofline)."""
+    hi = total_bins if hi is None else hi
+    cols = packed[:, feat_of[lo:hi]]                # (n, hi-lo) gather
+    return (cols == jnp.arange(lo, hi, dtype=packed.dtype)[None, :]
+            ).astype(dtype)
 
 
 def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
@@ -255,22 +267,45 @@ def _level_histograms(packed: jnp.ndarray, slot: jnp.ndarray,
                       total_bins: int,
                       bin_oh: Optional[jnp.ndarray] = None,
                       mode: str = "scatter",
-                      axis_name: Optional[str] = None) -> jnp.ndarray:
-    """(num_slots, total_bins, S) histograms. Three mathematically
-    identical strategies (see _hist_mode):
+                      axis_name: Optional[str] = None,
+                      feat_of: Optional[jnp.ndarray] = None
+                      ) -> jnp.ndarray:
+    """(num_slots, total_bins, S) histograms. Mathematically identical
+    strategies (see _hist_mode):
 
     - scatter (bin_oh None): fused segment_sum per feature block
       (segment id = slot*TB + packed bin), blocks bounding the
       broadcasted (n x d_block x S) scatter input to _HIST_CHUNK_ELEMS;
-    - matmul (bin_oh given): hist[c,b,s] = sum_i 1[slot_i=c] *
-      binOH[i,b] * stats[i,s] — S dense contractions on the MXU, no
-      per-level scatters. Peak memory is the (n, TB) indicator built
-      once per tree;
+    - matmul / matmul_bf16 (bin_oh given): hist[c,b,s] =
+      sum_i 1[slot_i=c] * binOH[i,b] * stats[i,s] — S dense
+      contractions on the MXU, no per-level scatters. Peak memory is
+      the (n, TB) indicator built once per tree;
+    - matmul_chunk (bin_oh None, feat_of given): the same MXU
+      contraction with the indicator REBUILT per bin block by gather +
+      compare, bounding the transient to ~_HIST_CHUNK_ELEMS — the
+      big-n mode where the whole (n, TB) indicator would blow HBM
+      (BASELINE.md roofline);
     - pallas (bin_oh given): same contraction as one fused Pallas
       kernel with the accumulator VMEM-resident (models/pallas_hist.py).
     """
     n, d = packed.shape
     s_dim = stats.shape[1]
+    if mode == "matmul_chunk":
+        slot_oh = jax.nn.one_hot(slot, num_slots, dtype=stats.dtype)
+        # per-block transient ≈ n * step elements; the floor of 8 bins
+        # keeps blocks from degenerating, so the true bound is
+        # max(_HIST_CHUNK_ELEMS, 8n) elements — still linear in n, the
+        # unavoidable cost of materializing any (n, block) indicator
+        step = max(8, min(total_bins,
+                          _HIST_CHUNK_ELEMS // max(n, 1)))
+        parts = []
+        for lo in range(0, total_bins, step):
+            hi = min(lo + step, total_bins)
+            oh = _bin_indicator(packed, total_bins, stats.dtype,
+                                feat_of, lo, hi)
+            parts.append(jnp.einsum("nc,ns,nb->cbs", slot_oh, stats, oh))
+        hist = jnp.concatenate(parts, axis=1)
+        return (jax.lax.psum(hist, axis_name) if axis_name else hist)
     if bin_oh is not None:
         if mode == "pallas":
             from transmogrifai_tpu.models.pallas_hist import (
@@ -367,20 +402,22 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     # points MUST pin it (static arg) or mode switches won't retrace
     hist_mode = hist_mode or _hist_mode(n, TB)
     if hist_mode == "matmul_bf16":
-        bin_oh = _bin_indicator(packed, TB, jnp.bfloat16)
+        bin_oh = _bin_indicator(packed, TB, jnp.bfloat16, feat_of)
     elif hist_mode in ("matmul", "pallas"):
         ind_gb = n * TB * jnp.dtype(stats.dtype).itemsize / 2 ** 30
         if ind_gb > 4.0:
             # the (n, TB) indicator is re-read every level; at this
-            # size it dominates HBM (BASELINE.md roofline) — bf16
-            # operands halve it with fp32 accumulation
+            # size it dominates HBM (BASELINE.md roofline) —
+            # matmul_chunk rebuilds it per bin block instead, and bf16
+            # operands halve it
             _log.warning(
                 "matmul histogram indicator is %.1f GiB (%d rows x %d "
-                "packed bins, %s); consider TX_TREE_HIST=matmul_bf16",
-                ind_gb, n, TB, jnp.dtype(stats.dtype).name)
-        bin_oh = _bin_indicator(packed, TB, stats.dtype)
+                "packed bins, %s); consider TX_TREE_HIST=matmul_chunk "
+                "or matmul_bf16", ind_gb, n, TB,
+                jnp.dtype(stats.dtype).name)
+        bin_oh = _bin_indicator(packed, TB, stats.dtype, feat_of)
     else:
-        bin_oh = None
+        bin_oh = None                # scatter / matmul_chunk modes
     key = feat_key
     for level in range(depth):
         # identity fast path: while every within-level node id fits the
@@ -407,7 +444,8 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
             else:
                 slot, node_of_slot, active = _compress_nodes(node, C)
         hist = _level_histograms(packed, slot, stats, C, TB, bin_oh,
-                                 mode=hist_mode, axis_name=axis_name)
+                                 mode=hist_mode, axis_name=axis_name,
+                                 feat_of=feat_of)
         cs = jnp.cumsum(hist, axis=1)              # packed-axis running sum
         # per-feature segmented cumsum: subtract the running sum at the
         # owning block's start; splitting at bin b sends bins<=b left
@@ -698,7 +736,7 @@ def _tree_block_size(n: int, total_bins: int, depth: int, s_dim: int,
     cap = min(n, _DEFAULT_NODE_CAP)
     c_max = min(2 ** max(depth - 1, 0), cap)
     per_tree = 2 * n * 8 + 2 * c_max * total_bins * s_dim * 8
-    if hist_mode in ("matmul", "pallas", "matmul_bf16"):
+    if hist_mode in ("matmul", "pallas", "matmul_bf16", "matmul_chunk"):
         # the (n, c_max) slot one-hot is the dominant per-tree transient
         # of the einsum strategy at depth
         per_tree += n * c_max * 8
