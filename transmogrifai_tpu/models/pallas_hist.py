@@ -13,12 +13,14 @@ einsum, which materializes the (n, C*S) slot-weighted stats intermediate
 in HBM every level and streams the (n, TB) bin indicator past it. This
 kernel fuses both contractions into a single pass over row blocks:
 
-  - the (CS, TB_tile) accumulator lives in VMEM for the whole row loop
-    (grid iterates row blocks fastest, so the revisited output block
-    never leaves the chip);
+  - the (S * C_pad, TB_tile) accumulator lives in VMEM for the whole
+    row loop (grid iterates row blocks fastest, so the revisited
+    output block never leaves the chip);
   - each step builds the slot one-hot for its row block on the VPU
-    (iota compare — no scatter) and issues one MXU contraction
-    ``combined^T @ bin_oh_block``;
+    (iota compare — no scatter) and issues one MXU contraction per
+    statistic: ``(slot_oh * stats[:, s])^T @ bin_oh_block`` into the
+    s-th accumulator row block (the S axis is statically unrolled —
+    see _hist_kernel for why no (R, C*S) interleaved operand exists);
   - nothing of size O(n * C) ever touches HBM.
 
 Numerics match the einsum: float32 operands, float32 MXU accumulation,
@@ -45,8 +47,8 @@ except Exception:  # pragma: no cover - exotic builds
 
 __all__ = ["pallas_level_hist"]
 
-#: rows per grid step — one (R, TB_tile) indicator block + one
-#: (R, CS) combined block in VMEM per step
+#: rows per grid step — one (R, TB_tile) indicator block + two
+#: (R, C_pad) temporaries (slot one-hot, per-s product) in VMEM per step
 _ROW_BLOCK = 512
 #: packed-bin tile width (lane-aligned); TB above this adds grid steps
 _TB_TILE = 2048
@@ -62,14 +64,20 @@ def _round_up(x: int, m: int) -> int:
 def _plan_tiles(CS_pad: int, S: int, TB: int):
     """(R, TB_tile) such that the VMEM working set
     acc(CS_pad x TB_tile) + 2x double-buffered inputs
-    (R x TB_tile indicator, R x CS_pad combined, R x (S+1) stats+slot)
-    stays under _VMEM_BUDGET; None if no tiling fits (huge C*S — the
-    caller falls back to the XLA einsum, which HBM-streams instead)."""
+    (R x TB_tile indicator, R x CS_pad/S one-hot + per-s product,
+    R x (S+1) stats+slot) stays under _VMEM_BUDGET; None if no tiling
+    fits (huge C*S — the caller falls back to the XLA einsum, which
+    HBM-streams instead). ``CS_pad`` is the accumulator height
+    S * C_pad (s-major row blocks, see _hist_kernel)."""
     R, TB_tile = _ROW_BLOCK, min(_round_up(TB, 128), _TB_TILE)
+    C_pad = CS_pad // S
 
     def fits(r, tbt):
-        return 4 * (CS_pad * tbt + 2 * r * (tbt + CS_pad + S + 1)) \
-            <= _VMEM_BUDGET
+        # acc + double-buffered inputs (indicator, stats+slot) + the
+        # kernel's two (R, C_pad) temporaries (slot one-hot, per-s
+        # product) — the unrolled kernel never materializes (R, CS_pad)
+        return 4 * (CS_pad * tbt + 2 * r * (tbt + S + 1)
+                    + 2 * r * C_pad) <= _VMEM_BUDGET
 
     while not fits(R, TB_tile) and TB_tile > 128:
         TB_tile //= 2
@@ -78,10 +86,20 @@ def _plan_tiles(CS_pad: int, S: int, TB: int):
     return (R, TB_tile) if fits(R, TB_tile) else None
 
 
-def _hist_kernel(slot_ref, stats_ref, binoh_ref, out_ref, *, C: int,
-                 CS_pad: int):
+def _hist_kernel(slot_ref, stats_ref, binoh_ref, out_ref, *,
+                 C_pad: int):
     """One (TB tile, row block) grid step; row blocks iterate fastest so
-    ``out_ref`` stays VMEM-resident while a tile accumulates."""
+    ``out_ref`` stays VMEM-resident while a tile accumulates.
+
+    The per-stat contractions are unrolled over the (tiny, static) S
+    axis: ``comb_s = slot_oh * stats[:, s]`` then one MXU dot per s
+    into the ``[s*C_pad, (s+1)*C_pad)`` row block of the accumulator.
+    An earlier draft built one (R, C*S) interleaved operand via a 3D
+    broadcast-multiply + reshape; that lowering requires a Mosaic
+    relayout compiled through a secondary TPU compile service, which
+    the axon tunnel's env-scrubbed helper cannot run (observed HTTP
+    500 `tpu_compile_helper` failures on real v5e) — the unrolled form
+    compiles inline everywhere and runs the same MXU contractions."""
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -90,17 +108,16 @@ def _hist_kernel(slot_ref, stats_ref, binoh_ref, out_ref, *, C: int,
 
     stats = stats_ref[:]                       # (R, S) f32
     R, S = stats.shape
-    cls = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
-    slot_oh = (cls == slot_ref[:]).astype(stats.dtype)      # (R, C)
-    combined = (slot_oh[:, :, None] * stats[:, None, :]).reshape(R, C * S)
-    if CS_pad != C * S:
-        combined = jnp.concatenate(
-            [combined,
-             jnp.zeros((R, CS_pad - C * S), combined.dtype)], axis=1)
-    out_ref[:] += jax.lax.dot_general(
-        combined, binoh_ref[:],
-        (((0,), (0,)), ((), ())),              # contract over rows
-        preferred_element_type=jnp.float32)
+    cls = jax.lax.broadcasted_iota(jnp.int32, (R, C_pad), 1)
+    # slots are < C, so the C..C_pad padding columns are zero for free
+    slot_oh = (cls == slot_ref[:]).astype(stats.dtype)      # (R, C_pad)
+    binoh = binoh_ref[:]
+    for s in range(S):                         # static unroll (S <= 4)
+        comb = slot_oh * stats[:, s][:, None]               # (R, C_pad)
+        out_ref[s * C_pad:(s + 1) * C_pad, :] += jax.lax.dot_general(
+            comb, binoh,
+            (((0,), (0,)), ((), ())),          # contract over rows
+            preferred_element_type=jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "interpret"))
@@ -129,7 +146,8 @@ def pallas_level_hist(bin_oh: jnp.ndarray, slot: jnp.ndarray,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    CS_pad = _round_up(C * S, 8)
+    C_pad = _round_up(C, 8)
+    CS_pad = C_pad * S
     plan = _plan_tiles(CS_pad, S, TB)
     if plan is None:  # pragma: no cover - needs enormous C*S
         # accumulator cannot fit VMEM at any tile size: stream via the
@@ -158,7 +176,7 @@ def pallas_level_hist(bin_oh: jnp.ndarray, slot: jnp.ndarray,
     vmem = (pltpu.VMEM if (_HAVE_PLTPU and not interpret)
             else pl.ANY)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, C=C, CS_pad=CS_pad),
+        functools.partial(_hist_kernel, C_pad=C_pad),
         grid=grid,
         in_specs=[
             pl.BlockSpec((R, 1), lambda i, j: (j, 0), memory_space=vmem),
@@ -171,5 +189,7 @@ def pallas_level_hist(bin_oh: jnp.ndarray, slot: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((CS_pad, TB_pad), f32),
         interpret=interpret,
     )(slot2d, stats, bin_oh)
-    # rows are laid out c*S + s
-    return out[:C * S, :TB].reshape(C, S, TB).transpose(0, 2, 1)
+    # rows are laid out s-major: block s holds slots [0, C_pad), of
+    # which the first C are real
+    return (out[:, :TB].reshape(S, C_pad, TB)[:, :C, :]
+            .transpose(1, 2, 0))

@@ -84,6 +84,64 @@ __all__ = [
 # packed axis.
 
 
+#: edge-matrix element count (edge rows x features) above which the
+#: auto binning mode moves quantile binning onto the accelerator: the
+#: per-feature host loop (np.unique + np.quantile + searchsorted, all
+#: f64 sorts) measured ~48 s at 1M x 100 vs ~4.5 s for the entire warm
+#: device GBT fit it feeds (BASELINE.md r5)
+_DEVICE_BIN_MIN_ELEMS = int(os.environ.get("TX_DEVICE_BIN_MIN_ELEMS",
+                                           "4000000"))
+
+
+def _binning_mode() -> str:
+    """Where quantile bin edges + digitization run: "host" (the exact
+    f64 numpy per-feature loop), "device" (f32 column sorts + quantile
+    gathers + compare-sum digitize, one XLA program set), or "auto"
+    (default): device when an accelerator backend is active and the
+    edge matrix is >= _DEVICE_BIN_MIN_ELEMS elements. The device path
+    deviates from host only in f32 arithmetic (edges can shift ~1 ulp
+    around ties); small fits and CPU runs keep host binning bit-exact.
+    TX_TREE_BINNING overrides."""
+    mode = os.environ.get("TX_TREE_BINNING", "auto")
+    return mode if mode in ("host", "device") else "auto"
+
+
+@jax.jit
+def _device_sort_stats(E: jnp.ndarray):
+    """Column-sorted copy + per-column unique count of the edge-row
+    matrix — the device half of width/edge estimation."""
+    s = jnp.sort(E, axis=0)
+    uniq = 1 + jnp.sum(jnp.diff(s, axis=0) != 0, axis=0)
+    return s, uniq
+
+
+@jax.jit
+def _device_edge_gather(sT: jnp.ndarray, lo: jnp.ndarray,
+                        frac: jnp.ndarray) -> jnp.ndarray:
+    """np.quantile's linear interpolation, vectorized: value at sorted
+    position ``lo + frac`` per (feature, interior-quantile)."""
+    m = sT.shape[1]
+    vlo = jnp.take_along_axis(sT, lo, axis=1)
+    vhi = jnp.take_along_axis(sT, jnp.minimum(lo + 1, m - 1), axis=1)
+    return vlo + frac * (vhi - vlo)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _device_digitize(Xp: jnp.ndarray, edges: jnp.ndarray,
+                     chunk: int) -> jnp.ndarray:
+    """searchsorted(edges_f, x, side="left") for every feature column:
+    the bin index is the count of that feature's edges strictly below
+    x (+inf padding never counts). Row-chunked via lax.map so the
+    (chunk, d, max_width) compare transient stays bounded."""
+    k = Xp.shape[0] // chunk
+
+    def one(xb):
+        return jnp.sum(xb[:, :, None] > edges[None], axis=-1,
+                       dtype=jnp.int32)
+    return jax.lax.map(one, Xp.reshape(k, chunk, -1)).reshape(
+        Xp.shape[0], -1)
+
+
 class _PackedDesign:
     """Host-prepared binning of a feature matrix (one per fit).
 
@@ -106,11 +164,50 @@ class _PackedDesign:
         rows (the fold-train rows under ``TX_TREE_EDGES=fold``) while
         still binning every row of ``X`` — out-of-fold rows never
         influence where the splits can fall."""
+        n, d = np.asarray(X).shape
+        e_rows = n if edge_rows is None else len(edge_rows)
+        mode = _binning_mode()
+        use_device = mode == "device" or (
+            mode == "auto" and e_rows * d >= _DEVICE_BIN_MIN_ELEMS
+            and jax.default_backend() != "cpu")
+        if use_device:
+            thr_parts, widths, binned = self._bin_device(
+                X, max_bins, edge_rows)
+        else:
+            thr_parts, widths, binned = self._bin_host(
+                X, max_bins, edge_rows)
+        offsets = np.concatenate([[0], np.cumsum(widths)[:-1]]).astype(np.int32)
+        self.n, self.d = n, d
+        self.total_bins = int(np.sum(widths))
+        #: (n, d) per-feature bin ids (uniform addressing for feature-
+        #: pool gathers) and (d, max_width) per-feature thresholds
+        #: (+inf padded = not-a-split). Device-binned designs keep the
+        #: two (n, d) matrices as DEVICE arrays — their only consumer
+        #: (_design_args) re-uploads host copies otherwise, and a
+        #: 1M x 100 int32 round-trip through a remote-TPU tunnel is
+        #: pure waste.
+        self.binned = binned
+        self.widths = np.asarray(widths, dtype=np.int64)
+        self.max_width = int(max(widths))
+        self.col_thr = np.full((d, self.max_width), np.inf)
+        for f in range(d):
+            t = thr_parts[f]
+            self.col_thr[f, :len(t)] = t
+        self.packed = binned + (jnp.asarray(offsets[None, :])
+                                if isinstance(binned, jnp.ndarray)
+                                else offsets[None, :])
+        self.feat_of = np.repeat(np.arange(d, dtype=np.int32), widths)
+        self.block_start = np.repeat(offsets, widths)
+        self.packed_thr = np.concatenate(thr_parts)
+
+    @staticmethod
+    def _bin_host(X: np.ndarray, max_bins: int,
+                  edge_rows: Optional[np.ndarray]):
+        """Exact f64 per-feature binning (the reference semantics)."""
         X = np.asarray(X, dtype=np.float64)
-        n, d = X.shape
         E = X if edge_rows is None else X[edge_rows]
         binned_cols, thr_parts, widths = [], [], []
-        for f in range(d):
+        for f in range(X.shape[1]):
             col = E[:, f]
             uniq = np.unique(col)
             if uniq.size <= 2:
@@ -130,23 +227,57 @@ class _PackedDesign:
                                 side="left").astype(np.int32))
             thr_parts.append(np.concatenate([edges, [np.inf]]))
             widths.append(width)
-        offsets = np.concatenate([[0], np.cumsum(widths)[:-1]]).astype(np.int32)
-        self.n, self.d = n, d
-        self.total_bins = int(np.sum(widths))
-        #: (n, d) per-feature bin ids (uniform addressing for feature-
-        #: pool gathers) and (d, max_width) per-feature thresholds
-        #: (+inf padded = not-a-split)
-        self.binned = np.stack(binned_cols, axis=1)
-        self.widths = np.asarray(widths, dtype=np.int64)
-        self.max_width = int(max(widths))
-        self.col_thr = np.full((d, self.max_width), np.inf)
+        return thr_parts, widths, np.stack(binned_cols, axis=1)
+
+    @staticmethod
+    def _bin_device(X, max_bins: int, edge_rows: Optional[np.ndarray]):
+        """f32 device binning: one column sort + unique count, one
+        quantile-interpolation gather, one chunked compare-sum
+        digitize — same width/edge/dedup semantics as _bin_host, with
+        only small (d,)-shaped metadata crossing to the host."""
+        Xd = jnp.asarray(X, jnp.float32)
+        n, d = Xd.shape
+        Ed = Xd if edge_rows is None else Xd[jnp.asarray(edge_rows)]
+        m = int(Ed.shape[0])
+        s, uniq_d = _device_sort_stats(Ed)
+        uniq = np.asarray(uniq_d)
+        widths = np.where(
+            uniq <= 2, 2,
+            np.clip(np.exp2(np.ceil(np.log2(np.maximum(uniq, 2)))),
+                    4, max_bins)).astype(np.int64)
+        maxw = int(widths.max())
+        # interior quantile positions (host f64 math on (d, maxw-1)
+        # metadata; only the value gather runs in f32)
+        j = np.arange(max(maxw - 1, 1))
+        q = (j[None, :] + 1) / widths[:, None].astype(np.float64)
+        h = np.clip(q, 0.0, 1.0) * (m - 1)
+        lo = np.floor(h).astype(np.int32)
+        edges = np.asarray(_device_edge_gather(
+            s.T, jnp.asarray(lo),
+            jnp.asarray((h - lo).astype(np.float32))), np.float64)
+        colmin = np.asarray(s[0])
+        thr_parts: List[np.ndarray] = []
         for f in range(d):
-            t = thr_parts[f]
-            self.col_thr[f, :len(t)] = t
-        self.packed = self.binned + offsets[None, :]
-        self.feat_of = np.repeat(np.arange(d, dtype=np.int32), widths)
-        self.block_start = np.repeat(offsets, widths)
-        self.packed_thr = np.concatenate(thr_parts)
+            w = int(widths[f])
+            if uniq[f] <= 2:
+                e = colmin[f:f + 1]
+            else:
+                e = np.unique(edges[f, :w - 1])
+                if e.size < w - 1:                   # dedup left empty bins
+                    e = np.concatenate(
+                        [e, np.full(w - 1 - e.size, np.inf)])
+            thr_parts.append(np.concatenate([e, [np.inf]]))
+        col_edges = np.full((d, maxw), np.inf)
+        for f in range(d):
+            t = thr_parts[f][:-1]                    # real edges only
+            col_edges[f, :len(t)] = t
+        chunk = max(256, min(n, _HIST_CHUNK_ELEMS // max(d * maxw, 1)))
+        n_pad = -(-n // chunk) * chunk
+        Xp = (jnp.pad(Xd, ((0, n_pad - n), (0, 0)))
+              if n_pad != n else Xd)
+        binned = _device_digitize(
+            Xp, jnp.asarray(col_edges, jnp.float32), chunk)[:n]
+        return thr_parts, list(widths), binned
 
 
 # ---------------------------------------------------------------------------
@@ -1727,8 +1858,13 @@ def _design_args(X: np.ndarray, max_bins: int,
     """Host-bin X and return ((packed, feat_of, block_start, packed_thr,
     binned, col_thr) device arrays, widths host array). ``edge_rows``
     restricts quantile-edge estimation (TX_TREE_EDGES=fold)."""
+    # the binning-mode env var joins the key: a TX_TREE_BINNING toggle
+    # between fits on the same matrix must not serve the other mode's
+    # cached design (the auto decision is pure in X/backend, so the
+    # env value is the only extra degree of freedom)
     key = (id(X), getattr(X, "shape", None), max_bins,
-           None if edge_rows is None else id(edge_rows))
+           None if edge_rows is None else id(edge_rows),
+           _binning_mode())
     hit = _DESIGN_CACHE.get(key)
     if hit is not None and hit[0] is X and hit[1] is edge_rows:
         _DESIGN_CACHE.move_to_end(key)
@@ -1765,18 +1901,24 @@ def _depth_mode() -> str:
       the default grids (flagship: 6 -> 2 programs) at the price of
       shallow lanes running the deep lane's masked levels.
 
-    Measured (BASELINE.md r5): identical metrics; on single-core CPU
-    the flagship search ran 97 s static vs 380 s mask warm — compute
-    inflation swamps the saved compiles, so static is the default
-    everywhere until the trade is measured on a real TPU (where the
-    inflation is larger still under matmul histograms — per-level cost
-    scales with the slot count — but compiles cost 100+ s). mask is
-    the cold-start lever (VERDICT r4 #3): flip TX_TREE_DEPTH=mask when
-    first-result latency matters more than steady-state throughput."""
+    Measured (BASELINE.md r5): identical metrics on both backends, but
+    the winner flips with the platform. Single-core CPU flagship: 97 s
+    static vs 380 s mask warm — the masked-level compute inflation
+    swamps the saved compiles. REAL TPU v5e flagship: 38.3 s static vs
+    **18.2 s mask warm (7.9 vs 3.8 models×folds/s)** — the TPU search
+    is dispatch-bound (device busy <10% under static), so folding the
+    whole depth sweep into one fat program per family wins 2.1× on top
+    of cutting compiles 3× (6 -> 2). Hence the auto default: mask on
+    accelerators, static on CPU (same split _hist_mode uses).
+    TX_TREE_DEPTH overrides."""
     mode = os.environ.get("TX_TREE_DEPTH")
     if mode in ("mask", "static"):
         return mode
-    return "static"
+    try:
+        platform = jax.default_backend()
+    except Exception:  # pragma: no cover - defensive
+        platform = "cpu"
+    return "static" if platform == "cpu" else "mask"
 
 
 #: (kernel kind, statics, call shape) triples seen — each is one XLA
