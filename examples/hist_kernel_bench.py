@@ -99,13 +99,19 @@ def main() -> None:
             oh_build_s = None
             if with_oh:
                 dt = jnp.bfloat16 if mode == "matmul_bf16" else jnp.float32
+                oh = build_oh(packed_d, dt)        # cold: trace+compile
+                oh.block_until_ready()
+                # warm per-tree build cost: same dependency-chain +
+                # final-fetch discipline as the level timing below —
+                # un-chained identical launches were served early/cached
+                # through the remote tunnel
+                pk = packed_d + oh[0, 0].astype(packed_d.dtype) * 0
                 t0 = time.perf_counter()
-                oh = build_oh(packed_d, dt)
-                oh.block_until_ready()
                 for _ in range(3):
-                    oh = build_oh(packed_d, dt)
-                oh.block_until_ready()
-                oh_build_s = (time.perf_counter() - t0) / 4
+                    oh = build_oh(pk, dt)
+                    pk = packed_d + oh[0, 0].astype(packed_d.dtype) * 0
+                float(oh[0, 0].astype(jnp.float32))
+                oh_build_s = (time.perf_counter() - t0) / 3
             t0 = time.perf_counter()
             out = level(packed_d, slot_d, stats_d, oh, mode=mode)
             float(out[0, 0, 0])

@@ -44,6 +44,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -1851,6 +1852,10 @@ def _resolve_max_features(strategy: str, d: int, classification: bool
 #: the keyed arrays keep their id()s valid while cached.
 _DESIGN_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
 _DESIGN_CACHE_SIZE = 8
+#: the validator dispatches tree families from separate threads
+#: (TX_ASYNC_FAMILIES); one lock makes the memo race-free AND keeps a
+#: shared matrix binned once instead of once per family
+_DESIGN_LOCK = threading.Lock()
 
 
 def _design_args(X: np.ndarray, max_bins: int,
@@ -1865,20 +1870,21 @@ def _design_args(X: np.ndarray, max_bins: int,
     key = (id(X), getattr(X, "shape", None), max_bins,
            None if edge_rows is None else id(edge_rows),
            _binning_mode())
-    hit = _DESIGN_CACHE.get(key)
-    if hit is not None and hit[0] is X and hit[1] is edge_rows:
-        _DESIGN_CACHE.move_to_end(key)
-        return hit[2]
-    design = _PackedDesign(X, max_bins, edge_rows=edge_rows)
-    args = ((jnp.asarray(design.packed), jnp.asarray(design.feat_of),
-             jnp.asarray(design.block_start),
-             jnp.asarray(design.packed_thr),
-             jnp.asarray(design.binned), jnp.asarray(design.col_thr)),
-            design.widths)
-    _DESIGN_CACHE[key] = (X, edge_rows, args)
-    while len(_DESIGN_CACHE) > _DESIGN_CACHE_SIZE:
-        _DESIGN_CACHE.popitem(last=False)
-    return args
+    with _DESIGN_LOCK:
+        hit = _DESIGN_CACHE.get(key)
+        if hit is not None and hit[0] is X and hit[1] is edge_rows:
+            _DESIGN_CACHE.move_to_end(key)
+            return hit[2]
+        design = _PackedDesign(X, max_bins, edge_rows=edge_rows)
+        args = ((jnp.asarray(design.packed), jnp.asarray(design.feat_of),
+                 jnp.asarray(design.block_start),
+                 jnp.asarray(design.packed_thr),
+                 jnp.asarray(design.binned), jnp.asarray(design.col_thr)),
+                design.widths)
+        _DESIGN_CACHE[key] = (X, edge_rows, args)
+        while len(_DESIGN_CACHE) > _DESIGN_CACHE_SIZE:
+            _DESIGN_CACHE.popitem(last=False)
+        return args
 
 
 def _fold_edges_mode() -> bool:

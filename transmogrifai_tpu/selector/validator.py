@@ -14,6 +14,7 @@ reference's per-fold / per-family ``Future`` task parallelism maps to:
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -179,10 +180,36 @@ class _ValidatorBase:
             X_val_st = np.stack([fd[2] for fd in fold_data])
             y_val_st = np.stack([fd[3] for fd in fold_data])
         results: List[ValidationResult] = []
-        for estimator, grid in models:
-            grid = list(grid) or [{}]
-            mm = self._try_device_eval(estimator, grid, X, y, masks,
-                                       X_val_st, y_val_st, spec)
+        models = [(est, list(grid) or [{}]) for est, grid in models]
+        # dispatch every family's device kernel BEFORE fetching any
+        # result: each kernel ends in a blocking device->host fetch, so
+        # a sequential loop would stall family B's dispatch on family
+        # A's transfer. Threads overlap host orchestration + transfers
+        # with on-chip compute (the chip still serializes the programs);
+        # JAX tracing/dispatch is thread-safe and the shared binning
+        # memo in models/trees serializes under its own lock.
+        # size guard: concurrent dispatch keeps EVERY family's input
+        # buffers + intermediates resident at once — at search sizes
+        # that's noise, but a huge matrix could push peak HBM past the
+        # chip where the sequential loop (family A freed before B
+        # uploads) would have fit. Beyond the cap, dispatch sequentially.
+        async_cap = int(os.environ.get("TX_ASYNC_FAMILIES_MAX_BYTES",
+                                       256 * 1024 * 1024))
+        if (len(models) > 1 and spec is not None
+                and getattr(X, "nbytes", 0) <= async_cap
+                and os.environ.get("TX_ASYNC_FAMILIES", "1") != "0"):
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(models)) as ex:
+                futures = [
+                    ex.submit(self._try_device_eval, est, grid, X, y,
+                              masks, X_val_st, y_val_st, spec)
+                    for est, grid in models]
+                device_mm = [f.result() for f in futures]
+        else:
+            device_mm = [self._try_device_eval(est, grid, X, y, masks,
+                                               X_val_st, y_val_st, spec)
+                         for est, grid in models]
+        for (estimator, grid), mm in zip(models, device_mm):
             if mm is not None:
                 results.extend(self._results_from_matrix(
                     estimator, grid, mm))
