@@ -35,6 +35,11 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=200_000)
     ap.add_argument("--cols", type=int, default=100)
+    ap.add_argument("--reps", type=int, default=0,
+                    help="measurement passes (default: 2 on "
+                         "accelerators — cold then warm — and 1 on "
+                         "CPU); each pass re-uploads X so warm passes "
+                         "time warm PROGRAMS, not cached designs")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend (the env may register a "
                          "remote TPU platform that wins over "
@@ -73,30 +78,70 @@ def main() -> None:
         "TX_PEAK_TFLOPS",
         "197" if jax.default_backend() == "tpu" else "0.1"))
 
-    for name, est, units, s_dim, depth in [
+    # phase split (accelerators): a remote/tunneled device charges the
+    # raw host->device copy of X to whoever uploads it — measure it
+    # once, hand every fit the DEVICE-RESIDENT matrix, and report both
+    # end-to-end-from-host and device-resident throughput. On a local
+    # TPU host the transfer is DMA-fast and the two converge; on CPU
+    # the host matrix is kept so binning stays the exact f64 path.
+    from transmogrifai_tpu.models.trees import clear_design_cache
+    reps = args.reps or (1 if jax.default_backend() == "cpu" else 2)
+    for rep in range(reps):
+      if rep:
+        # drop the previous pass's memoized design so (a) this pass
+        # re-times a REAL binning and (b) stale passes' device buffers
+        # don't accumulate in HBM across --reps
+        clear_design_cache()
+      transfer_s = None
+      # fresh array identity per pass — the design memo keys on id(X)
+      X_in = X if rep == 0 else X.copy()
+      if jax.default_backend() != "cpu":
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        X_in = jnp.asarray(X, jnp.float32)
+        X_in.block_until_ready()
+        transfer_s = time.perf_counter() - t0
+
+      for name, est, units, s_dim, depth in [
         ("gbt_20rounds_d6",
          GBTClassifier(num_rounds=20, max_depth=6), 20, 2, 6),
         ("rf_50trees_d6",
          RandomForestClassifier(num_trees=50, max_depth=6,
                                 min_instances_per_node=10), 50, 2, 6),
-    ]:
+      ]:
         t0 = time.perf_counter()
-        model = est.fit_arrays(X, y)
-        fit_s = time.perf_counter() - t0
+        _design_args(X_in, est.max_bins)   # shared across both models
+        bin_s = time.perf_counter() - t0   # ~0 on the memo hit
+        t0 = time.perf_counter()
+        model = est.fit_arrays(X_in, y)
+        fit_only_s = time.perf_counter() - t0
+        # device-resident headline: binning + fit, X already on chip;
+        # the separately-reported transfer covers the from-host story
+        fit_s = bin_s + fit_only_s
         t0 = time.perf_counter()
         pred = model.predict_arrays(X[:50_000])
         score_s = time.perf_counter() - t0
         acc = float(np.mean(pred.data == y[:50_000]))
         # _design_args memoizes on (X identity, max_bins): this hits the
         # cache the fit itself populated — no re-binning
-        _, widths = _design_args(X, est.max_bins)
+        _, widths = _design_args(X_in, est.max_bins)
         tb = int(np.sum(widths))
         gflop = hist_flops(args.rows, tb, depth, units, s_dim) / 1e9
         mfu = gflop / 1e3 / max(fit_s, 1e-9) / peak_tflops * 100.0
-        print(json.dumps({
-            "model": name, "rows": args.rows, "cols": args.cols,
+        row = {
+            "model": name, "pass": rep + 1,
+            "rows": args.rows, "cols": args.cols,
             "fit_seconds": round(fit_s, 2),
             "fit_rows_per_sec": round(args.rows / fit_s),
+            "bin_seconds": round(bin_s, 2),
+            "fit_only_seconds": round(fit_only_s, 2),
+        }
+        if transfer_s is not None:
+            row["transfer_seconds"] = round(transfer_s, 2)
+            row["end_to_end_rows_per_sec"] = round(
+                args.rows / (transfer_s + fit_s))
+        print(json.dumps({
+            **row,
             "score_rows_per_sec": round(50_000 / max(score_s, 1e-9)),
             "train_subset_acc": round(acc, 4),
             "hist_gflop_est": round(gflop, 1),

@@ -165,7 +165,7 @@ class _PackedDesign:
         rows (the fold-train rows under ``TX_TREE_EDGES=fold``) while
         still binning every row of ``X`` — out-of-fold rows never
         influence where the splits can fall."""
-        n, d = np.asarray(X).shape
+        n, d = X.shape          # numpy or device array — never download
         e_rows = n if edge_rows is None else len(edge_rows)
         mode = _binning_mode()
         use_device = mode == "device" or (
@@ -1856,6 +1856,15 @@ _DESIGN_CACHE_SIZE = 8
 #: (TX_ASYNC_FAMILIES); one lock makes the memo race-free AND keeps a
 #: shared matrix binned once instead of once per family
 _DESIGN_LOCK = threading.Lock()
+
+
+def clear_design_cache() -> None:
+    """Drop every memoized binned design (and the device buffers each
+    pins). Benchmarks re-measuring binning on fresh uploads of the same
+    matrix call this between passes so stale passes' working sets don't
+    accumulate in HBM."""
+    with _DESIGN_LOCK:
+        _DESIGN_CACHE.clear()
 
 
 def _design_args(X: np.ndarray, max_bins: int,
