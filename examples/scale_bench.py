@@ -93,8 +93,10 @@ def main() -> None:
         # don't accumulate in HBM across --reps
         clear_design_cache()
       transfer_s = None
-      # fresh array identity per pass — the design memo keys on id(X)
-      X_in = X if rep == 0 else X.copy()
+      # fresh array identity per CPU pass — the design memo keys on
+      # id(X); accelerator passes get a fresh device buffer below
+      X_in = X if (rep == 0 or jax.default_backend() != "cpu") \
+          else X.copy()
       if jax.default_backend() != "cpu":
         import jax.numpy as jnp
         t0 = time.perf_counter()

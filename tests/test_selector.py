@@ -327,6 +327,35 @@ class TestBatchedEvaluation:
             np.testing.assert_allclose(rb.metric_values, rs.metric_values,
                                        rtol=1e-9)
 
+    def test_async_family_dispatch_equals_sequential(self, monkeypatch):
+        """Threaded per-family dispatch (TX_ASYNC_FAMILIES) must be a
+        pure scheduling change: identical metric matrices, identical
+        winner, identical result order vs the sequential loop."""
+        import numpy as np
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import (GBTClassifier,
+                                              LogisticRegression)
+        from transmogrifai_tpu.selector import CrossValidation
+        X, y = self._data()
+        pool = [(LogisticRegression(max_iter=30),
+                 [{"reg_param": 0.01}, {"reg_param": 0.1}]),
+                (GBTClassifier(num_rounds=5),
+                 [{"max_depth": 2}, {"max_depth": 3}])]
+        cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=3,
+                             seed=5)
+        monkeypatch.setenv("TX_ASYNC_FAMILIES", "1")
+        best_async = cv.validate(pool, X, y)
+        monkeypatch.setenv("TX_ASYNC_FAMILIES", "0")
+        best_sync = cv.validate(pool, X, y)
+        assert best_async.name == best_sync.name
+        assert best_async.params == best_sync.params
+        assert [r.model_name for r in best_async.results] == \
+            [r.model_name for r in best_sync.results]
+        for ra, rs in zip(best_async.results, best_sync.results):
+            np.testing.assert_array_equal(ra.metric_values,
+                                          rs.metric_values)
+
     def test_mlp_fold_batched_matches_sequential_winner(self):
         """The batched MLP kernel uses fixed-trip mini-batch Adam (a
         documented solver deviation from the sequential L-BFGS path —

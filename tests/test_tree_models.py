@@ -183,6 +183,66 @@ class TestHistogramModes:
                 np.testing.assert_allclose(a.leaves, b.leaves, rtol=1e-5,
                                            err_msg=other)
 
+    def test_hist_subtraction_matches_direct(self, rng, monkeypatch):
+        """LightGBM-style histogram subtraction (``+sub`` suffix,
+        models/trees._grow_tree): identity levels build LEFT-child
+        histograms only and derive right = parent - left. On data
+        without exact gain ties the trees are identical to the direct
+        build (ties may legitimately resolve to a different equal-gain
+        split — the documented opt-in caveat)."""
+        import numpy as np
+        from transmogrifai_tpu.models.trees import (GBTClassifier,
+                                                    RandomForestClassifier)
+        X = rng.normal(size=(300, 12))
+        y = (X[:, 0] * 2 - X[:, 1] > 0.2).astype(float)
+        fits = {}
+        for mode in ("scatter", "scatter+sub", "matmul", "matmul+sub"):
+            monkeypatch.setenv("TX_TREE_HIST", mode)
+            fits[mode] = (
+                # shallow + few rounds keeps every node large and every
+                # residual strong: tiny nodes / flattened late-round
+                # residuals carry exactly-tied gains whose argmax is
+                # legitimately 1-ulp-sensitive under subtraction
+                GBTClassifier(num_rounds=3, max_depth=3).fit_arrays(X, y),
+                RandomForestClassifier(num_trees=4, max_depth=4,
+                                       min_instances_per_node=25
+                                       ).fit_arrays(X, y))
+        # each base vs ITS OWN +sub variant (cross-base comparisons
+        # already differ by summation order — test_modes_agree's job)
+        for base in ("scatter", "matmul"):
+            for a, b in zip(fits[base], fits[base + "+sub"]):
+                np.testing.assert_array_equal(a.feats, b.feats,
+                                              err_msg=base)
+                np.testing.assert_allclose(a.thrs, b.thrs, rtol=1e-6,
+                                           err_msg=base)
+                np.testing.assert_allclose(a.leaves, b.leaves, rtol=1e-5,
+                                           err_msg=base)
+
+    def test_hist_subtraction_identity_any_assignment(self):
+        """The subtraction identity holds for ARBITRARY level-l node
+        assignments: hist(node) == interleave(hist_even,
+        hist(node >> 1) - hist_even) up to float reassociation."""
+        import jax.numpy as jnp
+        import numpy as np
+        from transmogrifai_tpu.models.trees import (_design_args,
+                                                    _level_histograms)
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(500, 5))
+        (packed, feat_of, *_), _ = _design_args(X, 16)
+        TB = int(feat_of.shape[0])
+        stats = jnp.asarray(rng.normal(size=(500, 2)))
+        node = jnp.asarray(rng.integers(0, 8, size=500), jnp.int32)
+        full = _level_histograms(packed, node, stats, 8, TB, None,
+                                 mode="scatter", feat_of=feat_of)
+        prev = _level_histograms(packed, node >> 1, stats, 4, TB, None,
+                                 mode="scatter", feat_of=feat_of)
+        even = _level_histograms(
+            packed, jnp.where((node & 1) == 0, node >> 1, 8), stats, 4,
+            TB, None, mode="scatter", feat_of=feat_of)
+        sub = jnp.stack([even, prev - even], axis=1).reshape(8, TB, 2)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(sub),
+                                   atol=1e-10)
+
     def test_mode_switch_retraces(self, rng, monkeypatch):
         """Regression test: TX_TREE_HIST used to be read at trace time
         only, so the second fit in a process silently reused the first

@@ -360,19 +360,36 @@ def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
     indicator per bin block (gather+compare, scatter-free) every level
     instead of holding the whole (n, TB) matrix — the big-n mode where
     that matrix would blow HBM.
+    A ``+sub`` suffix (any base mode) additionally enables LightGBM-
+    style histogram SUBTRACTION inside the level loop: identity levels
+    > 0 build histograms for LEFT children only (half the slots) and
+    derive each right child as parent - left — the parent histogram is
+    the previous level's, and the per-row stats are level-invariant
+    within a tree. Mathematically identical; float cancellation can
+    move near-tie splits, so it is opt-in (TX_TREE_SUB=1) until the
+    accuracy audit at scale. The suffix rides the SAME static
+    ``hist_mode`` string every jitted entry pins, so toggling it
+    retraces exactly like a base-mode switch.
+
     TX_TREE_HIST overrides. Decided at trace time (platform only for
     now — the n/total_bins parameters stay in the signature so a
     size-based policy can return without touching every call site), so
     all modes stay available side by side."""
+    base_modes = ("scatter", "matmul", "pallas", "matmul_bf16",
+                  "matmul_chunk")
+    sub = os.environ.get("TX_TREE_SUB", "0") == "1"
     mode = os.environ.get("TX_TREE_HIST")
-    if mode in ("scatter", "matmul", "pallas", "matmul_bf16",
-                "matmul_chunk"):
-        return mode
+    if mode:
+        base, plus, suffix = mode.partition("+")
+        if base in base_modes and (not plus or suffix == "sub"):
+            # TX_TREE_SUB composes with an explicit base mode too
+            return mode if suffix == "sub" or not sub else mode + "+sub"
     try:
         platform = jax.default_backend()
     except Exception:
         platform = "cpu"
-    return "matmul" if platform != "cpu" else "scatter"
+    mode = "matmul" if platform != "cpu" else "scatter"
+    return mode + "+sub" if sub else mode
 
 
 def _bin_indicator(packed: jnp.ndarray, total_bins: int, dtype,
@@ -533,6 +550,9 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     # resolved here only when the caller did not pin it; jitted entry
     # points MUST pin it (static arg) or mode switches won't retrace
     hist_mode = hist_mode or _hist_mode(n, TB)
+    sub_enabled = hist_mode.endswith("+sub")
+    if sub_enabled:
+        hist_mode = hist_mode[:-len("+sub")]
     if hist_mode == "matmul_bf16":
         bin_oh = _bin_indicator(packed, TB, jnp.bfloat16, feat_of)
     elif hist_mode in ("matmul", "pallas"):
@@ -551,6 +571,8 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
     else:
         bin_oh = None                # scatter / matmul_chunk modes
     key = feat_key
+    prev_hist = None        # previous level's (C_prev, TB, S) histogram
+    prev_identity = False
     for level in range(depth):
         # identity fast path: while every within-level node id fits the
         # slot cap AND the next level's budget mask cannot bind
@@ -575,9 +597,29 @@ def _grow_tree(packed: jnp.ndarray, feat_of: jnp.ndarray,
                     node, C, 2 ** level, axis_name)
             else:
                 slot, node_of_slot, active = _compress_nodes(node, C)
-        hist = _level_histograms(packed, slot, stats, C, TB, bin_oh,
-                                 mode=hist_mode, axis_name=axis_name,
-                                 feat_of=feat_of)
+        if (sub_enabled and identity and prev_identity
+                and prev_hist is not None):
+            # histogram subtraction (the LightGBM trick): rows routed
+            # left stayed even-numbered (`node = 2*node + (1-go_left)`),
+            # so build ONLY the left-child histograms — half the
+            # contraction — indexed by parent (slot >> 1); each right
+            # child is parent - left. Stats are level-invariant within
+            # a tree and bins never change, so prev_hist[p] IS the
+            # parent's full histogram. Odd-slot rows park on sentinel
+            # slot C (== 2*C_half): one_hot zeroes it, scatter drops
+            # it, and the Pallas [:num_slots] slice discards it.
+            C_half = C // 2
+            slot_sub = jnp.where((slot & 1) == 0, slot >> 1, C)
+            hist_even = _level_histograms(
+                packed, slot_sub, stats, C_half, TB, bin_oh,
+                mode=hist_mode, axis_name=axis_name, feat_of=feat_of)
+            hist = jnp.stack([hist_even, prev_hist - hist_even],
+                             axis=1).reshape(C, TB, stats.shape[1])
+        else:
+            hist = _level_histograms(packed, slot, stats, C, TB, bin_oh,
+                                     mode=hist_mode, axis_name=axis_name,
+                                     feat_of=feat_of)
+        prev_hist, prev_identity = hist, identity
         cs = jnp.cumsum(hist, axis=1)              # packed-axis running sum
         # per-feature segmented cumsum: subtract the running sum at the
         # owning block's start; splitting at bin b sends bins<=b left
@@ -868,7 +910,8 @@ def _tree_block_size(n: int, total_bins: int, depth: int, s_dim: int,
     cap = min(n, _DEFAULT_NODE_CAP)
     c_max = min(2 ** max(depth - 1, 0), cap)
     per_tree = 2 * n * 8 + 2 * c_max * total_bins * s_dim * 8
-    if hist_mode in ("matmul", "pallas", "matmul_bf16", "matmul_chunk"):
+    if hist_mode and hist_mode.split("+")[0] in (
+            "matmul", "pallas", "matmul_bf16", "matmul_chunk"):
         # the (n, c_max) slot one-hot is the dominant per-tree transient
         # of the einsum strategy at depth
         per_tree += n * c_max * 8
