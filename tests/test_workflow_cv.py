@@ -244,3 +244,41 @@ def test_insearch_balancing_flips_winner():
 
     assert run(None) == "_Weak"
     assert run(DataBalancer(sample_fraction=0.4, seed=3)) == "_BalancePicky"
+
+
+def test_r5_tree_flags_compose_end_to_end(rng, monkeypatch):
+    """The r5 tree flags — TX_TREE_DEPTH=mask, TX_TREE_EDGES=fold,
+    TX_TREE_SUB=1 — must compose: one end-to-end search with ALL of
+    them on, plus an in-search balancer, still trains, scores and
+    reaches sane quality. Combinations are where flag interactions
+    regress (each flag's own parity is covered by its unit tests)."""
+    from transmogrifai_tpu.models import GBTClassifier
+    from transmogrifai_tpu.selector.splitters import DataBalancer
+    monkeypatch.setenv("TX_TREE_DEPTH", "mask")
+    monkeypatch.setenv("TX_TREE_EDGES", "fold")
+    monkeypatch.setenv("TX_TREE_SUB", "1")
+    recs = []
+    for i in range(400):
+        y = float(rng.random() < 0.25)
+        recs.append({"x0": y * 1.5 + rng.normal(),
+                     "x1": y - 1.2 * rng.normal(),
+                     "x2": float(rng.normal()),
+                     "label": y})
+    label = FeatureBuilder.real_nn("label").extract(
+        lambda r: r["label"]).as_response()
+    xs = [FeatureBuilder.real(n).extract(
+        lambda r, n=n: r[n]).as_predictor() for n in ("x0", "x1", "x2")]
+    fv = transmogrify(xs)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, stratify=True,
+        splitter=DataBalancer(sample_fraction=0.4, seed=7),
+        models=[(GBTClassifier(num_rounds=4),
+                 [{"max_depth": 2}, {"max_depth": 3}])])
+    pred = selector.set_input(label, fv).get_output()
+    model = (Workflow().set_result_features(label, pred)
+             .set_input_records(recs).train())
+    sel = [s for s in model.stages() if isinstance(s, SelectedModel)][0]
+    assert np.isfinite(sel.summary.best_validation_metric)
+    assert sel.summary.best_validation_metric > 0.5   # AuPR >> 0.25 base
+    scored = model.score(recs[:20])
+    assert scored[pred.name].data.shape == (20,)
