@@ -137,3 +137,60 @@ class TestExternalWorkflowPersistence:
         loaded = load_model(path)
         after = loaded.score(recs)[pred.name].data
         np.testing.assert_array_equal(before, after)
+
+
+# -- a REAL third-party estimator: scikit-learn --------------------------
+# The adapter's point (reference SwUnaryTransformer: wrap ANY Spark
+# estimator) demonstrated against an actual foreign library. The fitted
+# state is exported to plain arrays, so persistence and scoring never
+# need sklearn again — the same "wrapped stage persists as data, not
+# pickled objects" rule the reference's SparkWrapperParams enforces via
+# its spark-stage save path.
+
+def sklearn_logreg_fit(X, y, C=1.0):
+    from sklearn.linear_model import LogisticRegression as SkLR
+    sk = SkLR(C=C, max_iter=200).fit(X, y)
+    return {"coef": sk.coef_[0], "intercept": sk.intercept_,
+            "classes": sk.classes_.astype(np.float64)}
+
+
+def sklearn_logreg_predict(state, X):
+    p = 1.0 / (1.0 + np.exp(-(X @ state["coef"] + state["intercept"][0])))
+    return np.stack([1.0 - p, p], axis=1)
+
+
+class TestSklearnThroughAdapter:
+    def test_sklearn_races_and_persists(self, tmp_path):
+        """An actual sklearn estimator goes through the selector race
+        AND workflow save/load with identical scores after reload."""
+        import pytest
+        pytest.importorskip("sklearn")
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import \
+            BinaryClassificationModelSelector
+        from transmogrifai_tpu.selector.selector import SelectedModel
+        from transmogrifai_tpu.workflow import Workflow, load_model
+        X, y = _data(n=120)
+        recs = [{"x%d" % j: float(X[i, j]) for j in range(X.shape[1])}
+                | {"label": float(y[i])} for i in range(len(y))]
+        label = FeatureBuilder.real_nn("label").extract(
+            lambda r: r["label"]).as_response()
+        xs = [FeatureBuilder.real("x%d" % j).extract(
+            lambda r, j=j: r["x%d" % j]).as_predictor()
+            for j in range(X.shape[1])]
+        sk = wrap_estimator(sklearn_logreg_fit, sklearn_logreg_predict)
+        selector = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, stratify=True, splitter=None,
+            models=[(sk, [{"C": c} for c in (0.1, 1.0)]),
+                    (LogisticRegression(max_iter=20), [{}])])
+        pred = selector.set_input(label, transmogrify(xs)).get_output()
+        model = (Workflow().set_result_features(label, pred)
+                 .set_input_records(recs).train())
+        sel = [s for s in model.stages() if isinstance(s, SelectedModel)][0]
+        names = {r.model_name for r in sel.summary.validation_results}
+        assert "ExternalEstimator" in names
+        before = model.score(recs[:30])[pred.name].data
+        path = str(tmp_path / "skmodel")
+        model.save(path)
+        after = load_model(path).score(recs[:30])[pred.name].data
+        np.testing.assert_array_equal(before, after)

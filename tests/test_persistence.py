@@ -122,3 +122,72 @@ class TestEncodeDecode:
                                                             encode_value)
         enc = encode_value(Real, {}, "t")
         assert decode_value(enc, {}) is Real
+
+
+class TestSelectorModelPersistence:
+    """A workflow whose model stage is a ModelSelector must save/load:
+    the trained DAG holds a SelectedModel wrapping the winning fitted
+    model (nested-stage ctor arg) and the ModelSelectorSummary.
+    Regression: encode_value had no case for either, so EVERY
+    selector-trained model failed to save."""
+
+    def test_selector_workflow_roundtrip(self, tmp_path):
+        import numpy as np
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.models import (GBTClassifier,
+                                              LogisticRegression)
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.selector.selector import SelectedModel
+        from transmogrifai_tpu.workflow import Workflow, load_model
+        rng = np.random.default_rng(5)
+        recs = [{"a": float(rng.normal()), "b": float(rng.normal())}
+                for _ in range(120)]
+        for r in recs:
+            r["label"] = float(r["a"] - 0.5 * r["b"] + rng.normal() > 0)
+        label = FeatureBuilder.real_nn("label").extract(
+            lambda r: r["label"]).as_response()
+        xs = [FeatureBuilder.real(n).extract(
+            lambda r, n=n: r[n]).as_predictor() for n in ("a", "b")]
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, stratify=True, splitter=None,
+            models=[(LogisticRegression(max_iter=25),
+                     [{"reg_param": 0.01}, {"reg_param": 0.1}]),
+                    # numpy-typed grid values (np.arange) must survive
+                    # json.dump of the persisted summary
+                    (GBTClassifier(num_rounds=3),
+                     [{"max_depth": d} for d in np.arange(2, 3)])])
+        pred = sel.set_input(label, transmogrify(xs)).get_output()
+        model = (Workflow().set_result_features(label, pred)
+                 .set_input_records(recs).train())
+        before = model.score(recs[:25])[pred.name].data
+        path = str(tmp_path / "selmodel")
+        model.save(path)
+        loaded = load_model(path)
+        after = loaded.score(recs[:25])[pred.name].data
+        np.testing.assert_array_equal(before, after)
+        # the summary survives with full validation detail
+        orig = [s for s in model.stages()
+                if isinstance(s, SelectedModel)][0].summary
+        rest = [s for s in loaded.stages()
+                if isinstance(s, SelectedModel)][0].summary
+        assert rest.best_model_name == orig.best_model_name
+        assert rest.best_validation_metric == orig.best_validation_metric
+        assert ([r.to_json() for r in rest.validation_results]
+                == [r.to_json() for r in orig.validation_results])
+        # train_evaluation exercises the metrics_from_json rebuild: it
+        # must come back as the SAME typed dataclass, not None/dict
+        assert type(rest.train_evaluation) is type(orig.train_evaluation)
+        assert (rest.train_evaluation.to_json()
+                == orig.train_evaluation.to_json())
+        assert (rest.holdout_evaluation is None) == \
+            (orig.holdout_evaluation is None)
+        if orig.holdout_evaluation is not None:
+            assert (rest.holdout_evaluation.to_json()
+                    == orig.holdout_evaluation.to_json())
+        # local row-path scoring works on the loaded model too
+        from transmogrifai_tpu.local import score_function_for
+        fn = score_function_for(loaded)
+        row = fn(recs[0])
+        assert np.isclose(row[pred.name]["prediction"], before[0])

@@ -44,6 +44,23 @@ class EvaluationMetrics:
         return self.to_json()
 
 
+def metrics_from_json(class_name: str, d: Dict[str, Any]
+                      ) -> "Optional[EvaluationMetrics]":
+    """Rebuild a metrics dataclass from ``to_json`` output by class
+    name (model save/load of ModelSelectorSummary). Unknown classes
+    return None; nested EvaluationMetrics inside a MultiMetrics dict
+    come back as plain dicts (the summary consumers read leaf floats)."""
+    def walk(cls):
+        for sub in cls.__subclasses__():
+            yield sub
+            yield from walk(sub)
+    for sub in walk(EvaluationMetrics):
+        if sub.__name__ == class_name and dataclasses.is_dataclass(sub):
+            names = {f.name for f in dataclasses.fields(sub)}
+            return sub(**{k: v for k, v in d.items() if k in names})
+    return None
+
+
 @dataclass
 class SingleMetric(EvaluationMetrics):
     """One named metric value (reference SingleMetric)."""

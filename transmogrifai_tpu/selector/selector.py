@@ -60,9 +60,43 @@ class ModelSelectorSummary:
             "metricLargerBetter": self.metric_larger_better,
             "trainEvaluation": (self.train_evaluation.to_json()
                                 if self.train_evaluation else None),
+            "trainEvaluationClass": (type(self.train_evaluation).__name__
+                                     if self.train_evaluation else None),
             "holdoutEvaluation": (self.holdout_evaluation.to_json()
                                   if self.holdout_evaluation else None),
+            "holdoutEvaluationClass": (
+                type(self.holdout_evaluation).__name__
+                if self.holdout_evaluation else None),
         }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ModelSelectorSummary":
+        """Inverse of :meth:`to_json` (model save/load)."""
+        from ..evaluators.base import metrics_from_json
+
+        def metrics(which: str):
+            payload = d.get(which)
+            name = d.get(which + "Class")
+            return (metrics_from_json(name, payload)
+                    if payload is not None and name else None)
+
+        return cls(
+            validation_type=d.get("validationType", ""),
+            validation_parameters=d.get("validationParameters") or {},
+            data_prep_parameters=d.get("dataPrepParameters") or {},
+            data_prep_results=d.get("dataPrepResults") or {},
+            evaluation_metric=d.get("evaluationMetric", ""),
+            problem_type=d.get("problemType", ""),
+            best_model_name=d.get("bestModelName", ""),
+            best_model_uid=d.get("bestModelUID", ""),
+            best_model_params=d.get("bestModelParams") or {},
+            best_validation_metric=d.get("bestValidationMetric", 0.0),
+            validation_results=[ValidationResult.from_json(r)
+                                for r in d.get("validationResults", [])],
+            train_evaluation=metrics("trainEvaluation"),
+            holdout_evaluation=metrics("holdoutEvaluation"),
+            metric_larger_better=d.get("metricLargerBetter", True),
+        )
 
     def pretty(self) -> str:
         """Human summary (reference summaryPretty,

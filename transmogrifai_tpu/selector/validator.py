@@ -50,6 +50,12 @@ class ValidationResult:
                 "metricValues": [float(v) for v in self.metric_values],
                 "meanMetric": self.mean_metric}
 
+    @classmethod
+    def from_json(cls, d: dict) -> "ValidationResult":
+        return cls(model_name=d["modelName"], model_uid=d["modelUID"],
+                   grid_index=d["gridIndex"], params=dict(d["params"]),
+                   metric_values=list(d["metricValues"]))
+
 
 @dataclass
 class BestEstimator:

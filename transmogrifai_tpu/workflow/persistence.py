@@ -40,12 +40,30 @@ __all__ = ["save_model", "load_model", "stage_to_json", "stage_from_json",
 
 MODEL_JSON = "op-model.json"
 ARRAYS_NPZ = "arrays.npz"
+#: bumped to 2 when $stage/$selsummary nested encodings were added
+#: (selector-trained models); readers reject formats newer than this
+#: instead of mis-decoding them into plain dicts
+MODEL_FORMAT_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
 # value encoding (replaces reference AnyValueTypes,
 # OpPipelineStageReadWriteShared.scala)
 # ---------------------------------------------------------------------------
+
+def _jsonify(v: Any) -> Any:
+    """Pure-JSON copy of a nested dict/list payload: numpy scalars to
+    python scalars, arrays to lists."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, dict):
+        return {k: _jsonify(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonify(x) for x in v]
+    return v
+
 
 def encode_value(v: Any, arrays: Dict[str, np.ndarray], key: str) -> Any:
     """JSON-safe encoding; arrays are swapped for ``{"$array": key}`` refs
@@ -68,6 +86,17 @@ def encode_value(v: Any, arrays: Dict[str, np.ndarray], key: str) -> Any:
     if isinstance(v, dict):
         return {"$dict": {str(k): encode_value(x, arrays, f"{key}/{k}")
                           for k, x in v.items()}}
+    if isinstance(v, PipelineStage):
+        # nested fitted stage — e.g. SelectedModel.inner, the winning
+        # model a trained ModelSelector wraps (reference SelectedModel's
+        # sparkMlStage save, ModelSelectorReaderWriter semantics)
+        return {"$stage": stage_to_json(v, arrays)}
+    from ..selector.selector import ModelSelectorSummary
+    if isinstance(v, ModelSelectorSummary):
+        # param/grid dicts inside the summary can carry numpy scalars
+        # (e.g. np.int64 depths from an np.arange grid) — json.dump
+        # rejects those, so sanitize the whole payload
+        return {"$selsummary": _jsonify(v.to_json())}
     if isinstance(v, type):
         from ..types.base import FeatureType
         if issubclass(v, FeatureType):
@@ -98,6 +127,11 @@ def decode_value(v: Any, arrays: Dict[str, np.ndarray]) -> Any:
             return feature_type_by_name(v["$ftype"])
         if "$vmeta" in v:
             return VectorMetadata.from_json(v["$vmeta"])
+        if "$stage" in v:
+            return stage_from_json(v["$stage"], arrays)
+        if "$selsummary" in v:
+            from ..selector.selector import ModelSelectorSummary
+            return ModelSelectorSummary.from_json(v["$selsummary"])
         if "$fn" in v:
             if v["$fn"] is None:
                 return None
@@ -213,7 +247,7 @@ def save_model(model, path: str) -> None:
     from ..utils.version import version_info
     rff = getattr(model, "raw_feature_filter_results", None)
     doc = {
-        "formatVersion": 1,
+        "formatVersion": MODEL_FORMAT_VERSION,
         "versionInfo": version_info().to_json(),
         "resultFeatureUids": [f.uid for f in model.result_features],
         "features": [_feature_to_json(f) for f in feats],
@@ -238,6 +272,11 @@ def load_model(path: str):
     from .workflow import WorkflowModel
     with open(os.path.join(path, MODEL_JSON)) as fh:
         doc = json.load(fh)
+    fmt = doc.get("formatVersion", 1)
+    if fmt > MODEL_FORMAT_VERSION:
+        raise ValueError(
+            f"model at {path} uses format {fmt}; this build reads up "
+            f"to {MODEL_FORMAT_VERSION} — load with a newer build")
     npz_path = os.path.join(path, ARRAYS_NPZ)
     arrays: Dict[str, np.ndarray] = {}
     if os.path.exists(npz_path):
