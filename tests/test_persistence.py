@@ -191,3 +191,46 @@ class TestSelectorModelPersistence:
         fn = score_function_for(loaded)
         row = fn(recs[0])
         assert np.isclose(row[pred.name]["prediction"], before[0])
+
+    def test_multiclass_selector_roundtrip_exact_summary(self, tmp_path):
+        """Multiclass summaries carry NESTED metric dataclasses
+        (ThresholdMetrics with int topN keys) — the round-trip must
+        restore types AND values bit-exact (JSON stringifies int dict
+        keys; the decode hook undoes it)."""
+        import numpy as np
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.models import LogisticRegression
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import (
+            MultiClassificationModelSelector)
+        from transmogrifai_tpu.selector.selector import SelectedModel
+        from transmogrifai_tpu.workflow import Workflow, load_model
+        rng = np.random.default_rng(2)
+        recs = [{"x0": float(rng.normal()), "x1": float(rng.normal())}
+                for _ in range(150)]
+        for r in recs:
+            r["label"] = float(int(r["x0"] > 0) + int(r["x1"] > 0))
+        label = FeatureBuilder.real_nn("label").extract(
+            lambda r: r["label"]).as_response()
+        xs = [FeatureBuilder.real(n).extract(
+            lambda r, n=n: r[n]).as_predictor() for n in ("x0", "x1")]
+        sel = MultiClassificationModelSelector.with_cross_validation(
+            num_folds=2, splitter=None,
+            models=[(LogisticRegression(max_iter=25), [{}])])
+        pred = sel.set_input(label, transmogrify(xs)).get_output()
+        model = (Workflow().set_result_features(label, pred)
+                 .set_input_records(recs).train())
+        path = str(tmp_path / "mc")
+        model.save(path)
+        loaded = load_model(path)
+        np.testing.assert_array_equal(
+            model.score(recs[:20])[pred.name].data,
+            loaded.score(recs[:20])[pred.name].data)
+        orig = [s for s in model.stages()
+                if isinstance(s, SelectedModel)][0].summary
+        rest = [s for s in loaded.stages()
+                if isinstance(s, SelectedModel)][0].summary
+        assert rest.to_json() == orig.to_json()
+        tm = rest.train_evaluation.ThresholdMetrics
+        assert type(tm).__name__ == "ThresholdMetrics"
+        assert all(isinstance(k, int) for k in tm.correct_counts)

@@ -47,18 +47,63 @@ class EvaluationMetrics:
 def metrics_from_json(class_name: str, d: Dict[str, Any]
                       ) -> "Optional[EvaluationMetrics]":
     """Rebuild a metrics dataclass from ``to_json`` output by class
-    name (model save/load of ModelSelectorSummary). Unknown classes
-    return None; nested EvaluationMetrics inside a MultiMetrics dict
-    come back as plain dicts (the summary consumers read leaf floats)."""
+    name (model save/load of ModelSelectorSummary). Nested metric
+    dataclass FIELDS (e.g. MultiClassificationMetrics.ThresholdMetrics)
+    rebuild recursively from their annotations; unknown classes return
+    None; heterogeneous MultiMetrics dicts stay plain dicts (their leaf
+    classes aren't recorded — consumers read leaf floats)."""
     def walk(cls):
         for sub in cls.__subclasses__():
             yield sub
             yield from walk(sub)
+
+    def field_cls(f) -> "Optional[type]":
+        t = f.type
+        if isinstance(t, str):       # from __future__ annotations
+            t = t.replace("Optional[", "").rstrip("]")
+            return next((s for s in walk(EvaluationMetrics)
+                         if s.__name__ == t), None)
+        if isinstance(t, type):
+            return t if issubclass(t, EvaluationMetrics) else None
+        import typing
+        for a in typing.get_args(t):     # Optional[X] and friends
+            if isinstance(a, type) and issubclass(a, EvaluationMetrics):
+                return a
+        return None
+
     for sub in walk(EvaluationMetrics):
         if sub.__name__ == class_name and dataclasses.is_dataclass(sub):
-            names = {f.name for f in dataclasses.fields(sub)}
-            return sub(**{k: v for k, v in d.items() if k in names})
-    return None
+            kwargs = {}
+            for f in dataclasses.fields(sub):
+                if f.name not in d:
+                    continue
+                v = d[f.name]
+                nested = field_cls(f)
+                if nested is not None and isinstance(v, dict):
+                    v = metrics_from_json(nested.__name__, v)
+                kwargs[f.name] = v
+            hook = getattr(sub, "_decode_json_kwargs", None)
+            if hook is not None:
+                kwargs = hook(kwargs)
+            return sub(**kwargs)
+    # class not importable here: hold the payload (and the original
+    # name) rather than dropping it — re-save keeps everything
+    return RawMetrics(class_name=class_name, data=dict(d))
+
+
+@dataclass
+class RawMetrics(EvaluationMetrics):
+    """Fallback holder for a persisted metrics payload whose class is
+    not importable at load time (e.g. a user's custom Evaluator metrics
+    module absent from the loading process). Keeps the full dict — and
+    the ORIGINAL class name, which the summary re-records on save — so
+    nothing is lost across load/re-save cycles and a later load with
+    the class available rebuilds the real type."""
+    class_name: str = ""
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.data)
 
 
 @dataclass

@@ -31,6 +31,18 @@ class ThresholdMetrics(EvaluationMetrics):
     incorrect_counts: Dict[int, List[int]] = field(default_factory=dict)
     no_prediction_counts: Dict[int, List[int]] = field(default_factory=dict)
 
+    @staticmethod
+    def _decode_json_kwargs(kwargs: dict) -> dict:
+        """JSON stringifies the int topN keys of the count dicts; undo
+        that on rebuild (metrics_from_json hook) so save/load
+        round-trips bit-exact."""
+        for name in ("correct_counts", "incorrect_counts",
+                     "no_prediction_counts"):
+            v = kwargs.get(name)
+            if isinstance(v, dict):
+                kwargs[name] = {int(k): x for k, x in v.items()}
+        return kwargs
+
 
 @dataclass
 class MultiClassificationMetrics(EvaluationMetrics):

@@ -60,12 +60,17 @@ class ModelSelectorSummary:
             "metricLargerBetter": self.metric_larger_better,
             "trainEvaluation": (self.train_evaluation.to_json()
                                 if self.train_evaluation else None),
-            "trainEvaluationClass": (type(self.train_evaluation).__name__
-                                     if self.train_evaluation else None),
+            # RawMetrics fallbacks re-record the ORIGINAL class name so
+            # a later load with the class importable rebuilds the type
+            "trainEvaluationClass": (
+                getattr(self.train_evaluation, "class_name", "")
+                or type(self.train_evaluation).__name__
+                if self.train_evaluation else None),
             "holdoutEvaluation": (self.holdout_evaluation.to_json()
                                   if self.holdout_evaluation else None),
             "holdoutEvaluationClass": (
-                type(self.holdout_evaluation).__name__
+                getattr(self.holdout_evaluation, "class_name", "")
+                or type(self.holdout_evaluation).__name__
                 if self.holdout_evaluation else None),
         }
 

@@ -59,7 +59,9 @@ def _jsonify(v: Any) -> Any:
     if isinstance(v, np.ndarray):
         return v.tolist()
     if isinstance(v, dict):
-        return {k: _jsonify(x) for k, x in v.items()}
+        # keys too: np.int64 topNs etc. — json.dump rejects numpy keys
+        return {(k.item() if isinstance(k, np.generic) else k):
+                _jsonify(x) for k, x in v.items()}
     if isinstance(v, (list, tuple)):
         return [_jsonify(x) for x in v]
     return v
