@@ -115,6 +115,39 @@ class TestWorkflowRunner:
         assert os.path.exists(os.path.join(loc, "summary.txt"))
         assert "Label" in res.summary
 
+    def test_train_run_with_selector_saves(self, tmp_path):
+        """The production shape: runner train run over a workflow whose
+        model stage is a ModelSelector, with model_location set.
+        Regression — selector-trained models could not be saved at all
+        (SelectedModel's nested fitted model had no persistence
+        encoding), so THIS run type crashed for every selector config."""
+        from transmogrifai_tpu.models import LogisticRegression
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector)
+        wf, records, _ = _make_workflow_and_records(seed=7)
+        # swap the bare LR for a selector over the same features
+        lr_stage = [s for s in wf.stages()
+                    if type(s).__name__ == "LogisticRegression"][0]
+        label_f, vec_f = lr_stage.input_features
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, stratify=True, splitter=None,
+            models=[(LogisticRegression(max_iter=20),
+                     [{"reg_param": 0.01}, {"reg_param": 0.1}])])
+        pred = sel.set_input(label_f, vec_f).get_output()
+        wf2 = (type(wf)().set_result_features(pred)
+               .set_input_records(records))
+        runner = WorkflowRunner(workflow=wf2)
+        loc = str(tmp_path / "selmodel")
+        res = runner.run(RunType.TRAIN, OpParams(model_location=loc))
+        assert os.path.exists(os.path.join(loc, "op-model.json"))
+        # the saved dir serves through the score run type too
+        runner2 = WorkflowRunner(
+            score_reader=DataReaders.Simple.custom(records[:10]))
+        out_loc = str(tmp_path / "scores")
+        res2 = runner2.run(RunType.SCORE, OpParams(
+            model_location=loc, write_location=out_loc))
+        assert res2.n_rows == 10
+
     def test_stage_param_override(self):
         wf, records, pred = _make_workflow_and_records(seed=6)
         runner = WorkflowRunner(workflow=wf)

@@ -49,8 +49,10 @@ def metrics_from_json(class_name: str, d: Dict[str, Any]
     """Rebuild a metrics dataclass from ``to_json`` output by class
     name (model save/load of ModelSelectorSummary). Nested metric
     dataclass FIELDS (e.g. MultiClassificationMetrics.ThresholdMetrics)
-    rebuild recursively from their annotations; unknown classes return
-    None; heterogeneous MultiMetrics dicts stay plain dicts (their leaf
+    rebuild recursively from their annotations; a class that is not
+    importable here comes back as a :class:`RawMetrics` holder carrying
+    the full payload + original name (never None, nothing dropped);
+    heterogeneous MultiMetrics dicts stay plain dicts (their leaf
     classes aren't recorded — consumers read leaf floats)."""
     def walk(cls):
         for sub in cls.__subclasses__():
@@ -72,6 +74,12 @@ def metrics_from_json(class_name: str, d: Dict[str, Any]
         return None
 
     for sub in walk(EvaluationMetrics):
+        if sub is RawMetrics:
+            # never self-match: a recorded "RawMetrics" name would
+            # rebuild as an EMPTY holder (payload keys aren't its
+            # fields) — route it to the fallback below, which keeps
+            # the full payload instead
+            continue
         if sub.__name__ == class_name and dataclasses.is_dataclass(sub):
             kwargs = {}
             for f in dataclasses.fields(sub):
