@@ -75,6 +75,13 @@ def load_titanic(path: str = None):
     return records
 
 
+def age_to_group(a) -> PickList:
+    """Binned age (module-level so the stage survives model save/load —
+    closures can't; reference checkSerializable)."""
+    return PickList(None if a.is_empty
+                    else ("adult" if a.value > 18 else "child"))
+
+
 def build_features():
     """Raw + engineered features (OpTitanicSimple.scala:103-131)."""
     survived = FeatureBuilder.real_nn("survived").extract(
@@ -105,10 +112,7 @@ def build_features():
     ticket_cost = (family_size * fare).alias("estimatedCostOfTickets")
     pivoted_sex = sex.pivot()
     normed_age = age.fill_missing_with_mean().z_normalize()
-    age_group = age.map(
-        lambda a: PickList(None if a.is_empty
-                           else ("adult" if a.value > 18 else "child")),
-        PickList).alias("ageGroup")
+    age_group = age.map(age_to_group, PickList).alias("ageGroup")
 
     passenger_features = transmogrify([
         p_class, name, age, sib_sp, par_ch, ticket, cabin, embarked,
@@ -193,4 +197,22 @@ if __name__ == "__main__":
     from transmogrifai_tpu.utils.jax_setup import (
         pin_platform_from_env)
     pin_platform_from_env()
-    run(csv_path=sys.argv[1] if len(sys.argv) > 1 else None)
+    metrics, _, model = run(
+        csv_path=sys.argv[1] if len(sys.argv) > 1 else None)
+    # the reference helloworld's full story: persist the trained
+    # selector model and serve single records from the saved dir
+    # (kept OUT of run() so bench.py wall-clocks stay train+eval only)
+    import tempfile
+
+    from transmogrifai_tpu.local import load_score_function
+    path = os.path.join(tempfile.mkdtemp(prefix="titanic_"), "model")
+    model.save(path)
+    score = load_score_function(path)
+    row = score({"pClass": "1", "sex": "female", "age": 29.0,
+                 "sibSp": 0, "parCh": 0, "fare": 100.0,
+                 "embarked": "S", "name": "Test Passenger",
+                 "ticket": "t", "cabin": "C1"})
+    pred_key = next(f.name for f in model.result_features
+                    if f.name != "survived")
+    print(f"saved -> {path}; served one record: "
+          f"P(survived)={row[pred_key]['probability_1']:.3f}")

@@ -51,6 +51,33 @@ MODEL_FORMAT_VERSION = 2
 # OpPipelineStageReadWriteShared.scala)
 # ---------------------------------------------------------------------------
 
+def resolve_importable_fn(fn) -> "Optional[str]":
+    """``"module:qualname"`` for a function another process can
+    re-import, else None. Functions defined in a script run as
+    ``__main__`` are re-resolved through the script's module name —
+    a recorded ``__main__:f`` would import the LOADER's main module
+    and fail (or worse, silently bind a different f)."""
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", "")
+    if not (mod and qual) or "<" in qual:
+        return None
+    if mod != "__main__":
+        return f"{mod}:{qual}"
+    import importlib
+    import sys as _sys
+    f = getattr(_sys.modules.get("__main__"), "__file__", None)
+    stem = os.path.splitext(os.path.basename(f))[0] if f else None
+    if not stem:
+        return None
+    try:
+        target = importlib.import_module(stem)
+        for part in qual.split("."):
+            target = getattr(target, part)
+    except Exception:
+        return None    # script not importable by name -> honest drop
+    return f"{stem}:{qual}"
+
+
 def _jsonify(v: Any) -> Any:
     """Pure-JSON copy of a nested dict/list payload: numpy scalars to
     python scalars, arrays to lists."""
@@ -107,11 +134,7 @@ def encode_value(v: Any, arrays: Dict[str, np.ndarray], key: str) -> Any:
     if isinstance(v, VectorMetadata):
         return {"$vmeta": v.to_json()}
     if callable(v):
-        mod = getattr(v, "__module__", None)
-        qual = getattr(v, "__qualname__", "")
-        if mod and qual and "<" not in qual:
-            return {"$fn": f"{mod}:{qual}"}
-        return {"$fn": None}  # non-importable closure/lambda — dropped
+        return {"$fn": resolve_importable_fn(v)}  # None = dropped
     raise ValueError(
         f"Cannot serialize ctor arg of type {type(v).__name__} at {key}")
 

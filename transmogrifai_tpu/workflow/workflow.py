@@ -171,9 +171,11 @@ def check_serializable(result_features: Sequence[Feature]) -> List[str]:
     problems: List[str] = []
 
     def fn_importable(fn) -> bool:
-        mod = getattr(fn, "__module__", None)
-        qual = getattr(fn, "__qualname__", "")
-        return bool(mod and qual and "<" not in qual)
+        # shared with the persistence encoder so the audit warns about
+        # EXACTLY what save would drop (incl. __main__-script functions
+        # whose module another process cannot re-import)
+        from .persistence import resolve_importable_fn
+        return resolve_importable_fn(fn) is not None
 
     for layer in topo_layers(result_features):
         for stage in layer:
