@@ -50,6 +50,23 @@ def test_titanic_holdout_aupr_parity():
     # loose floor below the 0.8225 reference target; r3 measured 0.8333
     assert metrics.AuPR >= 0.78, f"holdout AuPR {metrics.AuPR:.4f}"
     assert metrics.AuROC >= 0.82
+    # the helloworld serving story on the flagship dataset: persist the
+    # selector-trained model, reload, serve one record (regression —
+    # selector models could not be saved at all before r5)
+    import tempfile
+
+    from transmogrifai_tpu.local import load_score_function
+    path = os.path.join(tempfile.mkdtemp(), "titanic-model")
+    model.save(path)
+    score = load_score_function(path)
+    row = score({"pClass": "1", "sex": "female", "age": 29.0,
+                 "sibSp": 0, "parCh": 0, "fare": 100.0,
+                 "embarked": "S", "name": "T", "ticket": "t",
+                 "cabin": "C1"})
+    pred_key = next(f.name for f in model.result_features
+                    if f.name != "survived")
+    assert 0.0 <= row[pred_key]["probability_1"] <= 1.0
+    assert row[pred_key]["prediction"] in (0.0, 1.0)
 
 
 @pytest.mark.slow
