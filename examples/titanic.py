@@ -75,6 +75,27 @@ def load_titanic(path: str = None):
     return records
 
 
+#: one servable passenger record (the save+serve demo below and the
+#: parity test's round-trip share it so they cannot drift apart)
+SAMPLE_PASSENGER = {"pClass": "1", "sex": "female", "age": 29.0,
+                    "sibSp": 0, "parCh": 0, "fare": 100.0,
+                    "embarked": "S", "name": "Test Passenger",
+                    "ticket": "t", "cabin": "C1"}
+
+
+def demo_serve(model, path: str) -> dict:
+    """Persist ``model`` to ``path``, reload via the local serving
+    entry point, and score :data:`SAMPLE_PASSENGER` — the reference
+    helloworld's save+serve story. Returns the served prediction dict."""
+    from transmogrifai_tpu.local import load_score_function
+    model.save(path)
+    score = load_score_function(path)
+    row = score(dict(SAMPLE_PASSENGER))
+    pred_key = next(f.name for f in model.result_features
+                    if f.name != "survived")
+    return row[pred_key]
+
+
 def age_to_group(a) -> PickList:
     """Binned age (module-level so the stage survives model save/load —
     closures can't; reference checkSerializable)."""
@@ -203,16 +224,7 @@ if __name__ == "__main__":
     # selector model and serve single records from the saved dir
     # (kept OUT of run() so bench.py wall-clocks stay train+eval only)
     import tempfile
-
-    from transmogrifai_tpu.local import load_score_function
     path = os.path.join(tempfile.mkdtemp(prefix="titanic_"), "model")
-    model.save(path)
-    score = load_score_function(path)
-    row = score({"pClass": "1", "sex": "female", "age": 29.0,
-                 "sibSp": 0, "parCh": 0, "fare": 100.0,
-                 "embarked": "S", "name": "Test Passenger",
-                 "ticket": "t", "cabin": "C1"})
-    pred_key = next(f.name for f in model.result_features
-                    if f.name != "survived")
+    served = demo_serve(model, path)
     print(f"saved -> {path}; served one record: "
-          f"P(survived)={row[pred_key]['probability_1']:.3f}")
+          f"P(survived)={served['probability_1']:.3f}")

@@ -35,7 +35,7 @@ def test_titanic_rf_cv_range_parity():
     assert metrics.AuPR >= 0.75
 
 
-def test_titanic_holdout_aupr_parity():
+def test_titanic_holdout_aupr_parity(tmp_path):
     from examples.titanic import run
     from transmogrifai_tpu.models import GBTClassifier, LogisticRegression
     from transmogrifai_tpu.selector import BinaryClassificationModelSelector
@@ -52,21 +52,12 @@ def test_titanic_holdout_aupr_parity():
     assert metrics.AuROC >= 0.82
     # the helloworld serving story on the flagship dataset: persist the
     # selector-trained model, reload, serve one record (regression —
-    # selector models could not be saved at all before r5)
-    import tempfile
-
-    from transmogrifai_tpu.local import load_score_function
-    path = os.path.join(tempfile.mkdtemp(), "titanic-model")
-    model.save(path)
-    score = load_score_function(path)
-    row = score({"pClass": "1", "sex": "female", "age": 29.0,
-                 "sibSp": 0, "parCh": 0, "fare": 100.0,
-                 "embarked": "S", "name": "T", "ticket": "t",
-                 "cabin": "C1"})
-    pred_key = next(f.name for f in model.result_features
-                    if f.name != "survived")
-    assert 0.0 <= row[pred_key]["probability_1"] <= 1.0
-    assert row[pred_key]["prediction"] in (0.0, 1.0)
+    # selector models could not be saved at all before r5). Shares the
+    # example's own demo helper so test and demo cannot drift.
+    from examples.titanic import demo_serve
+    served = demo_serve(model, str(tmp_path / "titanic-model"))
+    assert 0.0 <= served["probability_1"] <= 1.0
+    assert served["prediction"] in (0.0, 1.0)
 
 
 @pytest.mark.slow
