@@ -356,6 +356,29 @@ class TestBatchedEvaluation:
             np.testing.assert_array_equal(ra.metric_values,
                                           rs.metric_values)
 
+    def test_async_family_dispatch_propagates_errors(self, monkeypatch):
+        """A genuine kernel bug in one family must fail the search
+        (not deadlock, not silently degrade) exactly as the sequential
+        loop would — futures re-raise at result()."""
+        import pytest
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import (GBTClassifier,
+                                              LogisticRegression)
+        from transmogrifai_tpu.selector import CrossValidation
+        X, y = self._data()
+        boom = GBTClassifier(num_rounds=3)
+
+        def explode(*a, **k):
+            raise RuntimeError("kernel bug")
+        monkeypatch.setattr(boom, "eval_fold_grid_arrays", explode)
+        monkeypatch.setenv("TX_ASYNC_FAMILIES", "1")
+        cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                             seed=1)
+        with pytest.raises(RuntimeError, match="kernel bug"):
+            cv.validate([(LogisticRegression(max_iter=10), [{}]),
+                         (boom, [{"max_depth": 2}])], X, y)
+
     def test_mlp_fold_batched_matches_sequential_winner(self):
         """The batched MLP kernel uses fixed-trip mini-batch Adam (a
         documented solver deviation from the sequential L-BFGS path —
