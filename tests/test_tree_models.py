@@ -224,7 +224,8 @@ class TestHistogramModes:
         hist(node >> 1) - hist_even) up to float reassociation."""
         import jax.numpy as jnp
         import numpy as np
-        from transmogrifai_tpu.models.trees import (_design_args,
+        from transmogrifai_tpu.models.trees import (_bin_indicator,
+                                                    _design_args,
                                                     _level_histograms)
         rng = np.random.default_rng(7)
         X = rng.normal(size=(500, 5))
@@ -242,6 +243,16 @@ class TestHistogramModes:
         sub = jnp.stack([even, prev - even], axis=1).reshape(8, TB, 2)
         np.testing.assert_allclose(np.asarray(full), np.asarray(sub),
                                    atol=1e-10)
+        # the Pallas kernel must tolerate the sentinel slot (== C) the
+        # sub path parks odd rows on: C < C_pad contamination lands in
+        # accumulator rows the [:num_slots] slice discards
+        even_pl = _level_histograms(
+            packed, jnp.where((node & 1) == 0, node >> 1, 8), stats, 4,
+            TB, _bin_indicator(packed, TB, stats.dtype,
+                               jnp.asarray(feat_of)),
+            mode="pallas", feat_of=feat_of)
+        np.testing.assert_allclose(np.asarray(even_pl), np.asarray(even),
+                                   atol=1e-6)
 
     def test_mode_switch_retraces(self, rng, monkeypatch):
         """Regression test: TX_TREE_HIST used to be read at trace time
