@@ -234,3 +234,36 @@ class TestSelectorModelPersistence:
         tm = rest.train_evaluation.ThresholdMetrics
         assert type(tm).__name__ == "ThresholdMetrics"
         assert all(isinstance(k, int) for k in tm.correct_counts)
+
+    def test_workflow_cv_selector_roundtrip(self, tmp_path):
+        """Workflow-level CV produces its SelectedModel through a
+        different path (precomputed winner, reference applyDAG) — that
+        model must also save and serve via load_score_function."""
+        import numpy as np
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.local import load_score_function
+        from transmogrifai_tpu.models import LogisticRegression
+        from transmogrifai_tpu.ops import transmogrify
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.workflow import Workflow
+        rng = np.random.default_rng(8)
+        recs = [{"x0": float(rng.normal()), "x1": float(rng.normal())}
+                for _ in range(100)]
+        for r in recs:
+            r["label"] = float(r["x0"] > 0)
+        label = FeatureBuilder.real_nn("label").extract(
+            lambda r: r["label"]).as_response()
+        xs = [FeatureBuilder.real(n).extract(
+            lambda r, n=n: r[n]).as_predictor() for n in ("x0", "x1")]
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, stratify=True, splitter=None,
+            models=[(LogisticRegression(max_iter=20), [{}])])
+        pred = sel.set_input(label, transmogrify(xs)).get_output()
+        model = (Workflow().set_result_features(label, pred)
+                 .set_input_records(recs).with_workflow_cv().train())
+        path = str(tmp_path / "wcv")
+        model.save(path)
+        served = load_score_function(path)(dict(recs[0]))
+        assert pred.name in served
+        assert served[pred.name]["prediction"] in (0.0, 1.0)
