@@ -112,6 +112,75 @@ class TestCliAvroAndKind:
         compile(src, "main.py", "exec")   # generated code parses
 
 
+class TestCliLint:
+    """`python -m transmogrifai_tpu.cli lint` exit-code contract:
+    0 clean / 1 findings / 2 internal error."""
+
+    CLEAN = "import jax\nimport jax.numpy as jnp\n\n@jax.jit\ndef f(x):\n    return jnp.sum(x)\n"
+    BAD = "import jax\nimport numpy as np\n\n@jax.jit\ndef f(x):\n    return np.sum(x)\n"
+
+    def test_exit_0_clean(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        p = tmp_path / "clean.py"
+        p.write_text(self.CLEAN)
+        assert cli_main(["lint", str(p)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_exit_1_findings_json(self, tmp_path, capsys):
+        import json
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        p = tmp_path / "bad.py"
+        p.write_text(self.BAD)
+        assert cli_main(["lint", str(p), "--format", "json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["counts"]["errors"] == 1
+        (f,) = payload["findings"]
+        assert f["rule"] == "TX-J01" and f["path"] == str(p)
+        assert f["line"] == 6 and f["fingerprint"]
+
+    def test_exit_2_internal_error(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        missing = str(tmp_path / "nope_does_not_exist")
+        assert cli_main(["lint", missing]) == 2
+        assert "internal error" in capsys.readouterr().err
+
+    def test_write_baseline_then_clean(self, tmp_path, capsys, monkeypatch):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        p = tmp_path / "bad.py"
+        p.write_text(self.BAD)
+        bl = str(tmp_path / "bl.json")
+        assert cli_main(["lint", str(p), "--baseline", bl,
+                         "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert cli_main(["lint", str(p), "--baseline", bl]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_parse_error_is_a_finding(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        p = tmp_path / "broken.py"
+        p.write_text("def broken(:\n")
+        assert cli_main(["lint", str(p)]) == 1
+        assert "TX-E00" in capsys.readouterr().out
+
+    def test_repo_default_target_is_clean_via_subprocess(self):
+        """The CI gate itself: the shipped package lints clean through
+        the real module entry point."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        r = subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu.cli", "lint"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_list_rules(self, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        assert cli_main(["lint", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "TX-D01" in out and "TX-J05" in out
+
+
 class TestInteractiveGen:
     """Reference `op gen` interactive Q&A (cli/.../ProblemSchema)."""
 

@@ -1,0 +1,541 @@
+"""Pre-flight static analyzer tests: every rule demonstrated by a
+failing fixture, a clean negative case, the repo-clean CI gate, and
+regression tests for the satellite bugfixes that shipped with `tx lint`.
+"""
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.feature import Feature
+from transmogrifai_tpu.lint import (Baseline, LintError, abstract_probe,
+                                    lint_dag, lint_model, lint_paths,
+                                    lint_source, lint_workflow)
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.models.linear import LogisticRegressionModel
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.stages.base import UnaryTransformer
+from transmogrifai_tpu.types import OPVector, Real, RealNN, Text
+from transmogrifai_tpu.workflow import Workflow
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "transmogrifai_tpu")
+
+
+def _rules(findings):
+    return {f.rule_id for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# DAG fixtures
+# ---------------------------------------------------------------------------
+
+def _basic_pipeline():
+    label = FeatureBuilder.real_nn("label").extract(
+        lambda r: r["label"]).as_response()
+    x = FeatureBuilder.real("x").extract(lambda r: r["x"]).as_predictor()
+    cat = FeatureBuilder.pick_list("cat").extract(
+        lambda r: r["cat"]).as_predictor()
+    fv = transmogrify([x, cat])
+    pred = LogisticRegression().set_input(label, fv).get_output()
+    return label, fv, pred
+
+
+class TestDagRules:
+    def test_clean_dag_has_no_findings(self):
+        label, fv, pred = _basic_pipeline()
+        assert lint_dag([pred]) == []
+
+    def test_d01_leakage_path(self):
+        # a manually built feature hides its response ancestry (the
+        # is_response flag the set_input guard relies on is wrong)
+        label, fv, pred = _basic_pipeline()
+        leaky = Feature("leaky", OPVector, is_response=False,
+                        origin_stage=fv.origin_stage, parents=(label, fv))
+        pred2 = LogisticRegression().set_input(label, leaky).get_output()
+        findings = lint_dag([pred2])
+        assert "TX-D01" in _rules(findings)
+        (f,) = [f for f in findings if f.rule_id == "TX-D01"]
+        assert f.severity == "error" and "leak" in f.message.lower()
+
+    def test_d01_matrix_is_response(self):
+        label, fv, pred = _basic_pipeline()
+        resp_vec = Feature("resp_vec", OPVector, is_response=True,
+                           origin_stage=fv.origin_stage,
+                           parents=fv.parents)
+        lr = LogisticRegression()
+        lr.input_features = (label, resp_vec)   # bypass set_input guard
+        out = Feature("p", lr.output_type, origin_stage=lr,
+                      parents=(label, resp_vec))
+        assert "TX-D01" in _rules(lint_dag([out]))
+
+    def test_d01_sanity_checked_path_is_legit(self):
+        # label flowing through an AllowLabelAsInput stage is NOT leakage
+        label, fv, pred = _basic_pipeline()
+        checked = fv.sanity_check(label)
+        pred2 = LogisticRegression().set_input(label, checked).get_output()
+        assert "TX-D01" not in _rules(lint_dag([pred2]))
+
+    def test_d02_cycle(self):
+        a = Feature("a", Real)
+        st = UnaryTransformer()
+        st.input_features = (a,)
+        b = Feature("b", Real, origin_stage=st, parents=(a,))
+        a.parents = (b,)          # close the loop
+        findings = lint_dag([b])
+        assert "TX-D02" in _rules(findings)
+
+    def test_d03_dead_stage(self):
+        label, fv, pred = _basic_pipeline()
+        checked = fv.sanity_check(label)   # built but never wired in
+        findings = lint_dag([pred], extra_features=[checked])
+        dead = [f for f in findings if f.rule_id == "TX-D03"]
+        assert len(dead) == 1 and dead[0].severity == "warning"
+        assert checked.name in dead[0].message
+
+    def test_d04_type_mismatch_with_converter_hint(self):
+        class WantsReal(UnaryTransformer):
+            input_types = (Real,)
+            output_type = Real
+
+        txt = Feature("txt", Text)
+        st = WantsReal()
+        st.input_features = (txt,)       # bypass the set_input guard
+        out = Feature("out", Real, origin_stage=st, parents=(txt,))
+        findings = lint_dag([out])
+        (f,) = [f for f in findings if f.rule_id == "TX-D04"]
+        assert "Real" in f.message and "Text" in f.message
+        assert "to_real" in (f.hint or "")
+
+    def test_d05_untrained_estimator_in_scoring_dag(self):
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        label, fv, pred = _basic_pipeline()
+        model = WorkflowModel(result_features=(pred,))
+        findings = lint_model(model)
+        assert "TX-D05" in _rules(findings)
+        # the same DAG is fine pre-train
+        assert "TX-D05" not in _rules(lint_workflow(
+            Workflow().set_result_features(pred)))
+
+    def test_d06_duplicate_stage_uid(self):
+        class T(UnaryTransformer):
+            output_type = Real
+
+        x1, x2 = Feature("x1", Real), Feature("x2", Real)
+        s1, s2 = T(), T()
+        s2.uid = s1.uid
+        s1.input_features, s2.input_features = (x1,), (x2,)
+        o1 = Feature("o1", Real, origin_stage=s1, parents=(x1,))
+        o2 = Feature("o2", Real, origin_stage=s2, parents=(x2,))
+        assert "TX-D06" in _rules(lint_dag([o1, o2]))
+
+    def test_d07_vector_metadata_mismatch(self):
+        from transmogrifai_tpu.utils.vector_meta import (
+            VectorColumnMetadata, VectorMetadata)
+        label = Feature("label", RealNN, is_response=True)
+        fv = Feature("fv", OPVector)
+        m = LogisticRegressionModel(coefficients=np.zeros(3),
+                                    intercept=0.0)
+        m.vector_metadata = VectorMetadata("fv", tuple(
+            VectorColumnMetadata(parent_feature_name="x",
+                                 parent_feature_type="Real")
+            for _ in range(5)))
+        m.input_features = (label, fv)
+        out = Feature("p", m.output_type, origin_stage=m,
+                      parents=(label, fv))
+        (f,) = [f for f in lint_dag([out]) if f.rule_id == "TX-D07"]
+        assert "3" in f.message and "5" in f.message
+
+
+# ---------------------------------------------------------------------------
+# JAX / AST rules
+# ---------------------------------------------------------------------------
+
+def _src(code):
+    return lint_source(textwrap.dedent(code), "<fixture>")
+
+
+class TestJaxAstRules:
+    def test_j01_np_call_in_jit(self):
+        findings = _src("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.sum(x)
+        """)
+        assert _rules(findings) == {"TX-J01"}
+        assert "jnp.sum" in findings[0].hint
+
+    def test_j01_item_and_float(self):
+        findings = _src("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) + x.item()
+        """)
+        assert [f.rule_id for f in findings] == ["TX-J01", "TX-J01"]
+
+    def test_j01_host_code_untouched(self):
+        # numpy OUTSIDE jit is host orchestration — no findings
+        assert _src("""
+            import numpy as np
+
+            def host(x):
+                return np.sum(np.asarray(x, dtype=np.float64)).item()
+        """) == []
+
+    def test_j02_jit_per_call_and_in_loop(self):
+        findings = _src("""
+            import jax
+
+            def per_call(f, x):
+                return jax.jit(f)(x)
+
+            def in_loop(fs, x):
+                return [jax.jit(f)(x) for f in fs or ()] or [
+                    jax.jit(f)(x) for f in fs]
+        """)
+        assert "TX-J02" in _rules(findings)
+        findings2 = _src("""
+            import jax
+
+            def in_loop(fs, x):
+                out = []
+                for f in fs:
+                    out.append(jax.jit(f)(x))
+                return out
+        """)
+        errs = [f for f in findings2 if f.rule_id == "TX-J02"]
+        assert errs and errs[0].severity == "error"
+
+    def test_j02_memoized_builder_is_blessed(self):
+        assert _src("""
+            import functools
+            import jax
+
+            @functools.lru_cache(maxsize=8)
+            def builder(depth):
+                def body(x):
+                    return x * depth
+                return jax.jit(body)
+        """) == []
+
+    def test_j03_nonhashable_static(self):
+        findings = _src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("ks",))
+            def f(x, ks):
+                return x
+
+            def caller(x):
+                return f(x, ks=[1, 2])
+        """)
+        (f,) = [f for f in findings if f.rule_id == "TX-J03"]
+        assert "ks" in f.message and f.severity == "error"
+
+    def test_j04_float64_creep(self):
+        findings = _src("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return x.astype(jnp.float64) + jnp.zeros(
+                    3, dtype=jnp.float64)
+        """)
+        assert [f.rule_id for f in findings] == ["TX-J04", "TX-J04"]
+
+    def test_j04_dtype_guard_is_not_creep(self):
+        assert _src("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return x if x.dtype == jnp.float64 else x * 2
+        """) == []
+
+    def test_j05_traced_control_flow(self):
+        findings = _src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def f(x, n, k):
+                if k:           # static: fine
+                    x = x * 2
+                if n > 0:       # traced: concretization error
+                    x = x + 1
+                while x > 0:    # traced: concretization error
+                    x = x - 1
+                if x is None:   # identity check: fine
+                    return x
+                return x
+        """)
+        assert [f.rule_id for f in findings] == ["TX-J05", "TX-J05"]
+
+    def test_e00_parse_error(self):
+        findings = lint_source("def broken(:\n", "bad.py")
+        assert _rules(findings) == {"TX-E00"}
+
+    def test_shape_reads_are_static(self):
+        assert _src("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x.shape[0] > 4:
+                    return x[:4]
+                if len(x) > 2:
+                    return x
+                return x * x.ndim
+        """) == []
+
+
+class TestAbstractProbe:
+    def test_probe_catches_host_transfer(self):
+        import jax
+        import numpy as np
+
+        def bad(x):
+            return np.asarray(x) + 1
+        findings = abstract_probe(
+            bad, jax.ShapeDtypeStruct((4,), "float32"))
+        assert _rules(findings) == {"TX-J01"}
+
+    def test_probe_catches_concretization(self):
+        import jax
+
+        def bad(x):
+            if x[0] > 0:
+                return x
+            return -x
+        findings = abstract_probe(
+            bad, jax.ShapeDtypeStruct((4,), "float32"))
+        assert _rules(findings) == {"TX-J05"}
+
+    def test_probe_clean_fn_and_no_device_exec(self):
+        import jax
+        import jax.numpy as jnp
+
+        calls = []
+
+        def good(x):
+            calls.append(1)     # tracing runs the python body once
+            return jnp.tanh(x) * 2
+        assert abstract_probe(
+            good, jax.ShapeDtypeStruct((8, 3), "float32")) == []
+        assert calls == [1]     # traced abstractly, never executed again
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    BAD = ("import jax\nimport numpy as np\n\n"
+           "@jax.jit\ndef f(x):\n    return np.sum(x)")
+
+    def test_inline_disable(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(self.BAD.replace(
+            "return np.sum(x)",
+            "return np.sum(x)  # tx-lint: disable=TX-J01"))
+        findings, _ = lint_paths([str(p)])
+        assert findings == []
+
+    def test_inline_disable_all(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(self.BAD.replace(
+            "return np.sum(x)", "return np.sum(x)  # tx-lint: disable"))
+        assert lint_paths([str(p)])[0] == []
+
+    def test_baseline_roundtrip(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(self.BAD)
+        findings, _ = lint_paths([str(p)])
+        assert len(findings) == 1
+        bl_path = str(tmp_path / "baseline.json")
+        Baseline.write(bl_path, findings)
+        fresh, stale = lint_paths([str(p)], Baseline.load(bl_path))
+        assert fresh == [] and stale == []
+        # fixing the file makes the baseline entry stale
+        p.write_text("import numpy as np\n")
+        fresh, stale = lint_paths([str(p)], Baseline.load(bl_path))
+        assert fresh == [] and len(stale) == 1
+
+
+# ---------------------------------------------------------------------------
+# workflow integration + the repo gate
+# ---------------------------------------------------------------------------
+
+class _UntouchableData:
+    """train() must fail validation BEFORE reading any data."""
+
+    def __iter__(self):
+        raise AssertionError("input data was touched during pre-flight")
+
+
+class TestWorkflowValidate:
+    def _leaky_workflow(self):
+        label, fv, pred = _basic_pipeline()
+        leaky = Feature("leaky", OPVector, is_response=False,
+                        origin_stage=fv.origin_stage, parents=(label, fv))
+        pred2 = LogisticRegression().set_input(label, leaky).get_output()
+        wf = Workflow().set_result_features(pred2)
+        wf._input_data = _UntouchableData()
+        return wf
+
+    def test_strict_raises_before_touching_data(self):
+        wf = self._leaky_workflow()
+        with pytest.raises(LintError, match="TX-D01"):
+            wf.train(validate="strict")
+
+    def test_warn_logs_and_proceeds_to_data(self, caplog):
+        wf = self._leaky_workflow()
+        # warn mode continues past lint - so it MUST hit the data probe
+        with caplog.at_level("WARNING"):
+            with pytest.raises(AssertionError, match="touched"):
+                wf.train(validate="warn")
+        assert "TX-D01" in caplog.text
+
+    def test_off_skips_lint(self):
+        wf = self._leaky_workflow()
+        with pytest.raises(AssertionError, match="touched"):
+            wf.train(validate="off")
+
+    def test_bad_validate_value(self):
+        wf = self._leaky_workflow()
+        with pytest.raises(ValueError, match="validate"):
+            wf.train(validate="bogus")
+
+    def test_clean_workflow_trains_strict(self, rng):
+        recs = [{"x": float(rng.normal()), "cat": ["a", "b"][i % 2],
+                 "label": float(i % 2)} for i in range(60)]
+        label, fv, pred = _basic_pipeline()
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(recs).train(validate="strict"))
+        assert model.score(recs).n_rows == 60
+
+
+class TestRepoGate:
+    def test_package_source_is_lint_clean(self):
+        """The analyzer gates this repo: any new hot-path defect in
+        transmogrifai_tpu/ fails this test (and hence tier-1)."""
+        findings, _ = lint_paths([PKG])
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# satellite bugfix regressions
+# ---------------------------------------------------------------------------
+
+class TestResolveImportableFnNoExec:
+    def test_main_script_resolved_without_reexecution(
+            self, tmp_path, monkeypatch):
+        from transmogrifai_tpu.workflow.persistence import \
+            resolve_importable_fn
+        marker = tmp_path / "executed.marker"
+        script = tmp_path / "myscript77.py"
+        script.write_text(
+            "import pathlib\n"
+            f"pathlib.Path({str(marker)!r}).write_text('boom')\n"
+            "def extract(r):\n    return r.get('x')\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+
+        def extract(r):
+            return r.get("x")
+        extract.__module__ = "__main__"
+        extract.__qualname__ = "extract"
+        import types
+        fake_main = types.ModuleType("__main__")
+        fake_main.__file__ = str(script)
+        monkeypatch.setitem(sys.modules, "__main__", fake_main)
+
+        assert resolve_importable_fn(extract) == "myscript77:extract"
+        # find_spec-based resolution must NOT run the script's top level
+        assert not marker.exists()
+
+    def test_stem_resolving_elsewhere_is_dropped(
+            self, tmp_path, monkeypatch):
+        from transmogrifai_tpu.workflow.persistence import \
+            resolve_importable_fn
+        # __main__ claims to be "json.py" — the stem resolves to the
+        # stdlib json, NOT the running script: recording "json:extract"
+        # would silently bind a different module's attribute on load
+        import types
+
+        def extract(r):
+            return r
+        extract.__module__ = "__main__"
+        extract.__qualname__ = "extract"
+        fake_main = types.ModuleType("__main__")
+        fake_main.__file__ = str(tmp_path / "json.py")
+        monkeypatch.setitem(sys.modules, "__main__", fake_main)
+        assert resolve_importable_fn(extract) is None
+
+
+class TestHistModeSuffix:
+    def test_bad_suffix_honors_valid_base(self, monkeypatch, caplog):
+        from transmogrifai_tpu.models.trees import _hist_mode
+        monkeypatch.setenv("TX_TREE_HIST", "pallas+sb")   # the typo
+        monkeypatch.delenv("TX_TREE_SUB", raising=False)
+        with caplog.at_level("WARNING"):
+            assert _hist_mode() == "pallas"
+        assert "suffix" in caplog.text
+
+    def test_bad_suffix_still_composes_tx_tree_sub(self, monkeypatch):
+        from transmogrifai_tpu.models.trees import _hist_mode
+        monkeypatch.setenv("TX_TREE_HIST", "matmul+subb")
+        monkeypatch.setenv("TX_TREE_SUB", "1")
+        assert _hist_mode() == "matmul+sub"
+
+    def test_valid_modes_unchanged(self, monkeypatch):
+        from transmogrifai_tpu.models.trees import _hist_mode
+        monkeypatch.setenv("TX_TREE_HIST", "matmul+sub")
+        monkeypatch.delenv("TX_TREE_SUB", raising=False)
+        assert _hist_mode() == "matmul+sub"
+        monkeypatch.setenv("TX_TREE_HIST", "scatter")
+        assert _hist_mode() == "scatter"
+
+    def test_unknown_base_falls_back_with_warning(
+            self, monkeypatch, caplog):
+        from transmogrifai_tpu.models.trees import _hist_mode
+        monkeypatch.setenv("TX_TREE_HIST", "bogus")
+        monkeypatch.delenv("TX_TREE_SUB", raising=False)
+        with caplog.at_level("WARNING"):
+            mode = _hist_mode()
+        assert mode in ("scatter", "matmul")
+        assert "not a recognized" in caplog.text
+
+
+class TestAsyncDispatchGuard:
+    def test_counts_stacked_validation_folds_and_masks(self):
+        from transmogrifai_tpu.selector.validator import \
+            _async_dispatch_bytes
+        X = np.zeros((100, 10))
+        masks = np.zeros((5, 100))
+        X_val_st = np.zeros((5, 20, 10))
+        y_val_st = np.zeros((5, 20))
+        total = _async_dispatch_bytes(X, masks, X_val_st, y_val_st)
+        assert total == (X.nbytes + masks.nbytes + X_val_st.nbytes
+                         + y_val_st.nbytes)
+        # the old guard looked at X alone — the under-estimate the fix
+        # closes is exactly the masks + stacked-fold contribution
+        assert total > X.nbytes
+
+    def test_no_stacked_folds(self):
+        from transmogrifai_tpu.selector.validator import \
+            _async_dispatch_bytes
+        X = np.zeros((10, 4))
+        masks = np.zeros((3, 10))
+        assert _async_dispatch_bytes(X, masks, None, None) == \
+            X.nbytes + masks.nbytes

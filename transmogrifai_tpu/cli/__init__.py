@@ -1,4 +1,6 @@
-"""CLI codegen (SURVEY §2.14; cli/src/main/scala/com/salesforce/op/cli/)."""
+"""CLI: project codegen (SURVEY §2.14;
+cli/src/main/scala/com/salesforce/op/cli/) + the ``lint`` pre-flight
+static analyzer (lint/, docs/lint.md)."""
 from .gen import generate_project, main
 
 __all__ = ["generate_project", "main"]

@@ -1,0 +1,36 @@
+"""``tx lint`` — pre-flight static analysis for feature DAGs and the JAX
+compile path.
+
+The reference framework's headline pillar is compile-time safety: a
+typed ``Feature[T]`` DAG that fails before cluster time is spent. This
+package restores that guarantee for the TPU port — and extends it to the
+JAX layer — WITHOUT tracing, compiling or allocating a single device
+buffer:
+
+- DAG rules (``rules_dag``): label-leakage paths, cycles, dead stages,
+  input-edge type contracts, untrained-estimator-in-score, duplicate
+  stage uids, vector-metadata/column-count drift.
+- JAX rules (``rules_jax``): AST analysis of jitted functions (host
+  transfers, recompilation hazards, non-hashable statics, float64
+  creep, traced-value control flow) plus a ``jax.eval_shape`` abstract
+  probe for dynamic confirmation.
+
+Entry points: ``python -m transmogrifai_tpu.cli lint`` (source rules,
+CI gate), ``Workflow.train(validate="strict"|"warn"|"off")`` (DAG rules,
+pre-flight), and the programmatic API below. Rule catalog and
+suppression syntax: docs/lint.md.
+"""
+from .baseline import Baseline, DEFAULT_BASELINE_NAME
+from .engine import (format_json, format_text, lint_model, lint_paths,
+                     lint_workflow, summarize)
+from .findings import ERROR, RULES, WARNING, LintError, LintFinding
+from .rules_dag import lint_dag
+from .rules_jax import abstract_probe, lint_file, lint_source
+
+__all__ = [
+    "LintFinding", "LintError", "RULES", "ERROR", "WARNING",
+    "Baseline", "DEFAULT_BASELINE_NAME",
+    "lint_dag", "lint_source", "lint_file", "abstract_probe",
+    "lint_paths", "lint_workflow", "lint_model",
+    "format_text", "format_json", "summarize",
+]

@@ -1,0 +1,78 @@
+"""Baseline + inline suppression for lint findings.
+
+Two mechanisms, mirroring mature analyzers:
+
+- **Inline**: a ``# tx-lint: disable=TX-J01`` (or ``disable`` for all
+  rules) comment on the offending line suppresses source findings there.
+- **Baseline file** (``.txlint-baseline.json``): a recorded set of
+  finding fingerprints (rule + file/subject + message, line-independent)
+  that are accepted debt; ``cli lint --write-baseline`` records the
+  current findings, subsequent runs report only NEW findings. An entry
+  that no longer matches anything is reported by ``--format json`` as
+  ``stale_baseline`` so the file can be re-tightened.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Iterable, List, Sequence, Tuple
+
+from .findings import LintFinding
+
+__all__ = ["Baseline", "is_suppressed_inline", "DEFAULT_BASELINE_NAME"]
+
+DEFAULT_BASELINE_NAME = ".txlint-baseline.json"
+
+_DISABLE_RE = re.compile(
+    r"#\s*tx-lint:\s*disable(?:=(?P<rules>[A-Z0-9,\-\s]+))?")
+
+
+def is_suppressed_inline(source_line: str, rule_id: str) -> bool:
+    """True when the line carries a ``# tx-lint: disable[=RULES]``
+    comment naming this rule (or naming no rule = all rules)."""
+    m = _DISABLE_RE.search(source_line)
+    if not m:
+        return False
+    rules = m.group("rules")
+    if rules is None:
+        return True
+    return rule_id in {r.strip() for r in rules.split(",")}
+
+
+class Baseline:
+    """A set of accepted finding fingerprints."""
+
+    def __init__(self, fingerprints: Iterable[str] = ()):
+        self.fingerprints = set(fingerprints)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as fh:
+            data = json.load(fh)
+        return cls(data.get("suppressed", []))
+
+    @staticmethod
+    def write(path: str, findings: Sequence[LintFinding]) -> None:
+        payload = {
+            "version": 1,
+            "comment": "accepted tx-lint findings; regenerate with "
+                       "`python -m transmogrifai_tpu.cli lint "
+                       "--write-baseline`",
+            "suppressed": sorted({f.fingerprint() for f in findings}),
+        }
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+
+    def split(self, findings: Sequence[LintFinding]
+              ) -> Tuple[List[LintFinding], List[str]]:
+        """(new findings not in the baseline, stale fingerprints no
+        finding matched)."""
+        seen = {f.fingerprint() for f in findings}
+        fresh = [f for f in findings
+                 if f.fingerprint() not in self.fingerprints]
+        stale = sorted(self.fingerprints - seen)
+        return fresh, stale

@@ -1,0 +1,75 @@
+"""CLI glue for ``python -m transmogrifai_tpu.cli lint``.
+
+Exit codes (stable contract, used by CI):
+
+- **0** — clean (no findings after baseline/suppressions)
+- **1** — findings reported
+- **2** — internal error (bad paths, unreadable baseline, crash)
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .baseline import Baseline, DEFAULT_BASELINE_NAME
+from .engine import format_json, format_text, lint_paths
+from .findings import RULES
+
+__all__ = ["add_lint_parser", "run_lint"]
+
+#: default lint target: the package's own source tree
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def add_lint_parser(sub) -> None:
+    lint = sub.add_parser(
+        "lint",
+        help="static pre-flight analysis of the JAX compile path "
+             "(exit 0 clean / 1 findings / 2 internal error)")
+    lint.add_argument("paths", nargs="*", default=None,
+                      help=f"files/directories to analyze "
+                           f"(default: {os.path.basename(_PKG_ROOT)} "
+                           f"package source)")
+    lint.add_argument("--format", choices=["text", "json"], default="text",
+                      help="output format (default: text)")
+    lint.add_argument("--baseline", default=None,
+                      help=f"baseline file of accepted findings "
+                           f"(default: ./{DEFAULT_BASELINE_NAME} when "
+                           f"present)")
+    lint.add_argument("--write-baseline", action="store_true",
+                      help="record current findings as the new baseline "
+                           "and exit 0")
+    lint.add_argument("--list-rules", action="store_true",
+                      help="print the rule catalog and exit")
+
+
+def run_lint(args) -> int:
+    try:
+        if args.list_rules:
+            for rid, (sev, summary) in sorted(RULES.items()):
+                print(f"{rid}  {sev:7s}  {summary}")
+            return 0
+        paths = args.paths or [_PKG_ROOT]
+        baseline_path = args.baseline
+        if baseline_path is None and os.path.exists(DEFAULT_BASELINE_NAME):
+            baseline_path = DEFAULT_BASELINE_NAME
+        baseline = Baseline.load(baseline_path) if baseline_path else None
+        if args.write_baseline:
+            findings, _ = lint_paths(paths, baseline=None)
+            out = args.baseline or DEFAULT_BASELINE_NAME
+            Baseline.write(out, findings)
+            print(f"baseline written: {out} "
+                  f"({len(findings)} finding(s) recorded)")
+            return 0
+        findings, stale = lint_paths(paths, baseline=baseline)
+        if args.format == "json":
+            print(format_json(findings, stale))
+        else:
+            print(format_text(findings, stale))
+        return 1 if findings else 0
+    except BrokenPipeError:  # pragma: no cover
+        raise
+    except Exception as e:
+        print(f"tx-lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2

@@ -1,0 +1,139 @@
+"""The lint engine: rule orchestration, suppression, output formats.
+
+Front doors:
+
+- :func:`lint_paths` — AST/JAX rules over source trees (what
+  ``python -m transmogrifai_tpu.cli lint`` runs).
+- :func:`lint_workflow` — DAG rules over a constructed (un-run)
+  ``Workflow``; what ``Workflow.train(validate=...)`` calls pre-flight.
+- :func:`lint_model` — DAG rules over a fitted ``WorkflowModel``
+  (scoring contract: no unfitted estimators, metadata consistent).
+
+All return plain ``LintFinding`` lists after applying inline
+``# tx-lint: disable=...`` comments and the optional baseline file.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from .baseline import Baseline, is_suppressed_inline
+from .findings import ERROR, LintFinding
+from .rules_dag import lint_dag
+from .rules_jax import lint_file
+
+__all__ = ["lint_paths", "lint_workflow", "lint_model", "iter_py_files",
+           "format_text", "format_json", "summarize"]
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git",
+                                        ".jax_cache", "node_modules")]
+                out.extend(os.path.join(root, f) for f in files
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"not a .py file or directory: {p}")
+    missing = [p for p in out if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(f"no such file: {missing[0]}")
+    return sorted(set(out))
+
+
+def _apply_inline_suppressions(findings: List[LintFinding]
+                               ) -> List[LintFinding]:
+    """Drop findings whose source line opts out via ``# tx-lint:``."""
+    kept: List[LintFinding] = []
+    cache: dict = {}
+    for f in findings:
+        if f.path and f.line:
+            lines = cache.get(f.path)
+            if lines is None:
+                try:
+                    with open(f.path, encoding="utf-8") as fh:
+                        lines = fh.readlines()
+                except OSError:
+                    lines = []
+                cache[f.path] = lines
+            if 0 < f.line <= len(lines) and is_suppressed_inline(
+                    lines[f.line - 1], f.rule_id):
+                continue
+        kept.append(f)
+    return kept
+
+
+def lint_paths(paths: Sequence[str],
+               baseline: Optional[Baseline] = None
+               ) -> Tuple[List[LintFinding], List[str]]:
+    """(findings, stale baseline fingerprints) for the source rules."""
+    findings: List[LintFinding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path))
+    findings = _apply_inline_suppressions(findings)
+    if baseline is not None:
+        return baseline.split(findings)
+    return findings, []
+
+
+def lint_workflow(workflow, extra_features: Sequence = ()
+                  ) -> List[LintFinding]:
+    """DAG rules over an un-trained workflow — pure graph walk, runs in
+    milliseconds, touches no data and no device."""
+    if not workflow.result_features:
+        return [LintFinding(
+            rule_id="TX-D03", severity=ERROR, subject="<workflow>",
+            message="workflow has no result features",
+            hint="call set_result_features(...) before train()")]
+    return lint_dag(workflow.result_features,
+                    extra_features=extra_features, scoring=False)
+
+
+def lint_model(model, extra_features: Sequence = ()) -> List[LintFinding]:
+    """DAG rules over a fitted WorkflowModel, scoring contract enforced
+    (TX-D05: no unfitted estimator may remain)."""
+    return lint_dag(model.result_features,
+                    extra_features=extra_features, scoring=True)
+
+
+# ---------------------------------------------------------------------------
+# output formats
+# ---------------------------------------------------------------------------
+
+def summarize(findings: Sequence[LintFinding]) -> str:
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    warnings = len(findings) - errors
+    return f"{len(findings)} finding(s): {errors} error(s), " \
+           f"{warnings} warning(s)"
+
+
+def format_text(findings: Sequence[LintFinding],
+                stale: Sequence[str] = ()) -> str:
+    lines = [str(f) for f in findings]
+    if findings:
+        lines.append(summarize(findings))
+    else:
+        lines.append("clean: no lint findings")
+    if stale:
+        lines.append(f"note: {len(stale)} stale baseline entr"
+                     f"{'y' if len(stale) == 1 else 'ies'} no longer "
+                     f"match — regenerate with --write-baseline")
+    return "\n".join(lines)
+
+
+def format_json(findings: Sequence[LintFinding],
+                stale: Sequence[str] = ()) -> str:
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    return json.dumps({
+        "findings": [f.to_json() for f in findings],
+        "counts": {"total": len(findings), "errors": errors,
+                   "warnings": len(findings) - errors},
+        "stale_baseline": list(stale),
+    }, indent=1)

@@ -1,0 +1,110 @@
+"""Structured lint findings — the analyzer's output model.
+
+Every rule emits :class:`LintFinding` records: a stable rule id, a
+severity, a location (``file:line`` for source findings, a feature/stage
+uid for DAG findings), a human message and an actionable fix hint. The
+records are JSON-serializable (``--format json``) and fingerprinted for
+the baseline/suppression mechanism (lint/baseline.py).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+__all__ = ["LintFinding", "LintError", "RULES", "ERROR", "WARNING",
+           "rule_severity"]
+
+ERROR = "error"
+WARNING = "warning"
+
+#: rule catalog: id -> (default severity, one-line summary). docs/lint.md
+#: documents each in full; `cli lint --list-rules` prints this table.
+RULES: Dict[str, tuple] = {
+    # -- DAG rules (pure graph walk, no tracing) ---------------------------
+    "TX-D01": (ERROR, "label-leakage path: a response feature reaches a "
+                      "predictor's feature matrix"),
+    "TX-D02": (ERROR, "feature DAG contains a cycle"),
+    "TX-D03": (WARNING, "dead stage: a built feature does not contribute "
+                        "to any result feature"),
+    "TX-D04": (ERROR, "stage input edge violates the declared feature "
+                      "type contract"),
+    "TX-D05": (ERROR, "untrained estimator in a scoring DAG"),
+    "TX-D06": (ERROR, "duplicate stage uid aliases fitted models"),
+    "TX-D07": (ERROR, "vector metadata column count disagrees with the "
+                      "model's feature dimension"),
+    # -- JAX compile-path rules (AST + abstract eval, no device code) ------
+    "TX-J01": (ERROR, "implicit host transfer inside a jitted function "
+                      "(np.* call / .item() / float() on a traced value)"),
+    "TX-J02": (WARNING, "recompilation hazard: jax.jit applied per call "
+                        "instead of once"),
+    "TX-J03": (ERROR, "non-hashable value passed for a static jit "
+                      "argument"),
+    "TX-J04": (WARNING, "float64 creep inside a jitted function"),
+    "TX-J05": (ERROR, "Python control flow on a traced value inside a "
+                      "jitted function (concrete-shape dependence)"),
+    # -- infrastructure ----------------------------------------------------
+    "TX-E00": (ERROR, "source file does not parse"),
+}
+
+
+def rule_severity(rule_id: str) -> str:
+    return RULES.get(rule_id, (ERROR,))[0]
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    """One defect found by one rule at one location."""
+    rule_id: str
+    message: str
+    severity: str = ERROR
+    #: source findings: repo-relative path + 1-based line
+    path: Optional[str] = None
+    line: int = 0
+    #: DAG findings: the offending feature/stage uid (location stand-in)
+    subject: Optional[str] = None
+    hint: Optional[str] = None
+
+    def location(self) -> str:
+        if self.path:
+            return f"{self.path}:{self.line}" if self.line else self.path
+        return self.subject or "<dag>"
+
+    def fingerprint(self) -> str:
+        """Stable identity for baseline suppression: rule + file/subject +
+        message, deliberately excluding the line number so unrelated
+        edits above a finding don't invalidate the baseline."""
+        raw = "|".join((self.rule_id, self.path or self.subject or "",
+                        self.message))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "severity": self.severity,
+            "location": self.location(),
+            "path": self.path,
+            "line": self.line,
+            "subject": self.subject,
+            "message": self.message,
+            "hint": self.hint,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def __str__(self) -> str:
+        hint = f"  [{self.hint}]" if self.hint else ""
+        return (f"{self.location()}: {self.severity}: "
+                f"{self.rule_id}: {self.message}{hint}")
+
+
+class LintError(ValueError):
+    """Raised by ``Workflow.train(validate='strict')`` when the pre-flight
+    analyzer finds errors — BEFORE any data is read, any stage traced or
+    any device buffer allocated."""
+
+    def __init__(self, findings: List[LintFinding]):
+        self.findings = list(findings)
+        lines = "\n".join(f"  {f}" for f in self.findings)
+        super().__init__(
+            f"workflow failed pre-flight lint with "
+            f"{len(self.findings)} finding(s):\n{lines}")

@@ -1,0 +1,252 @@
+"""DAG-level lint rules: pure graph walks over the Feature/stage DAG.
+
+No data is read, no stage is traced, no device buffer is allocated —
+every rule here works off the static metadata a constructed DAG already
+carries (``Feature.parents`` / ``Feature.is_response`` /
+``PipelineStage.static_input_types()``). This is the pre-flight
+equivalent of the reference's compile-time type safety: the same defects
+``train()`` would eventually hit after minutes of tracing are reported
+in milliseconds.
+
+Rules (catalog in lint/findings.py, prose in docs/lint.md):
+
+- TX-D01 label leakage   - TX-D02 cycles        - TX-D03 dead stages
+- TX-D04 type mismatch   - TX-D05 untrained     - TX-D06 duplicate uids
+- TX-D07 vector metadata/model dimension mismatch
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import AllowLabelAsInput, Estimator, PipelineStage
+from .findings import ERROR, WARNING, LintFinding
+
+__all__ = ["lint_dag", "collect_graph"]
+
+
+def collect_graph(result_features: Sequence[Feature]
+                  ) -> Tuple[List[Feature], List[PipelineStage],
+                             List[Tuple[Feature, Feature]]]:
+    """(features, stages, cycle back-edges) reachable from the results.
+
+    Iterative DFS that records back edges instead of raising
+    ``FeatureCycleError`` — the linter must report every problem, not
+    die on the first."""
+    feats: Dict[str, Feature] = {}
+    stages: Dict[str, PipelineStage] = {}
+    back_edges: List[Tuple[Feature, Feature]] = []
+    color: Dict[str, int] = {}            # 1=on current path, 2=done
+
+    for root in result_features:
+        stack: List[Tuple[Feature, int]] = [(root, 0)]
+        while stack:
+            f, pi = stack.pop()
+            if pi == 0:
+                if color.get(f.uid) == 2:
+                    continue
+                color[f.uid] = 1
+                feats[f.uid] = f
+                if f.origin_stage is not None:
+                    # uid collisions surface via TX-D06, keep the first
+                    stages.setdefault(f.origin_stage.uid, f.origin_stage)
+            if pi < len(f.parents):
+                p = f.parents[pi]
+                stack.append((f, pi + 1))
+                if color.get(p.uid) == 1:
+                    back_edges.append((f, p))    # cycle edge: skip descent
+                elif color.get(p.uid) != 2:
+                    stack.append((p, 0))
+            else:
+                color[f.uid] = 2
+    return list(feats.values()), list(stages.values()), back_edges
+
+
+def _is_predictor_like(stage: PipelineStage) -> bool:
+    """Stages with the (label, feature-matrix) contract of the model
+    layer — the sinks label leakage must never reach."""
+    from ..models.base import PredictionModel, Predictor
+    return isinstance(stage, (Predictor, PredictionModel))
+
+
+def _taint(feats: List[Feature]) -> Dict[str, bool]:
+    """feature uid -> True when a raw response is reachable upward
+    WITHOUT crossing an ``AllowLabelAsInput`` stage (which consumes the
+    label legitimately, e.g. SanityChecker). A tainted feature carries
+    label information that a predictor must never see."""
+    memo: Dict[str, bool] = {}
+
+    def go(f: Feature, on_path: Set[str]) -> bool:
+        if f.uid in memo:
+            return memo[f.uid]
+        if f.uid in on_path:        # cycle guard; TX-D02 reports it
+            return False
+        if f.is_raw:
+            memo[f.uid] = bool(f.is_response)
+            return memo[f.uid]
+        if isinstance(f.origin_stage, AllowLabelAsInput):
+            memo[f.uid] = False
+            return False
+        on_path = on_path | {f.uid}
+        memo[f.uid] = any(go(p, on_path) for p in f.parents)
+        return memo[f.uid]
+
+    for f in feats:
+        go(f, set())
+    return memo
+
+
+def _converter_hint(expected: type) -> Optional[str]:
+    """Point at the matching ``types.conversions`` helper when one
+    exists for the expected feature type (to_real, to_op_vector, ...)."""
+    from ..types import conversions
+    want = expected.__name__.lower()
+    for name in conversions.__all__:
+        if name.startswith("to_") and name[3:].replace("_", "") == want:
+            return (f"convert the value in the extract/transform fn via "
+                    f"types.conversions.{name}()")
+    return None
+
+
+def lint_dag(result_features: Sequence[Feature],
+             extra_features: Sequence[Feature] = (),
+             scoring: bool = False) -> List[LintFinding]:
+    """Run every DAG rule; returns findings (empty = clean).
+
+    ``extra_features`` are features the caller built that SHOULD
+    contribute to the results — any that don't are dead stages (TX-D03),
+    the classic "sanity-checked the vector but wired the unchecked one
+    into the selector" bug. ``scoring=True`` additionally requires every
+    estimator to be fitted (TX-D05) — the contract of a scoring DAG."""
+    findings: List[LintFinding] = []
+    feats, stages, back_edges = collect_graph(result_features)
+
+    # TX-D02: cycles -------------------------------------------------------
+    for child, ancestor in back_edges:
+        findings.append(LintFinding(
+            rule_id="TX-D02", severity=ERROR,
+            subject=child.uid,
+            message=f"feature cycle: {child.name!r} depends on "
+                    f"{ancestor.name!r} which is also its descendant",
+            hint="a stage output cannot be (transitively) its own input; "
+                 "rebuild the offending feature instead of rewiring it "
+                 "into its own ancestry"))
+
+    # TX-D06: duplicate stage uids ----------------------------------------
+    by_uid: Dict[str, PipelineStage] = {}
+    for f in feats:
+        s = f.origin_stage
+        if s is None:
+            continue
+        other = by_uid.setdefault(s.uid, s)
+        if other is not s:
+            findings.append(LintFinding(
+                rule_id="TX-D06", severity=ERROR, subject=s.uid,
+                message=f"duplicate stage uid {s.uid!r}: "
+                        f"{type(other).__name__} and {type(s).__name__} "
+                        f"share it — fitted-model rewiring would alias "
+                        f"them",
+                hint="give each stage instance its own uid; don't reuse "
+                     "one stage object with different inputs"))
+
+    # TX-D04: input-edge type contract ------------------------------------
+    for s in stages:
+        if isinstance(s, FeatureGeneratorStage) or not s.input_features:
+            continue
+        expected = s.static_input_types()
+        if expected is None:
+            continue
+        if len(expected) != len(s.input_features):
+            findings.append(LintFinding(
+                rule_id="TX-D04", severity=ERROR, subject=s.uid,
+                message=f"{type(s).__name__} declares {len(expected)} "
+                        f"inputs but is wired with "
+                        f"{len(s.input_features)}",
+                hint="re-wire the stage with set_input(...) matching its "
+                     "arity"))
+            continue
+        for i, (f, t) in enumerate(zip(s.input_features, expected)):
+            if t is not None and not issubclass(f.ftype, t):
+                findings.append(LintFinding(
+                    rule_id="TX-D04", severity=ERROR, subject=s.uid,
+                    message=f"{type(s).__name__} input {i} "
+                            f"({f.name!r}) must be {t.__name__}, got "
+                            f"{f.ftype.__name__}",
+                    hint=_converter_hint(t) or
+                         f"produce a {t.__name__} feature upstream"))
+
+    # TX-D01: label leakage into predictor feature matrices ----------------
+    tainted = _taint(feats)
+    for s in stages:
+        if not _is_predictor_like(s) or len(s.input_features) != 2:
+            continue
+        label_f, matrix_f = s.input_features
+        if matrix_f.is_response:
+            findings.append(LintFinding(
+                rule_id="TX-D01", severity=ERROR, subject=s.uid,
+                message=f"{type(s).__name__} feature-matrix input "
+                        f"{matrix_f.name!r} is itself a response — the "
+                        f"model would train on the label",
+                hint="wire the predictor matrix, not the label, as "
+                     "input 2"))
+        elif tainted.get(matrix_f.uid):
+            findings.append(LintFinding(
+                rule_id="TX-D01", severity=ERROR, subject=s.uid,
+                message=f"label-leakage path: response feature(s) reach "
+                        f"{type(s).__name__}'s feature matrix "
+                        f"{matrix_f.name!r} without passing through a "
+                        f"label-aware stage",
+                hint="route label-consuming derivations through an "
+                     "AllowLabelAsInput stage (e.g. sanity_check) or "
+                     "drop the response from the matrix"))
+
+    # TX-D05: untrained estimator in a scoring DAG -------------------------
+    if scoring:
+        for s in stages:
+            if isinstance(s, Estimator):
+                findings.append(LintFinding(
+                    rule_id="TX-D05", severity=ERROR, subject=s.uid,
+                    message=f"unfitted estimator {type(s).__name__} "
+                            f"({s.uid}) in a scoring DAG — score() would "
+                            f"fail after materializing the raw data",
+                    hint="train the workflow first; score through the "
+                         "WorkflowModel returned by train()"))
+
+    # TX-D07: vector metadata vs model feature dimension -------------------
+    for s in stages:
+        meta = getattr(s, "vector_metadata", None)
+        coef = getattr(s, "coefficients", None)
+        if meta is None or coef is None or not hasattr(coef, "shape"):
+            continue
+        if not coef.shape:        # scalar coefficient: nothing to check
+            continue
+        dim = coef.shape[-1]
+        if meta.size and dim != meta.size:
+            findings.append(LintFinding(
+                rule_id="TX-D07", severity=ERROR, subject=s.uid,
+                message=f"{type(s).__name__} was fitted on {dim} "
+                        f"columns but its vector metadata describes "
+                        f"{meta.size} — insights/LOCO would mis-attribute "
+                        f"every column",
+                hint="regenerate the metadata with the matrix that "
+                     "actually trained the model (vector surgery must "
+                     "update both)"))
+
+    # TX-D03: dead stages (declared features that feed nothing) ------------
+    if extra_features:
+        reachable = {f.uid for f in feats}
+        for f in extra_features:
+            if f.uid in reachable:
+                continue
+            origin = type(f.origin_stage).__name__ if f.origin_stage \
+                else "raw feature"
+            findings.append(LintFinding(
+                rule_id="TX-D03", severity=WARNING, subject=f.uid,
+                message=f"dead stage: feature {f.name!r} ({origin}) is "
+                        f"built but does not contribute to any result "
+                        f"feature",
+                hint="wire it into the result DAG or delete it — a "
+                     "common form is sanity-checking a vector but "
+                     "feeding the UNchecked vector to the selector"))
+    return findings

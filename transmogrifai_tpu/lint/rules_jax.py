@@ -1,0 +1,531 @@
+"""JAX compile-path lint rules: AST analysis + abstract shape probing.
+
+Static analysis of the device hot path — the defects XLA only surfaces
+after minutes of tracing (or never surfaces, silently recompiling every
+call) are caught here in milliseconds:
+
+- TX-J01 implicit host transfer inside a jitted function: ``np.*`` calls,
+  ``.item()`` / ``.tolist()``, or ``float()/int()/bool()`` applied to a
+  traced value — each forces a device->host sync per call.
+- TX-J02 recompilation hazard: ``jax.jit`` applied inside a loop or a
+  plain (non-memoized) function body builds a FRESH jitted callable per
+  call, so XLA recompiles every time. The blessed repo idiom — a
+  ``functools.lru_cache``'d builder returning ``jax.jit(...)`` — is
+  recognized and allowed.
+- TX-J03 non-hashable static argument: a list/dict/set passed for a
+  parameter the jit declares static — TypeError at trace time, or (for
+  a tuple-of-list) a silent cache miss per call.
+- TX-J04 float64 creep: float64 dtypes requested inside a jitted
+  function — on TPU this means silent f32 downcast (x64 off) or a 2x
+  memory/bandwidth tax (x64 on).
+- TX-J05 Python control flow on a traced value: ``if``/``while`` on a
+  non-static parameter concretizes the tracer -> TracerBoolConversionError
+  at trace time, i.e. concrete-shape dependence.
+
+Scope discipline keeps the rules precise: J01/J04/J05 only fire INSIDE
+functions statically known to be jitted (decorated with ``jax.jit`` or
+``functools.partial(jax.jit, ...)``); host-side numpy orchestration code
+is untouched. ``abstract_probe`` complements the AST with
+``jax.eval_shape`` — tracing a callable with abstract values only, so
+host-transfer and concretization defects hidden behind dynamic dispatch
+are confirmed without executing a single device instruction.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .findings import ERROR, WARNING, LintFinding
+
+__all__ = ["lint_source", "lint_file", "abstract_probe"]
+
+#: attribute accesses on a traced value that stay abstract (shape/dtype
+#: are static at trace time — reading them is free and safe)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "at"}
+
+#: np.<fn> calls that are trace-time constants, not host transfers
+_NP_SAFE_CALLS = {"iinfo", "finfo", "dtype"}
+
+#: methods that force a device->host transfer / concretization
+_HOST_METHODS = {"item", "tolist", "block_until_ready", "to_py"}
+
+_F64_NAMES = {"float64", "f64", "double"}
+
+
+# ---------------------------------------------------------------------------
+# import/alias resolution
+# ---------------------------------------------------------------------------
+
+class _Aliases:
+    """Names the module binds to numpy / jax / jax.numpy / functools."""
+
+    def __init__(self):
+        self.numpy: Set[str] = set()
+        self.jax: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.jit: Set[str] = set()        # `from jax import jit [as j]`
+        self.partial: Set[str] = set()    # `from functools import partial`
+        self.functools: Set[str] = set()
+        self.lru: Set[str] = set()        # `from functools import lru_cache`
+
+    @classmethod
+    def collect(cls, tree: ast.Module) -> "_Aliases":
+        al = cls()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name
+                    if a.name == "numpy":
+                        al.numpy.add(name)
+                    elif a.name == "jax":
+                        al.jax.add(name)
+                    elif a.name == "jax.numpy":
+                        al.jnp.add(name)
+                    elif a.name == "functools":
+                        al.functools.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    name = a.asname or a.name
+                    if node.module == "jax" and a.name == "jit":
+                        al.jit.add(name)
+                    elif node.module == "jax" and a.name == "numpy":
+                        al.jnp.add(name)
+                    elif node.module == "functools":
+                        if a.name == "partial":
+                            al.partial.add(name)
+                        elif a.name in ("lru_cache", "cache"):
+                            al.lru.add(name)
+        return al
+
+    def is_jax_jit(self, node: ast.AST) -> bool:
+        """``jax.jit`` / bare ``jit`` reference."""
+        if isinstance(node, ast.Attribute) and node.attr == "jit" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in self.jax:
+            return True
+        return isinstance(node, ast.Name) and node.id in self.jit
+
+    def is_partial(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in self.partial:
+            return True
+        return (isinstance(node, ast.Attribute) and node.attr == "partial"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.functools)
+
+    def is_lru_cache(self, node: ast.AST) -> bool:
+        target = node.func if isinstance(node, ast.Call) else node
+        if isinstance(target, ast.Name) and target.id in self.lru:
+            return True
+        return (isinstance(target, ast.Attribute)
+                and target.attr in ("lru_cache", "cache")
+                and isinstance(target.value, ast.Name)
+                and target.value.id in self.functools)
+
+
+def _static_names_from_call(call: ast.Call,
+                            fn: Optional[ast.FunctionDef]) -> Set[str]:
+    """Parameter names declared static via static_argnames/static_argnums
+    keywords of a ``jax.jit`` / ``partial(jax.jit, ...)`` call."""
+    static: Set[str] = set()
+    params = []
+    if fn is not None:
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg == "static_argnames":
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    static.add(e.value)
+        elif kw.arg == "static_argnums":
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                        and 0 <= e.value < len(params):
+                    static.add(params[e.value])
+    return static
+
+
+def _jit_decoration(fn: ast.FunctionDef, al: _Aliases
+                    ) -> Optional[Set[str]]:
+    """None when ``fn`` is not statically jitted; otherwise the set of
+    static parameter names. Recognizes ``@jax.jit``, ``@jit``,
+    ``@jax.jit(...)`` and ``@functools.partial(jax.jit, ...)``."""
+    for dec in fn.decorator_list:
+        if al.is_jax_jit(dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            if al.is_jax_jit(dec.func):
+                return _static_names_from_call(dec, fn)
+            if al.is_partial(dec.func) and dec.args \
+                    and al.is_jax_jit(dec.args[0]):
+                return _static_names_from_call(dec, fn)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# traced-value reachability inside an expression
+# ---------------------------------------------------------------------------
+
+def _mentions_traced(node: ast.AST, traced: Set[str]) -> bool:
+    """Does the expression reference a traced name in a way that needs a
+    concrete value? Reads of static attributes (``x.shape``...) and
+    ``len(x)`` are trace-time constants and don't count."""
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False                       # x.shape / x.dtype: static
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id == "len":
+            return False                   # len(traced) is static
+        if isinstance(node.func, ast.Attribute):
+            # x.astype(...) etc: the CALL result is still traced, but
+            # deciding that needs type inference; the test below treats
+            # the receiver as the signal
+            return any(_mentions_traced(a, traced)
+                       for a in [node.func.value] + list(node.args))
+        return any(_mentions_traced(a, traced)
+                   for a in list(node.args)
+                   + [kw.value for kw in node.keywords])
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` never concretizes a tracer
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+    return any(_mentions_traced(c, traced)
+               for c in ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# the per-file visitor
+# ---------------------------------------------------------------------------
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, al: _Aliases):
+        self.path = path
+        self.al = al
+        self.findings: List[LintFinding] = []
+        #: stack of enclosing FunctionDefs, innermost last
+        self.fn_stack: List[ast.FunctionDef] = []
+        #: stack of "inside a loop" flags per function level
+        self.loop_depth = 0
+        #: when non-None we are inside a jitted function: set of traced
+        #: (non-static) parameter names accumulated over nested scopes
+        self.jit_ctx: Optional[Set[str]] = None
+        self.jit_fn_name = ""
+        #: module-level registry: jitted fn name -> static argnames
+        self.jitted_statics: Dict[str, Set[str]] = {}
+
+    # -- helpers -----------------------------------------------------------
+    def add(self, rule: str, node: ast.AST, message: str,
+            severity: str, hint: str = None) -> None:
+        self.findings.append(LintFinding(
+            rule_id=rule, severity=severity, path=self.path,
+            line=getattr(node, "lineno", 0), message=message, hint=hint))
+
+    def _in_memoized_builder(self) -> bool:
+        """True when any enclosing function is an lru_cache'd builder —
+        the jit-once idiom (build + cache the jitted callable per static
+        config)."""
+        return any(
+            any(self.al.is_lru_cache(d) for d in fn.decorator_list)
+            for fn in self.fn_stack)
+
+    # -- function defs -----------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        statics = _jit_decoration(node, self.al)
+        outer_ctx, outer_name = self.jit_ctx, self.jit_fn_name
+        outer_loops = self.loop_depth
+        if statics is not None:
+            # a jitted function: params minus statics are traced values
+            if not self.fn_stack:
+                self.jitted_statics[node.name] = statics
+            elif not self._in_memoized_builder():
+                self.add(
+                    "TX-J02", node,
+                    f"@jit function {node.name!r} is (re)defined per call "
+                    f"of {self.fn_stack[-1].name!r} — every call builds a "
+                    f"fresh jitted callable and recompiles",
+                    WARNING,
+                    hint="hoist the @jit function to module level, or "
+                         "memoize the builder with functools.lru_cache")
+            params = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                      + node.args.kwonlyargs)}
+            self.jit_ctx = (params - statics) | (outer_ctx or set())
+            self.jit_fn_name = node.name
+        elif self.jit_ctx is not None:
+            # nested helper inside a jit body: its params are traced too
+            # (they receive traced values from scan/vmap/call sites)
+            params = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                      + node.args.kwonlyargs)}
+            self.jit_ctx = self.jit_ctx | params
+        self.fn_stack.append(node)
+        self.loop_depth = 0
+        self.generic_visit(node)
+        self.fn_stack.pop()
+        self.loop_depth = outer_loops
+        self.jit_ctx, self.jit_fn_name = outer_ctx, outer_name
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- loops -------------------------------------------------------------
+    def visit_For(self, node: ast.For) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_control_flow(node)
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_control_flow(node)
+        self.generic_visit(node)
+
+    def _check_control_flow(self, node) -> None:
+        # TX-J05: Python branching on a traced value inside jit
+        if self.jit_ctx is None:
+            return
+        if _mentions_traced(node.test, self.jit_ctx):
+            kind = "while" if isinstance(node, ast.While) else "if"
+            self.add(
+                "TX-J05", node,
+                f"`{kind}` on a traced value inside jitted "
+                f"{self.jit_fn_name!r} — concretizes the tracer "
+                f"(TracerBoolConversionError at trace time)",
+                ERROR,
+                hint="use jnp.where / lax.cond / lax.while_loop, or "
+                     "declare the parameter static via static_argnames")
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        al = self.al
+        # TX-J02: jax.jit(...) applied at call time -----------------------
+        if al.is_jax_jit(node.func):
+            if self.loop_depth > 0:
+                self.add(
+                    "TX-J02", node,
+                    "jax.jit(...) called inside a loop — a fresh jitted "
+                    "callable (and a full XLA recompile) per iteration",
+                    ERROR,
+                    hint="hoist the jit call out of the loop; the loop "
+                         "should call ONE jitted function")
+            elif self.fn_stack and not self._in_memoized_builder():
+                self.add(
+                    "TX-J02", node,
+                    f"jax.jit(...) called per invocation of "
+                    f"{self.fn_stack[-1].name!r} — the returned callable "
+                    f"is rebuilt (and recompiled) every call",
+                    WARNING,
+                    hint="decorate the enclosing builder with "
+                         "functools.lru_cache (the memoized-builder "
+                         "idiom) or jit once at module level")
+            # register module-level `name = jax.jit(fn, static_...)`
+        # TX-J03: non-hashable static args at a call site ------------------
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self.jitted_statics:
+            statics = self.jitted_statics[node.func.id]
+            for kw in node.keywords:
+                if kw.arg in statics and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set,
+                                   ast.ListComp, ast.DictComp,
+                                   ast.SetComp, ast.GeneratorExp)):
+                    kind = type(kw.value).__name__.lower()
+                    self.add(
+                        "TX-J03", node,
+                        f"static argument {kw.arg!r} of jitted "
+                        f"{node.func.id!r} receives a non-hashable "
+                        f"{kind} — TypeError at trace time",
+                        ERROR,
+                        hint="pass a tuple (hashable) instead; static "
+                             "args key the compilation cache")
+        # TX-J01: host transfers inside jit --------------------------------
+        if self.jit_ctx is not None:
+            self._check_host_transfer(node)
+        # TX-J04: float64 creep inside jit ---------------------------------
+        # Only dtype REQUESTS count (dtype= kwarg, .astype(f64),
+        # jnp.float64(x), positional dtype of a jnp/np constructor) — a
+        # `x.dtype == jnp.float64` comparison is a guard, not creep.
+        if self.jit_ctx is not None:
+            f64_args = [kw.value for kw in node.keywords
+                        if kw.arg == "dtype" and self._is_f64(kw.value)]
+            fn = node.func
+            is_cast = (isinstance(fn, ast.Attribute)
+                       and fn.attr == "astype") or self._is_f64(fn)
+            is_array_ctor = (isinstance(fn, ast.Attribute)
+                             and isinstance(fn.value, ast.Name)
+                             and fn.value.id in (self.al.jnp
+                                                 | self.al.numpy))
+            if is_cast or is_array_ctor:
+                f64_args += [a for a in node.args if self._is_f64(a)]
+            if self._is_f64(fn):
+                f64_args.append(fn)
+            if f64_args:
+                self.add(
+                    "TX-J04", node,
+                    f"float64 dtype requested inside jitted "
+                    f"{self.jit_fn_name!r}",
+                    WARNING,
+                    hint="TPUs execute f32/bf16; with x64 disabled this "
+                         "silently downcasts, with x64 enabled it "
+                         "doubles memory traffic — use float32 or an "
+                         "explicit bf16 policy")
+        self.generic_visit(node)
+
+    def _is_f64(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return node.value in _F64_NAMES
+        if isinstance(node, ast.Attribute):
+            return node.attr in _F64_NAMES
+        return False
+
+    def _check_host_transfer(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            root = fn.value
+            # np.<anything>(...) — numpy executes on host; feeding it a
+            # tracer raises, feeding it a device array syncs + copies
+            if isinstance(root, ast.Name) and root.id in self.al.numpy \
+                    and fn.attr not in _NP_SAFE_CALLS:
+                self.add(
+                    "TX-J01", node,
+                    f"numpy call np.{fn.attr}(...) inside jitted "
+                    f"{self.jit_fn_name!r} — numpy executes on the host "
+                    f"(TracerArrayConversionError or an implicit "
+                    f"device->host transfer)",
+                    ERROR,
+                    hint=f"use jnp.{fn.attr} (or a lax primitive) so the "
+                         f"op stays in the XLA program")
+            # chained module: np.linalg.solve etc.
+            elif isinstance(root, ast.Attribute) \
+                    and isinstance(root.value, ast.Name) \
+                    and root.value.id in self.al.numpy:
+                self.add(
+                    "TX-J01", node,
+                    f"numpy call np.{root.attr}.{fn.attr}(...) inside "
+                    f"jitted {self.jit_fn_name!r} — host execution",
+                    ERROR,
+                    hint=f"use jnp.{root.attr}.{fn.attr}")
+            elif fn.attr in _HOST_METHODS and _mentions_traced(
+                    fn.value, self.jit_ctx):
+                self.add(
+                    "TX-J01", node,
+                    f".{fn.attr}() on a traced value inside jitted "
+                    f"{self.jit_fn_name!r} — forces a device->host "
+                    f"transfer and a blocking sync",
+                    ERROR,
+                    hint="keep the value on device; materialize results "
+                         "only OUTSIDE the jitted function")
+        elif isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool") \
+                and node.args and _mentions_traced(node.args[0],
+                                                   self.jit_ctx):
+            self.add(
+                "TX-J01", node,
+                f"{fn.id}(...) applied to a traced value inside jitted "
+                f"{self.jit_fn_name!r} — concretizes the tracer "
+                f"(ConcretizationTypeError at trace time)",
+                ERROR,
+                hint="use .astype(...) for dtype casts; scalar reads "
+                     "belong outside the jitted function")
+
+    # -- module-level jit assignments for TX-J03 ---------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self.fn_stack and isinstance(node.value, ast.Call) \
+                and self.al.is_jax_jit(node.value.func) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            self.jitted_statics[node.targets[0].id] = \
+                _static_names_from_call(node.value, None)
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _register_module_jits(tree: ast.Module, al: _Aliases,
+                          visitor: _Visitor) -> None:
+    """Pre-pass: collect every module-level jitted function and its
+    static argnames BEFORE the main walk, so call sites earlier in the
+    file still get TX-J03 coverage."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            statics = _jit_decoration(node, al)
+            if statics is not None:
+                visitor.jitted_statics[node.name] = statics
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and al.is_jax_jit(node.value.func) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            visitor.jitted_statics[node.targets[0].id] = \
+                _static_names_from_call(node.value, None)
+
+
+def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
+    """Run every JAX AST rule over one source text."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [LintFinding(
+            rule_id="TX-E00", severity=ERROR, path=path,
+            line=e.lineno or 0,
+            message=f"source does not parse: {e.msg}")]
+    al = _Aliases.collect(tree)
+    visitor = _Visitor(path, al)
+    _register_module_jits(tree, al, visitor)
+    visitor.visit(tree)
+    return visitor.findings
+
+
+def lint_file(path: str) -> List[LintFinding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def abstract_probe(fn, *arg_specs) -> List[LintFinding]:
+    """Confirm compile-path defects by ABSTRACT tracing — ``jax.eval_shape``
+    runs the function with shape/dtype-only values: no device buffer is
+    allocated, no XLA program compiled, no kernel executed. Defects the
+    AST can't see statically (host transfers / concretization behind
+    dynamic dispatch) surface as typed exceptions here; float64 results
+    surface in the output aval dtypes.
+
+    ``arg_specs`` are ``jax.ShapeDtypeStruct``s (or arrays, used only
+    for their avals)."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (fn under probe usually needs it)
+
+    name = getattr(fn, "__name__", repr(fn))
+    findings: List[LintFinding] = []
+    try:
+        out = jax.eval_shape(fn, *arg_specs)
+    except jax.errors.TracerArrayConversionError as e:
+        findings.append(LintFinding(
+            rule_id="TX-J01", severity=ERROR, subject=name,
+            message=f"abstract probe of {name!r}: traced value converted "
+                    f"to a host numpy array ({type(e).__name__})",
+            hint="replace np.* with jnp.* inside the device function"))
+        return findings
+    except (jax.errors.TracerBoolConversionError,
+            jax.errors.ConcretizationTypeError) as e:
+        findings.append(LintFinding(
+            rule_id="TX-J05", severity=ERROR, subject=name,
+            message=f"abstract probe of {name!r}: Python control flow "
+                    f"required a concrete traced value "
+                    f"({type(e).__name__})",
+            hint="use lax.cond / lax.while_loop / jnp.where, or mark the "
+                 "argument static"))
+        return findings
+    import jax.tree_util as jtu
+    for leaf in jtu.tree_leaves(out):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None and str(dtype) == "float64":
+            findings.append(LintFinding(
+                rule_id="TX-J04", severity=WARNING, subject=name,
+                message=f"abstract probe of {name!r}: output has dtype "
+                        f"float64",
+                hint="cast to float32 before returning; TPUs have no "
+                     "native f64 path"))
+    return findings

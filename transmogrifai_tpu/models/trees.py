@@ -381,9 +381,20 @@ def _hist_mode(n: int = 0, total_bins: int = 0) -> str:
     mode = os.environ.get("TX_TREE_HIST")
     if mode:
         base, plus, suffix = mode.partition("+")
-        if base in base_modes and (not plus or suffix == "sub"):
+        if base in base_modes:
+            if plus and suffix != "sub":
+                # a typo'd suffix ("pallas+sb") must not silently throw
+                # away the user's explicit, valid base-mode choice
+                _log.warning(
+                    "TX_TREE_HIST=%r has unrecognized suffix %r "
+                    "(only '+sub' exists); honoring base mode %r",
+                    mode, suffix, base)
+                return base + "+sub" if sub else base
             # TX_TREE_SUB composes with an explicit base mode too
             return mode if suffix == "sub" or not sub else mode + "+sub"
+        _log.warning(
+            "TX_TREE_HIST=%r is not a recognized histogram mode %s; "
+            "falling back to the platform default", mode, base_modes)
     try:
         platform = jax.default_backend()
     except Exception:

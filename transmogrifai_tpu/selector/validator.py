@@ -30,6 +30,18 @@ __all__ = ["ValidationResult", "BestEstimator", "CrossValidation",
            "TrainValidationSplit"]
 
 
+def _async_dispatch_bytes(X, masks, X_val_st, y_val_st) -> int:
+    """Bytes concurrent family dispatch keeps resident on device AT
+    ONCE: the train matrix, the fold masks and (when the device fast
+    path is active) the stacked per-fold validation arrays. The async
+    HBM guard must sum all of them — counting X alone under-estimates
+    peak HBM for many-fold searches near the threshold."""
+    total = int(getattr(X, "nbytes", 0)) + int(masks.nbytes)
+    if X_val_st is not None:
+        total += int(X_val_st.nbytes) + int(y_val_st.nbytes)
+    return total
+
+
 @dataclass
 class ValidationResult:
     """Metric record for one (model family, grid point)
@@ -201,8 +213,10 @@ class _ValidatorBase:
         # uploads) would have fit. Beyond the cap, dispatch sequentially.
         async_cap = int(os.environ.get("TX_ASYNC_FAMILIES_MAX_BYTES",
                                        256 * 1024 * 1024))
+        dispatch_bytes = _async_dispatch_bytes(X, masks, X_val_st,
+                                               y_val_st)
         if (len(models) > 1 and spec is not None
-                and getattr(X, "nbytes", 0) <= async_cap
+                and dispatch_bytes <= async_cap
                 and os.environ.get("TX_ASYNC_FAMILIES", "1") != "0"):
             from concurrent.futures import ThreadPoolExecutor
             with ThreadPoolExecutor(max_workers=len(models)) as ex:

@@ -159,6 +159,27 @@ class PipelineStage:
         """Hook for semantic checks, e.g. response/predictor constraints
         (reference CheckIsResponseValues)."""
 
+    # -- static type metadata (consumed by the lint pre-flight) -----------
+    def static_input_types(self) -> Optional[List[Optional[type]]]:
+        """The declared input type contract resolved for the CURRENT
+        wiring, without touching data or tracing: one entry per wired
+        input (None = any FeatureType). Returns None when the stage
+        declares no contract, or when the wiring violates the arity so
+        badly the contract can't be resolved (lint reports that case
+        from the raw declaration instead)."""
+        if self.input_types is None:
+            return None
+        n = len(self.input_features) or len(self.input_types)
+        try:
+            return self.expected_input_types(n)
+        except ValueError:
+            return None
+
+    def static_output_type(self) -> Type[FeatureType]:
+        """The declared output feature type (instance attribute aware —
+        e.g. LambdaTransformer's per-instance output_type)."""
+        return self.output_type
+
     # -- output ------------------------------------------------------------
     def output_is_response(self) -> bool:
         """A feature derived from any response is itself a response, so it

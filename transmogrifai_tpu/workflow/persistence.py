@@ -63,18 +63,25 @@ def resolve_importable_fn(fn) -> "Optional[str]":
         return None
     if mod != "__main__":
         return f"{mod}:{qual}"
-    import importlib
+    import importlib.util
     import sys as _sys
     f = getattr(_sys.modules.get("__main__"), "__file__", None)
     stem = os.path.splitext(os.path.basename(f))[0] if f else None
     if not stem:
         return None
+    # Resolve WITHOUT importing: import_module(stem) would re-execute the
+    # running script's top-level code mid-train (and a name collision
+    # would silently bind a DIFFERENT module's f). find_spec only
+    # consults the finders; requiring the spec to point back at the
+    # running script guarantees `stem:qual` reloads THIS function.
     try:
-        target = importlib.import_module(stem)
-        for part in qual.split("."):
-            target = getattr(target, part)
-    except Exception:
-        return None    # script not importable by name -> honest drop
+        spec = importlib.util.find_spec(stem)
+    except (ImportError, ValueError, AttributeError):
+        return None   # script not importable by name -> honest drop
+    if spec is None or not spec.origin:
+        return None
+    if os.path.abspath(spec.origin) != os.path.abspath(f):
+        return None   # stem resolves to a different module -> wrong f
     return f"{stem}:{qual}"
 
 

@@ -353,13 +353,37 @@ class Workflow:
                 for s in layer if not isinstance(s, FeatureGeneratorStage)]
 
     # -- training ----------------------------------------------------------
-    def train(self) -> "WorkflowModel":
+    def train(self, validate: str = "warn") -> "WorkflowModel":
         """Fit all estimators layer-by-layer and return the fitted model
-        (reference OpWorkflow.train:332 / fitStages:368)."""
+        (reference OpWorkflow.train:332 / fitStages:368).
+
+        ``validate`` runs the pre-flight static analyzer (lint/) over
+        the feature DAG BEFORE any data is read, any stage traced or any
+        device buffer allocated — the compile-time safety pillar of the
+        reference, restored as a millisecond graph walk:
+
+        - ``"strict"``: raise :class:`~..lint.LintError` on any
+          error-severity finding (leakage path, cycle, type-contract
+          violation, duplicate uid, ...)
+        - ``"warn"`` (default): log findings and continue
+        - ``"off"``: skip the pre-flight entirely
+        """
+        if validate not in ("strict", "warn", "off"):
+            raise ValueError(
+                f"validate must be 'strict', 'warn' or 'off', "
+                f"got {validate!r}")
         if not self.result_features:
             raise ValueError("No result features set")
         if self._input_data is None:
             raise ValueError("No input data set")
+        if validate != "off":
+            from ..lint import ERROR, LintError, lint_workflow
+            findings = lint_workflow(self)
+            errors = [f for f in findings if f.severity == ERROR]
+            if validate == "strict" and errors:
+                raise LintError(errors)
+            for f in findings:
+                _log.warning("pre-flight lint: %s", f)
         result_features = self.result_features
         self.blacklisted_features = ()
         self.raw_feature_filter_results = None
