@@ -75,6 +75,43 @@ def load_titanic(path: str = None):
     return records
 
 
+def synthetic_titanic(n: int = 1000, seed: int = 42):
+    """Titanic-SHAPED records (same schema, plausible marginals) for
+    environments without the reference CSV — scoring-path benchmarks
+    and tests exercise the exact production DAG; only parity-vs-0.8225
+    assertions need the real data."""
+    rng = np.random.default_rng(seed)
+    classes = np.asarray(["1", "2", "3"])
+    sexes = np.asarray(["male", "female"])
+    ports = np.asarray(["S", "C", "Q", None], dtype=object)
+    records = []
+    for i in range(n):
+        sex = str(rng.choice(sexes))
+        p_class = str(rng.choice(classes, p=[0.24, 0.21, 0.55]))
+        age = None if rng.uniform() < 0.2 else float(
+            np.clip(rng.normal(29, 14), 0.5, 80))
+        fare = None if rng.uniform() < 0.02 else float(
+            np.round(rng.gamma(2.0, 16.0), 4))
+        logit = (1.2 * (sex == "female") - 0.5 * (p_class == "3")
+                 - 0.01 * (age or 29) + 0.004 * (fare or 32) - 0.4)
+        records.append({
+            "id": i,
+            "survived": float(rng.uniform() < 1 / (1 + np.exp(-logit))),
+            "pClass": p_class,
+            "name": f"Passenger {i} {'Mrs' if sex == 'female' else 'Mr'}",
+            "sex": sex,
+            "age": age,
+            "sibSp": int(rng.poisson(0.5)),
+            "parCh": int(rng.poisson(0.4)),
+            "ticket": f"T{rng.integers(1000, 9999)}",
+            "fare": fare,
+            "cabin": None if rng.uniform() < 0.77
+            else f"{'ABCDEF'[int(rng.integers(6))]}{rng.integers(1, 99)}",
+            "embarked": rng.choice(ports, p=[0.72, 0.19, 0.08, 0.01]),
+        })
+    return records
+
+
 #: one servable passenger record (the save+serve demo below and the
 #: parity test's round-trip share it so they cannot drift apart)
 SAMPLE_PASSENGER = {"pClass": "1", "sex": "female", "age": 29.0,

@@ -181,6 +181,47 @@ class TestCliLint:
         assert "TX-D01" in out and "TX-J05" in out
 
 
+class TestCliScore:
+    """`python -m transmogrifai_tpu.cli score` — the compiled serving
+    entry point (docs/serving.md); --bench is the self-contained smoke
+    that must emit one parseable score_rows_per_s JSON line."""
+
+    def test_score_bench_smoke(self, capsys):
+        import json
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        assert cli_main(["score", "--bench", "--rows", "300"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["metric"] == "score_rows_per_s"
+        assert out["value"] > 0
+        assert out["repeat_compiles"] == 0
+        assert out["coverage"]["lowered"]
+
+    def test_score_saved_model_end_to_end(self, tmp_path, capsys):
+        import json
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        from transmogrifai_tpu.cli.score import _tiny_pipeline
+        model, records = _tiny_pipeline(n_rows=120)
+        mdir = str(tmp_path / "model")
+        model.save(mdir)
+        csv = tmp_path / "score.csv"
+        csv.write_text("x,y,cat\n" + "\n".join(
+            f"{r['x'] if r['x'] is not None else ''},{r['y']},{r['cat']}"
+            for r in records[:25]))
+        out_path = str(tmp_path / "scores.json")
+        assert cli_main(["score", "--model", mdir, "--input", str(csv),
+                         "--output", out_path]) == 0
+        assert "engine=compiled" in capsys.readouterr().out
+        rows = json.load(open(out_path))
+        assert len(rows) == 25
+        assert all("prediction" in next(iter(r.values())) for r in rows)
+
+    def test_score_requires_model_and_input(self):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        with pytest.raises(ValueError, match="--model"):
+            cli_main(["score"])
+
+
 class TestInteractiveGen:
     """Reference `op gen` interactive Q&A (cli/.../ProblemSchema)."""
 

@@ -282,6 +282,37 @@ class TestJaxAstRules:
         """)
         assert [f.rule_id for f in findings] == ["TX-J05", "TX-J05"]
 
+    def test_j06_serving_per_call_jit(self):
+        code = textwrap.dedent("""
+            import jax
+
+            def handle_request(f, x):
+                return jax.jit(f)(x)
+        """)
+        findings = lint_source(code, "transmogrifai_tpu/serving/api.py")
+        assert [f.rule_id for f in findings] == ["TX-J06"]
+        assert findings[0].severity == "error"
+        # the SAME source outside serving/ is the milder TX-J02 warning
+        assert _rules(lint_source(code, "pkg/models/api.py")) == {"TX-J02"}
+
+    def test_j06_serving_transform_value_loop(self):
+        code = textwrap.dedent("""
+            def score_batch(stages, rows):
+                out = []
+                for r in rows:
+                    out.append(stages[0].transform_value(r))
+                return out + [s.transform_value(rows[0]) for s in stages]
+        """)
+        findings = lint_source(code, "x/serving/loop.py")
+        assert [f.rule_id for f in findings] == ["TX-J06", "TX-J06"]
+        # batched columnar code in serving/ is clean
+        assert lint_source(textwrap.dedent("""
+            def score_batch(stage, ds):
+                return stage.transform_dataset(ds)
+        """), "x/serving/ok.py") == []
+        # and transform_value loops OUTSIDE serving/ are not its business
+        assert lint_source(code, "x/local/loop.py") == []
+
     def test_e00_parse_error(self):
         findings = lint_source("def broken(:\n", "bad.py")
         assert _rules(findings) == {"TX-E00"}

@@ -1,0 +1,297 @@
+"""Compiled scoring engine tests (serving/plan.py, ISSUE 2).
+
+Parity suite: the fused, shape-bucketed XLA plan must reproduce the
+per-stage numpy path to 1e-6 across testkit random data for every
+transmogrify feature family, including batch sizes that straddle
+bucket boundaries; plus compile-counter, coverage, fallback,
+ScoreFunction.score_batch and satellite-fix regression tests.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.serving import (PlanCompileError, ScoringPlan,
+                                       bucket_for, plan_compiles)
+from transmogrifai_tpu.testkit import (RandomBinary, RandomData,
+                                       RandomIntegral, RandomList,
+                                       RandomMap, RandomReal, RandomSet,
+                                       RandomText)
+from transmogrifai_tpu.types import (Binary, Date, DateList, DateMap,
+                                     Integral, MultiPickList,
+                                     MultiPickListMap, NumericMap, PickList,
+                                     PickListMap, Real, RealNN, Text)
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _family_generators(seed0: int):
+    """One generator per transmogrify feature family the testkit can
+    produce, each with a healthy empty rate where the type allows."""
+    return {
+        "real": (Real, RandomReal.normal(0, 2, seed=seed0 + 1)
+                 .with_probability_of_empty(0.2)),
+        "integral": (Integral,
+                     RandomIntegral.integers(0, 50, seed=seed0 + 2)
+                     .with_probability_of_empty(0.15)),
+        "flag": (Binary, RandomBinary(0.4, seed=seed0 + 3)
+                 .with_probability_of_empty(0.1)),
+        "when": (Date, RandomIntegral.dates(seed=seed0 + 4)
+                 .with_probability_of_empty(0.2)),
+        "pick": (PickList, RandomText.picklists(
+            ["a", "b", "c", "d"], seed=seed0 + 5)
+            .with_probability_of_empty(0.15)),
+        "tags": (MultiPickList, RandomSet(
+            ["x", "y", "z", "w"], seed=seed0 + 6)
+            .with_probability_of_empty(0.2)),
+        "blurb": (Text, RandomText.strings(seed=seed0 + 7)
+                  .with_probability_of_empty(0.1)),
+        "nums": (NumericMap, RandomMap(
+            RandomReal.uniform(0, 5, seed=seed0 + 8), NumericMap,
+            min_size=1, max_size=3, seed=seed0 + 9)
+            .with_probability_of_empty(0.2)),
+        # PickListMap pivots per key (TextMapPivotVectorizer); a free
+        # TextMap would dispatch to the smart hash/pivot fallback
+        "words": (PickListMap, RandomMap(
+            RandomText.picklists(["p", "q", "r"], seed=seed0 + 10),
+            PickListMap, min_size=1, max_size=3, seed=seed0 + 11)
+            .with_probability_of_empty(0.2)),
+        "sets": (MultiPickListMap, RandomMap(
+            RandomSet(["m", "n", "o"], seed=seed0 + 12),
+            MultiPickListMap, min_size=1, max_size=2, seed=seed0 + 13)
+            .with_probability_of_empty(0.2)),
+        "whens": (DateMap, RandomMap(
+            RandomIntegral.dates(seed=seed0 + 14), DateMap,
+            min_size=1, max_size=2, seed=seed0 + 15)
+            .with_probability_of_empty(0.2)),
+        "dates": (DateList, RandomList(
+            RandomIntegral.dates(seed=seed0 + 16), min_size=1,
+            max_size=3, ftype=DateList, seed=seed0 + 17)
+            .with_probability_of_empty(0.3)),
+    }
+
+
+def _records(n: int, seed0: int):
+    gens = _family_generators(seed0)
+    data = RandomData(seed=seed0)
+    for name, (_, gen) in gens.items():
+        data.with_column(name, gen)
+    records = data.records(n)
+    rng = np.random.default_rng(seed0)
+    for r in records:
+        r["label"] = float((r["real"] or 0)
+                           + (1.0 if r["pick"] == "a" else 0.0)
+                           + 0.5 * rng.normal() > 0.5)
+    return records
+
+
+@pytest.fixture(scope="module")
+def family_model():
+    records = _records(400, seed0=100)
+    feats = []
+    for name, (ftype, _) in _family_generators(100).items():
+        feats.append(FeatureBuilder.of(name, ftype).extract(
+            lambda r, k=name: r.get(k)).as_predictor())
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    vec = transmogrify(feats)
+    checked = vec.sanity_check(label, min_variance=-0.1)
+    pred = LogisticRegression(reg_param=0.05, max_iter=50).set_input(
+        label, checked).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(records).train(validate="off"))
+    return model, pred
+
+
+class TestBuckets:
+    def test_bucket_for_powers_of_two(self):
+        assert bucket_for(1) == 8
+        assert bucket_for(8) == 8
+        assert bucket_for(9) == 16
+        assert bucket_for(1000) == 1024
+        assert bucket_for(10 ** 9) == 8192       # clamped to max bucket
+        assert bucket_for(5, min_bucket=2, max_bucket=4) == 4
+
+    def test_plan_buckets_listing(self, family_model):
+        model, _ = family_model
+        plan = ScoringPlan(model, min_bucket=4, max_bucket=32)
+        assert plan.buckets() == [4, 8, 16, 32]
+
+
+class TestFamilyParity:
+    """Compiled plan == per-stage numpy path to 1e-6, every family."""
+
+    @pytest.mark.parametrize("n", [1, 7, 8, 9, 1000])
+    def test_batch_sizes_straddling_buckets(self, family_model, n):
+        model, pred = family_model
+        batch = _records(n, seed0=999)
+        truth = model.score(batch)
+        comp = model.score(batch, engine="compiled")
+        t, c = truth[pred.name], comp[pred.name]
+        np.testing.assert_allclose(c.data, t.data, atol=1e-6)
+        np.testing.assert_allclose(c.probability, t.probability,
+                                   atol=1e-6)
+        np.testing.assert_allclose(c.raw_prediction, t.raw_prediction,
+                                   atol=1e-6)
+
+    def test_chunked_beyond_max_bucket(self, family_model):
+        model, pred = family_model
+        batch = _records(700, seed0=555)
+        truth = model.score(batch)
+        plan = ScoringPlan(model, max_bucket=256).compile()
+        comp = plan.score(batch)
+        np.testing.assert_allclose(comp[pred.name].probability,
+                                   truth[pred.name].probability,
+                                   atol=1e-6)
+
+    def test_coverage_reports_fallbacks_with_reasons(self, family_model):
+        model, _ = family_model
+        plan = model.scoring_plan()
+        cov = plan.coverage
+        # the families with array kernels all lowered
+        lowered = " ".join(cov.lowered)
+        for cls in ("RealVectorizerModel", "OneHotVectorizerModel",
+                    "MultiPickListVectorizerModel",
+                    "DateToUnitCircleVectorizer", "RealMapVectorizerModel",
+                    "TextMapPivotVectorizerModel",
+                    "DateMapToUnitCircleVectorizerModel",
+                    "VectorsCombiner", "SanityCheckerModel",
+                    "LogisticRegressionModel"):
+            assert cls in lowered, cls
+        # free text and date lists stay on the numpy fallback, reported
+        fallback = " ".join(n for n, _ in cov.fallback)
+        assert "SmartTextVectorizerModel" in fallback
+        assert "DateListVectorizer" in fallback
+        assert all(reason for _, reason in cov.fallback)
+        assert 0 < cov.lowered_fraction < 1
+
+    def test_same_bucket_zero_new_compiles(self, family_model):
+        model, _ = family_model
+        model.score(_records(6, seed0=321), engine="compiled")  # warm
+        before = plan_compiles()
+        for seed in (11, 12, 13):
+            model.score(_records(5, seed0=seed), engine="compiled")
+        assert plan_compiles() == before   # bucket 8 already compiled
+
+    def test_sizes_one_through_bucket_share_one_program(self, family_model):
+        model, _ = family_model
+        model.score(_records(3, seed0=42), engine="compiled")   # warm 8
+        before = plan_compiles()
+        for n in (1, 2, 5, 8):
+            model.score(_records(n, seed0=40 + n), engine="compiled")
+        assert plan_compiles() == before
+
+    def test_engine_validation(self, family_model):
+        model, _ = family_model
+        with pytest.raises(ValueError, match="engine"):
+            model.score(_records(2, seed0=1), engine="warp")
+        with pytest.raises(ValueError, match="keep_intermediate"):
+            model.score(_records(2, seed0=1), engine="compiled",
+                        keep_intermediate=True)
+
+
+class TestScoreFunctionBatch:
+    def test_score_batch_matches_record_loop(self, family_model):
+        from transmogrifai_tpu.local import ScoreFunction
+        model, pred = family_model
+        fn = ScoreFunction(model)
+        batch = _records(9, seed0=777)
+        compiled = fn.score_batch(batch)
+        loop = fn.score_batch(batch, engine="records")
+        assert len(compiled) == len(loop) == 9
+        for a, b in zip(compiled, loop):
+            assert set(a) == set(b) == {pred.name}
+            for k, v in b[pred.name].items():
+                assert abs(a[pred.name][k] - v) < 1e-6, k
+
+    def test_score_batch_engine_validation(self, family_model):
+        from transmogrifai_tpu.local import ScoreFunction
+        model, _ = family_model
+        with pytest.raises(ValueError, match="engine"):
+            ScoreFunction(model).score_batch([], engine="turbo")
+
+    def test_score_batch_falls_back_when_plan_unavailable(self,
+                                                          family_model):
+        from transmogrifai_tpu.local import ScoreFunction
+        model, pred = family_model
+        fn = ScoreFunction(model)
+        fn._compiled_plan_error = RuntimeError("forced")  # plan "failed"
+        out = fn.score_batch(_records(3, seed0=31))
+        assert len(out) == 3 and pred.name in out[0]
+
+
+class TestPlanInternals:
+    def test_plan_compile_idempotent_and_describe(self, family_model):
+        model, _ = family_model
+        plan = model.scoring_plan()
+        assert plan.compile() is plan
+        desc = plan.describe()
+        assert desc["device_stages"] == len(plan.coverage.lowered)
+        assert desc["fallback_stages"] == len(plan.coverage.fallback)
+        assert desc["buckets"][0] == plan.min_bucket
+
+    def test_bad_bucket_range_rejected(self, family_model):
+        model, _ = family_model
+        with pytest.raises(ValueError, match="bucket"):
+            ScoringPlan(model, min_bucket=16, max_bucket=8)
+
+    def test_unfitted_estimator_rejected(self):
+        label = FeatureBuilder.of("label", RealNN).extract(
+            lambda r: r.get("label")).as_response()
+        x = FeatureBuilder.of("x", Real).extract(
+            lambda r: r.get("x")).as_predictor()
+        pred = LogisticRegression().set_input(
+            label, transmogrify([x])).get_output()
+
+        class _Fake:
+            result_features = (pred,)
+
+            def raw_features(self):
+                return pred.raw_features()
+
+        with pytest.raises(PlanCompileError, match="estimator"):
+            ScoringPlan(_Fake()).compile()
+
+
+class TestSatelliteFixes:
+    def test_unbox_mixed_type_set_sorts_by_repr(self):
+        from transmogrifai_tpu.local.scoring import _unbox
+        from transmogrifai_tpu.types import MultiPickList, OPSet
+
+        class _RawSet(OPSet):  # keeps mixed-type members unconverted
+            __slots__ = ()
+
+            @classmethod
+            def _convert(cls, v):
+                return frozenset(v)
+
+        out = _unbox(_RawSet({1, "a"}))        # sorted({1,"a"}) raises
+        assert out == sorted([1, "a"], key=repr)
+        assert _unbox(MultiPickList({"b", "a"})) == ["a", "b"]
+
+    def test_extract_errors_counted_not_silent(self):
+        from transmogrifai_tpu.local import ScoreFunction
+        records = [{"x": float(i), "label": float(i % 2)}
+                   for i in range(60)]
+
+        def exploding(r):
+            if r["x"] > 50:
+                raise KeyError("boom")
+            return r["x"]
+
+        label = FeatureBuilder.of("label", RealNN).extract(
+            lambda r: r.get("label")).as_response()
+        x = FeatureBuilder.of("x", Real).extract(exploding).as_predictor()
+        pred = LogisticRegression().set_input(
+            label, transmogrify([x])).get_output()
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(records[:50]).train(validate="off"))
+        fn = ScoreFunction(model)
+        assert fn.extract_errors == 0
+        out = [fn(r) for r in records]
+        assert len(out) == 60
+        assert fn.extract_errors == 9          # x in 51..59 raised
+        assert fn.extract_error_fields == {"x": 9}
+        # batch path counts through the same counter
+        fn.score_batch(records[55:], engine="records")
+        assert fn.extract_error_fields["x"] == 14

@@ -276,3 +276,10 @@ class SanityCheckerModel(AllowLabelAsInput, BinaryModel):
         arr = np.asarray(vec.value if hasattr(vec, "value") else vec,
                          dtype=np.float64).reshape(1, -1)
         return OPVector(arr[0, self.kept_indices])
+
+    def transform_arrays(self, arrays):
+        # column slice by kept indices; the (ignored) label lane rides
+        # along so serve-time NaN labels never touch the output
+        import jax.numpy as jnp
+        return jnp.take(arrays[-1], jnp.asarray(self.kept_indices,
+                                                dtype=jnp.int32), axis=1)

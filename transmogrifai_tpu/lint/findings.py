@@ -43,6 +43,8 @@ RULES: Dict[str, tuple] = {
     "TX-J04": (WARNING, "float64 creep inside a jitted function"),
     "TX-J05": (ERROR, "Python control flow on a traced value inside a "
                       "jitted function (concrete-shape dependence)"),
+    "TX-J06": (ERROR, "serving hot path: per-call jax.jit or a Python "
+                      "per-row transform_value loop inside serving code"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

@@ -21,6 +21,11 @@ call) are caught here in milliseconds:
 - TX-J05 Python control flow on a traced value: ``if``/``while`` on a
   non-static parameter concretizes the tracer -> TracerBoolConversionError
   at trace time, i.e. concrete-shape dependence.
+- TX-J06 serving hot path (``serving/`` files only): per-call
+  ``jax.jit`` — a trace/compile per REQUEST — or a Python per-row loop
+  over ``transform_value``, the exact pattern the compiled ScoringPlan
+  exists to replace. The J02 per-call-jit patterns report as J06 (error
+  severity) there.
 
 Scope discipline keeps the rules precise: J01/J04/J05 only fire INSIDE
 functions statically known to be jitted (decorated with ``jax.jit`` or
@@ -199,9 +204,26 @@ def _mentions_traced(node: ast.AST, traced: Set[str]) -> bool:
 # the per-file visitor
 # ---------------------------------------------------------------------------
 
+def _is_serving_path(path: str) -> bool:
+    """serving/ package files get the TX-J06 hot-path rules."""
+    import re
+    return "serving" in re.split(r"[/\\]", path)
+
+
+def _calls_transform_value(node: ast.AST) -> bool:
+    """Does the subtree call ``<x>.transform_value(...)``?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr == "transform_value":
+            return True
+    return False
+
+
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, al: _Aliases):
         self.path = path
+        self.serving = _is_serving_path(path)
         self.al = al
         self.findings: List[LintFinding] = []
         #: stack of enclosing FunctionDefs, innermost last
@@ -269,9 +291,30 @@ class _Visitor(ast.NodeVisitor):
 
     # -- loops -------------------------------------------------------------
     def visit_For(self, node: ast.For) -> None:
+        self._check_serving_row_loop(node)
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
+
+    def _check_serving_row_loop(self, node) -> None:
+        # TX-J06: per-row transform_value loops have no place in
+        # serving code — that is exactly the Python hot loop the
+        # compiled ScoringPlan replaces (batch through
+        # transform_columns / transform_arrays instead)
+        if self.serving and _calls_transform_value(node):
+            self.add(
+                "TX-J06", node,
+                "Python loop over transform_value in serving code — "
+                "per-row scoring instead of one batched/compiled "
+                "program",
+                ERROR,
+                hint="route the batch through ScoringPlan (or at least "
+                     "transform_columns); transform_value is the "
+                     "single-record edge only")
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self._check_serving_row_loop(node)
+        self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:
         self._check_control_flow(node)
@@ -301,11 +344,12 @@ class _Visitor(ast.NodeVisitor):
     # -- calls -------------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:
         al = self.al
-        # TX-J02: jax.jit(...) applied at call time -----------------------
+        # TX-J02 (TX-J06 inside serving/): jax.jit applied at call time ----
         if al.is_jax_jit(node.func):
+            per_call_rule = "TX-J06" if self.serving else "TX-J02"
             if self.loop_depth > 0:
                 self.add(
-                    "TX-J02", node,
+                    per_call_rule, node,
                     "jax.jit(...) called inside a loop — a fresh jitted "
                     "callable (and a full XLA recompile) per iteration",
                     ERROR,
@@ -313,12 +357,15 @@ class _Visitor(ast.NodeVisitor):
                          "should call ONE jitted function")
             elif self.fn_stack and not self._in_memoized_builder():
                 self.add(
-                    "TX-J02", node,
+                    per_call_rule, node,
                     f"jax.jit(...) called per invocation of "
                     f"{self.fn_stack[-1].name!r} — the returned callable "
                     f"is rebuilt (and recompiled) every call",
-                    WARNING,
-                    hint="decorate the enclosing builder with "
+                    ERROR if self.serving else WARNING,
+                    hint="compile once per plan/model and cache the "
+                         "jitted callable (serving must never pay a "
+                         "per-request trace)" if self.serving else
+                         "decorate the enclosing builder with "
                          "functools.lru_cache (the memoized-builder "
                          "idiom) or jit once at module level")
             # register module-level `name = jax.jit(fn, static_...)`

@@ -5,12 +5,19 @@ TPU-native port of the reference local module
 {OpWorkflowModelLocal.scala:52,88-120, OpWorkflowRunnerLocal.scala:41}):
 a saved workflow model becomes a pure-Python scoring closure that folds
 one record's values through every stage's row-level ``transform_value``
-path in DAG order — no Spark/MLeap (reference) and no batch engine
-here; models already predict from plain arrays so nothing needs
-conversion.
+path in DAG order — no Spark/MLeap (reference) and no batch engine for
+single records; models already predict from plain arrays so nothing
+needs conversion.
+
+Batch scoring (``score_batch``) routes through the compiled
+:class:`~transmogrifai_tpu.serving.ScoringPlan` — the fitted DAG fused
+into shape-bucketed XLA programs (docs/serving.md) — instead of looping
+the per-record path, and falls back to that loop only if the plan
+cannot compile.
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -18,6 +25,8 @@ import numpy as np
 from ..features.feature import Feature, topo_layers
 from ..features.generator import FeatureGeneratorStage
 from ..types import FeatureType, Prediction
+
+_log = logging.getLogger(__name__)
 
 __all__ = ["ScoreFunction", "load_score_function", "score_function_for"]
 
@@ -30,7 +39,12 @@ def _unbox(value: Any) -> Any:
         if isinstance(v, np.ndarray):
             return v.tolist()
         if isinstance(v, (set, frozenset)):
-            return sorted(v)
+            try:
+                return sorted(v)
+            except TypeError:
+                # mixed-type members (e.g. {1, "a"}) are unorderable in
+                # py3 — fall back to a deterministic repr ordering
+                return sorted(v, key=repr)
         return v
     return value
 
@@ -48,8 +62,20 @@ class ScoreFunction:
         self._plan = [s for layer in topo_layers(self.result_features)
                       for s in layer
                       if not isinstance(s, FeatureGeneratorStage)]
+        #: extraction failures observed so far (an extract fn raising on
+        #: a record nulls that field instead of failing the request —
+        #: but silently-nulled fields destroy scores invisibly, so the
+        #: count and the per-feature breakdown are exposed here)
+        self.extract_errors = 0
+        self.extract_error_fields: Dict[str, int] = {}
+        self._compiled_plan = None
+        self._compiled_plan_error = None
 
-    def __call__(self, record: Dict[str, Any]) -> Dict[str, Any]:
+    def _extract_raw(self, record: Dict[str, Any]
+                     ) -> Dict[str, FeatureType]:
+        """One record -> boxed raw feature values, with the serving
+        edge's error policy: a raising extract fn nulls the field (and
+        is counted), a missing response gets an ignored placeholder."""
         values: Dict[str, FeatureType] = {}
         for f in self.raw_features:
             gen = f.origin_stage
@@ -58,6 +84,7 @@ class ScoreFunction:
                     raw = gen.extract_fn(record)
                 except Exception:
                     raw = None
+                    self._note_extract_error(f.name)
             else:
                 raw = record.get(f.name)
             if raw is None and f.is_response:
@@ -70,6 +97,20 @@ class ScoreFunction:
                 continue
             values[f.name] = raw if isinstance(raw, FeatureType) \
                 else f.ftype.from_any(raw)
+        return values
+
+    def _note_extract_error(self, feature_name: str) -> None:
+        self.extract_errors += 1
+        count = self.extract_error_fields.get(feature_name, 0) + 1
+        self.extract_error_fields[feature_name] = count
+        if count == 1:  # one warning per feature, not per record
+            _log.warning(
+                "extract fn for raw feature %r raised; the field is "
+                "scored as missing (see ScoreFunction.extract_errors)",
+                feature_name)
+
+    def __call__(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        values = self._extract_raw(record)
         for stage in self._plan:
             ins = [values[f.name] for f in stage.input_features]
             out = stage.get_output()
@@ -77,9 +118,51 @@ class ScoreFunction:
         return {f.name: _unbox(values[f.name])
                 for f in self.result_features}
 
-    def score_batch(self, records: Sequence[Dict[str, Any]]
-                    ) -> List[Dict[str, Any]]:
-        return [self(r) for r in records]
+    # -- batch path --------------------------------------------------------
+    def _scoring_plan(self):
+        """The compiled ScoringPlan for this model (built once; a plan
+        that cannot compile is remembered so every batch does not
+        re-attempt and re-log)."""
+        if self._compiled_plan is None and self._compiled_plan_error is None:
+            from ..serving import ScoringPlan
+            try:
+                builder = getattr(self.model, "scoring_plan", None)
+                # share the model's cached plan when it has one
+                self._compiled_plan = builder() if callable(builder) \
+                    else ScoringPlan(self.model).compile()
+            except Exception as e:
+                self._compiled_plan_error = e
+                _log.warning(
+                    "compiled scoring plan unavailable (%r); score_batch "
+                    "falls back to the per-record loop", e)
+        return self._compiled_plan
+
+    def score_batch(self, records: Sequence[Dict[str, Any]],
+                    engine: str = "compiled") -> List[Dict[str, Any]]:
+        """Score many records in one shot. ``engine="compiled"``
+        (default) runs the whole batch through the fused XLA plan —
+        one host->device->host round-trip per shape bucket;
+        ``engine="records"`` keeps the legacy per-record loop."""
+        if engine not in ("compiled", "records"):
+            raise ValueError(
+                f"engine must be 'compiled' or 'records', got {engine!r}")
+        records = list(records)
+        if engine == "records" or not records:
+            return [self(r) for r in records]
+        plan = self._scoring_plan()
+        if plan is None:
+            return [self(r) for r in records]
+        from ..features.columns import Dataset, FeatureColumn
+        boxed = [self._extract_raw(r) for r in records]
+        ds = Dataset({
+            f.name: FeatureColumn.from_values(
+                f.ftype, [b[f.name] for b in boxed])
+            for f in self.raw_features})
+        scored = plan.score_raw_dataset(ds)
+        cols = [scored[f.name] for f in self.result_features]
+        return [{f.name: _unbox(col.boxed(i))
+                 for f, col in zip(self.result_features, cols)}
+                for i in range(len(records))]
 
 
 def score_function_for(model) -> ScoreFunction:

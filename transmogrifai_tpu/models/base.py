@@ -135,6 +135,23 @@ class PredictionModel(BinaryModel):
                          dtype=np.float64).reshape(1, -1)
         return self.predict_arrays(arr).boxed(0)
 
+    # -- compiled-serving lowering (serving/plan.py) -----------------------
+    def raw_arrays(self, X):
+        """jnp kernel producing this model's RAW output (margins for
+        classifiers, values for regressors) from the feature matrix —
+        the array-level predict lowering. The plan funnels the result
+        through ``prediction_from_raw`` host-side, so wrapper semantics
+        (probabilities, argmax/threshold) stay the model's own. Models
+        without a kernel keep this default and fall back to numpy."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no array predict kernel")
+
+    def supports_arrays(self) -> bool:
+        return (type(self).raw_arrays is not PredictionModel.raw_arrays)
+
+    def transform_arrays(self, arrays):
+        return self.raw_arrays(arrays[-1])
+
 
 class ClassifierModel(PredictionModel):
     """Probabilistic classifier: produces prediction + rawPrediction +

@@ -296,3 +296,13 @@ class NaiveBayesModel(ClassifierModel):
             return (self.pi + Xb @ self.theta.T
                     + (1.0 - Xb) @ neg.T)
         return self.pi + X @ self.theta.T
+
+    def raw_arrays(self, X):
+        import jax.numpy as jnp
+        pi = jnp.asarray(self.pi, X.dtype)
+        theta = jnp.asarray(self.theta, X.dtype)
+        if self.model_type == "bernoulli":
+            Xb = (X != 0).astype(X.dtype)
+            neg = jnp.log1p(-jnp.minimum(jnp.exp(theta), 1 - 1e-12))
+            return pi + Xb @ theta.T + (1.0 - Xb) @ neg.T
+        return pi + X @ theta.T

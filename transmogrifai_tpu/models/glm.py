@@ -380,3 +380,17 @@ class GeneralizedLinearRegressionModel(RegressionModel):
         if self.link == "sqrt":
             return eta * eta
         raise ValueError(f"Unknown link {self.link!r}")
+
+    def raw_arrays(self, X):
+        eta = X @ jnp.asarray(self.coefficients, X.dtype) + self.intercept
+        if self.link == "identity":
+            return eta
+        if self.link == "log":
+            return jnp.exp(eta)
+        if self.link == "logit":
+            return 1.0 / (1.0 + jnp.exp(-eta))
+        if self.link == "inverse":
+            return 1.0 / jnp.where(jnp.abs(eta) > _EPS, eta, _EPS)
+        if self.link == "sqrt":
+            return eta * eta
+        raise ValueError(f"Unknown link {self.link!r}")

@@ -117,3 +117,17 @@ class IsotonicRegressionCalibratorModel(RegressionModel):
         if scores.ndim == 2:
             scores = scores[:, self.feature_index]
         return self.calibrate(scores)
+
+    def raw_arrays(self, X):
+        import jax.numpy as jnp
+        scores = X[:, self.feature_index] if X.ndim == 2 else X
+        b = jnp.asarray(self.boundaries, scores.dtype)
+        p = jnp.asarray(self.predictions, scores.dtype)
+        if self.boundaries.size == 0:
+            return jnp.zeros_like(scores)
+        if self.boundaries.size == 1:
+            return jnp.full_like(scores, self.predictions[0])
+        out = jnp.interp(scores, b, p)
+        lo = min(self.predictions[0], self.predictions[-1])
+        hi = max(self.predictions[0], self.predictions[-1])
+        return jnp.clip(out, lo, hi)

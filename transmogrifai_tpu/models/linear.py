@@ -59,7 +59,14 @@ def _weighted_standardize(X, w, axis_name=None):
     var = _psum(jnp.sum(w[:, None] * (X - mu) ** 2, axis=0),
                 axis_name) / wsum
     sigma = jnp.sqrt(var)
-    safe = jnp.where(sigma > 0, sigma, 1.0)
+    # constant columns must be treated as such: float reduction noise
+    # makes their variance ~1e-32 rather than exactly 0, and dividing
+    # by sigma~1e-16 back-transforms into a gigantic coefficient whose
+    # cancellation against the intercept quantizes every margin (seen
+    # as 1/256-grid logits on one-hot OTHER columns). A RELATIVE floor
+    # catches them; genuinely informative columns sit far above it.
+    floor = 1e-9 * jnp.maximum(jnp.abs(mu), 1.0)
+    safe = jnp.where(sigma > floor, sigma, 1.0)
     return (X - mu) / safe, mu, safe, wsum
 
 
@@ -345,6 +352,13 @@ class LogisticRegressionModel(ClassifierModel):
             return np.stack([-m, m], axis=1)
         return X @ self.coefficients.T + self.intercept
 
+    def raw_arrays(self, X):
+        c = jnp.asarray(self.coefficients, X.dtype)
+        if self.coefficients.ndim == 1:
+            m = X @ c + float(self.intercept)
+            return jnp.stack([-m, m], axis=1)
+        return X @ c.T + jnp.asarray(self.intercept, X.dtype)
+
 
 # ---------------------------------------------------------------------------
 # linear regression
@@ -425,6 +439,9 @@ class LinearRegressionModel(RegressionModel):
     def predict_values(self, X: np.ndarray) -> np.ndarray:
         return X @ self.coefficients + self.intercept
 
+    def raw_arrays(self, X):
+        return X @ jnp.asarray(self.coefficients, X.dtype) + self.intercept
+
 
 # ---------------------------------------------------------------------------
 # linear SVC
@@ -499,6 +516,10 @@ class LinearSVCModel(ClassifierModel):
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         m = X @ self.coefficients + self.intercept
         return np.stack([-m, m], axis=1)
+
+    def raw_arrays(self, X):
+        m = X @ jnp.asarray(self.coefficients, X.dtype) + self.intercept
+        return jnp.stack([-m, m], axis=1)
 
     def prediction_from_raw(self, raw: np.ndarray) -> PredictionColumn:
         raw = np.asarray(raw, dtype=np.float64)

@@ -356,3 +356,12 @@ class MultilayerPerceptronClassifierModel(ClassifierModel):
         for W, b in zip(self.weights[:-1], self.biases[:-1]):
             h = 1.0 / (1.0 + np.exp(-(h @ W + b)))
         return h @ self.weights[-1] + self.biases[-1]
+
+    def raw_arrays(self, X):
+        import jax.numpy as jnp
+        h = X
+        for W, b in zip(self.weights[:-1], self.biases[:-1]):
+            h = 1.0 / (1.0 + jnp.exp(-(h @ jnp.asarray(W, X.dtype)
+                                       + jnp.asarray(b, X.dtype))))
+        return h @ jnp.asarray(self.weights[-1], X.dtype) \
+            + jnp.asarray(self.biases[-1], X.dtype)

@@ -1737,6 +1737,14 @@ class TreeEnsembleClassifierModel(ClassifierModel):
         probs = self.leaves[np.arange(len(self.feats))[:, None], leaf_idx]
         return np.mean(probs, axis=0)                          # (n, K)
 
+    def raw_arrays(self, X):
+        leaf_idx = _predict_leaves(X, jnp.asarray(self.feats),
+                                   jnp.asarray(self.thrs, X.dtype),
+                                   self.depth)
+        probs = jnp.asarray(self.leaves, X.dtype)[
+            jnp.arange(len(self.feats))[:, None], leaf_idx]
+        return jnp.mean(probs, axis=0)
+
     def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         s = np.sum(raw, axis=1, keepdims=True)
         return raw / np.where(s > 0, s, 1.0)
@@ -1762,6 +1770,14 @@ class TreeEnsembleRegressorModel(RegressionModel):
             jnp.asarray(self.thrs), self.depth))
         vals = self.leaves[np.arange(len(self.feats))[:, None], leaf_idx]
         return np.mean(vals, axis=0)
+
+    def raw_arrays(self, X):
+        leaf_idx = _predict_leaves(X, jnp.asarray(self.feats),
+                                   jnp.asarray(self.thrs, X.dtype),
+                                   self.depth)
+        vals = jnp.asarray(self.leaves, X.dtype)[
+            jnp.arange(len(self.feats))[:, None], leaf_idx]
+        return jnp.mean(vals, axis=0)
 
     @property
     def feature_importances(self) -> np.ndarray:
@@ -1796,6 +1812,15 @@ class GBTClassifierModel(ClassifierModel):
 
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
         return self.raw_from_margin(self.margins(X))
+
+    def raw_arrays(self, X):
+        leaf_idx = _predict_leaves(X, jnp.asarray(self.feats),
+                                   jnp.asarray(self.thrs, X.dtype),
+                                   self.depth)
+        vals = jnp.asarray(self.leaves, X.dtype)[
+            jnp.arange(len(self.feats))[:, None], leaf_idx]
+        m = self.base + jnp.sum(vals, axis=0)
+        return jnp.stack([-m, m], axis=1)
 
     def raw_to_probability(self, raw: np.ndarray) -> np.ndarray:
         p = 1.0 / (1.0 + np.exp(-raw[:, 1]))
@@ -1835,6 +1860,17 @@ class GBTMulticlassClassifierModel(ClassifierModel):
         margins = vals.reshape(rounds, k, -1).sum(axis=0).T  # (n, K)
         return self.base + margins
 
+    def raw_arrays(self, X):
+        rounds, k, heap = self.feats.shape
+        leaf_idx = _predict_leaves(
+            X, jnp.asarray(self.feats.reshape(rounds * k, heap)),
+            jnp.asarray(self.thrs.reshape(rounds * k, heap), X.dtype),
+            self.depth)                                      # (R*K, n)
+        flat_l = jnp.asarray(self.leaves.reshape(rounds * k, -1), X.dtype)
+        vals = flat_l[jnp.arange(rounds * k)[:, None], leaf_idx]
+        margins = vals.reshape(rounds, k, -1).sum(axis=0).T  # (n, K)
+        return jnp.asarray(self.base, X.dtype) + margins
+
     @property
     def feature_importances(self) -> np.ndarray:
         rounds, k, heap = self.feats.shape
@@ -1860,6 +1896,14 @@ class GBTRegressorModel(RegressionModel):
             jnp.asarray(self.thrs), self.depth))
         vals = self.leaves[np.arange(len(self.feats))[:, None], leaf_idx]
         return self.base + np.sum(vals, axis=0)
+
+    def raw_arrays(self, X):
+        leaf_idx = _predict_leaves(X, jnp.asarray(self.feats),
+                                   jnp.asarray(self.thrs, X.dtype),
+                                   self.depth)
+        vals = jnp.asarray(self.leaves, X.dtype)[
+            jnp.arange(len(self.feats))[:, None], leaf_idx]
+        return self.base + jnp.sum(vals, axis=0)
 
     @property
     def feature_importances(self) -> np.ndarray:

@@ -95,6 +95,32 @@ class OneHotVectorizerModel(SequenceModel):
             metas.extend(_pivot_metas(f, cats, self.track_nulls))
         return vector_output(self.get_output().name, blocks, metas)
 
+    # -- compiled-serving lowering (serving/plan.py): the trained
+    # category->index lookup runs on host, the one-hot expansion on
+    # device. Index layout: [0..K-1] categories, K = OTHER, K+1 = NULL
+    # (or -1 = all-zero row when nulls are untracked).
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        cats = self.categories[i]
+        index = {c: j for j, c in enumerate(cats)}
+        other = len(cats)
+        null = other + 1 if self.track_nulls else -1
+        out = np.empty(col.n_rows, dtype=np.int32)
+        for r, v in enumerate(col.data):
+            out[r] = null if v is None else index.get(v, other)
+        return out
+
+    def transform_arrays(self, arrays):
+        import jax
+        import jax.numpy as jnp
+        blocks = []
+        for idx, cats in zip(arrays, self.categories):
+            width = len(cats) + 1 + (1 if self.track_nulls else 0)
+            blocks.append(jax.nn.one_hot(idx, width))
+        return jnp.concatenate(blocks, axis=1)
+
 
 class OneHotVectorizer(SequenceEstimator):
     """Top-K one-hot pivot for categorical text features
@@ -140,6 +166,21 @@ class MultiPickListVectorizerModel(SequenceModel):
             blocks.append(_pivot_block(rows, cats, self.track_nulls))
             metas.extend(_pivot_metas(f, cats, self.track_nulls))
         return vector_output(self.get_output().name, blocks, metas)
+
+    # -- compiled-serving lowering: set membership is inherently a host
+    # dict walk, so the encoder emits the multi-hot block directly
+    # (EXACTLY _pivot_block, so parity is structural) and the kernel is
+    # the concat that fuses it into the downstream program.
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        rows = [None if v is None else tuple(v) for v in col.data]
+        return _pivot_block(rows, self.categories[i], self.track_nulls)
+
+    def transform_arrays(self, arrays):
+        import jax.numpy as jnp
+        return jnp.concatenate(arrays, axis=1)
 
 
 class MultiPickListVectorizer(SequenceEstimator):

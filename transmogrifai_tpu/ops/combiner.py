@@ -47,3 +47,9 @@ class VectorsCombiner(SequenceTransformer):
                else np.zeros((0, 0), dtype=np.float64))
         return FeatureColumn.vector(
             mat, VectorMetadata.flatten(out_name, metas))
+
+    def transform_arrays(self, arrays):
+        # the fusion seam of the compiled plan: every vectorizer kernel
+        # feeds this one concat, handing XLA the whole feature matrix
+        import jax.numpy as jnp
+        return jnp.concatenate(arrays, axis=1)

@@ -58,6 +58,23 @@ def _month_phase(ms: np.ndarray) -> np.ndarray:
     return (m - 1) / 12.0
 
 
+def _unit_circle_kernel(arrays):
+    """(sin, cos) projection of host-encoded [0, 1) phases, NaN =
+    missing -> (0, 0); one (n,) or (n, k) phase array per input, 2 (or
+    2k, interleaved sin/cos per key) output columns each."""
+    import jax.numpy as jnp
+    blocks = []
+    for p in arrays:
+        ok = ~jnp.isnan(p)
+        ang = 2.0 * jnp.pi * jnp.where(ok, p, 0.0)
+        zero = jnp.zeros_like(ang)
+        sin = jnp.where(ok, jnp.sin(ang), zero)
+        cos = jnp.where(ok, jnp.cos(ang), zero)
+        block = jnp.stack([sin, cos], axis=-1)
+        blocks.append(block.reshape(block.shape[0], -1))
+    return jnp.concatenate(blocks, axis=1)
+
+
 class DateListPivot:
     """(reference DateListPivot enum in DateListVectorizer.scala)"""
     SINCE_FIRST = "SinceFirst"
@@ -204,3 +221,22 @@ class DateToUnitCircleVectorizer(SequenceTransformer):
                     parent_feature_type=f.ftype.__name__,
                     descriptor_value=f"{trig}({self.time_period})"))
         return vector_output(self.get_output().name, blocks, metas)
+
+    # -- compiled-serving lowering: the calendar arithmetic needs int64
+    # epoch math (f32 on device would lose ~1e5 ms of precision on
+    # current timestamps), so the encoder computes the [0, 1) phase on
+    # host in the SAME numpy code as transform_columns; the device
+    # kernel is the trig projection, which fuses.
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        phase_fn = TIME_PERIODS[self.time_period]
+        vals = np.asarray(col.data, dtype=np.float64)
+        ok = ~np.isnan(vals)
+        ms = np.where(ok, vals, 0.0).astype(np.int64)
+        phase = np.asarray(phase_fn(ms), dtype=np.float64)
+        return np.where(ok, phase, np.nan)
+
+    def transform_arrays(self, arrays):
+        return _unit_circle_kernel(arrays)

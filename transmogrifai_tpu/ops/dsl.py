@@ -32,6 +32,16 @@ _OPS = {
 }
 
 
+def _arith_kernel(op: str, a, b):
+    """jnp analogue of the numpy arithmetic incl. the inf -> NaN
+    missing-propagation rule (serving/plan.py lowering)."""
+    import jax.numpy as jnp
+    fns = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    out = fns[op](a, b)
+    return jnp.where(jnp.isinf(out), jnp.nan, out)
+
+
 class NumericBinaryTransformer(BinaryTransformer):
     """Elementwise arithmetic of two numeric features; missing (NaN) in
     either operand propagates (reference RichNumericFeature ``/``, ``*``,
@@ -53,6 +63,9 @@ class NumericBinaryTransformer(BinaryTransformer):
             out = _OPS[self.op](a, b)
         out = np.where(np.isinf(out), np.nan, out)
         return FeatureColumn(ftype=Real, data=out)
+
+    def transform_arrays(self, arrays):
+        return _arith_kernel(self.op, arrays[0], arrays[1])
 
 
 class NumericScalarTransformer(UnaryTransformer):
@@ -78,6 +91,11 @@ class NumericScalarTransformer(UnaryTransformer):
         out = np.where(np.isinf(out), np.nan, out)
         return FeatureColumn(ftype=Real, data=out)
 
+    def transform_arrays(self, arrays):
+        a = arrays[0]
+        x, y = (self.scalar, a) if self.swapped else (a, self.scalar)
+        return _arith_kernel(self.op, x, y)
+
 
 class AliasTransformer(UnaryTransformer):
     """Identity stage that renames its input feature
@@ -97,6 +115,12 @@ class AliasTransformer(UnaryTransformer):
     def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
         return cols[0]
 
+    def transform_arrays(self, arrays):
+        # identity; lowers only when the input is numerically encodable
+        # (object-typed aliases fail the plan's encoder probe and fall
+        # back — same rename, host-side)
+        return arrays[0]
+
 
 class FillMissingWithMeanModel(UnaryModel):
     input_types = (OPNumeric,)
@@ -110,6 +134,10 @@ class FillMissingWithMeanModel(UnaryModel):
         vals = np.asarray(cols[0].data, dtype=np.float64)
         return FeatureColumn(
             ftype=RealNN, data=np.where(np.isnan(vals), self.fill_value, vals))
+
+    def transform_arrays(self, arrays):
+        import jax.numpy as jnp
+        return jnp.where(jnp.isnan(arrays[0]), self.fill_value, arrays[0])
 
 
 class FillMissingWithMean(UnaryEstimator):
@@ -144,6 +172,10 @@ class StandardScalerModel(UnaryModel):
         vals = np.asarray(cols[0].data, dtype=np.float64)
         std = self.std if self.std > 0 else 1.0
         return FeatureColumn(ftype=RealNN, data=(vals - self.mean) / std)
+
+    def transform_arrays(self, arrays):
+        std = self.std if self.std > 0 else 1.0
+        return (arrays[0] - self.mean) / std
 
 
 class StandardScaler(UnaryEstimator):

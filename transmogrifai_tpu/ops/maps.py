@@ -85,6 +85,41 @@ class RealMapVectorizerModel(SequenceModel):
                         indicator_value=NULL_INDICATOR))
         return vector_output(self.get_output().name, blocks, metas)
 
+    # -- compiled-serving lowering: the per-key dict walk runs on host
+    # (one (n, n_keys) NaN-missing matrix per input); impute + null
+    # tracking fuse on device.
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        return _map_values_matrix(col, self.keys[i])
+
+    def transform_arrays(self, arrays):
+        import jax.numpy as jnp
+        outs = []
+        for mat, fills in zip(arrays, self.fill_values):
+            isnan = jnp.isnan(mat)
+            filled = jnp.where(isnan, jnp.asarray(fills, mat.dtype), mat)
+            if self.track_nulls:
+                # interleave (value, null) per key — the numpy column order
+                blk = jnp.stack([filled, isnan.astype(mat.dtype)],
+                                axis=2).reshape(mat.shape[0], -1)
+            else:
+                blk = filled
+            outs.append(blk)
+        return jnp.concatenate(outs, axis=1)
+
+
+def _map_values_matrix(col: FeatureColumn, keys: Sequence[str]
+                       ) -> np.ndarray:
+    """(n, len(keys)) float matrix of map values, NaN = key absent."""
+    out = np.full((col.n_rows, len(keys)), np.nan)
+    for j, k in enumerate(keys):
+        for r, m in enumerate(col.data):
+            if m and k in m and m[k] is not None:
+                out[r, j] = float(m[k])
+    return out
+
 
 class RealMapVectorizer(SequenceEstimator):
     """Numeric maps -> per-key columns, mean-imputed
@@ -183,6 +218,39 @@ class TextMapPivotVectorizerModel(SequenceModel):
                         parent_feature_type=f.ftype.__name__,
                         grouping=k, indicator_value=NULL_INDICATOR))
         return vector_output(self.get_output().name, blocks, metas)
+
+    # -- compiled-serving lowering: per-key level->index lookup on host
+    # ((n, n_keys) int32), per-key one-hot expansion on device. Index
+    # layout per key: [0..L-1] levels, L = OTHER, L+1 = NULL.
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        keys, cats = self.keys[i], self.categories[i]
+        out = np.empty((col.n_rows, len(keys)), dtype=np.int32)
+        for j, k in enumerate(keys):
+            levels = cats.get(k, [])
+            index = {c: q for q, c in enumerate(levels)}
+            other = len(levels)
+            null = other + 1 if self.track_nulls else -1
+            for r, m in enumerate(col.data):
+                v = m.get(k) if m else None
+                out[r, j] = null if v is None \
+                    else index.get(str(v), other)
+        return out
+
+    def transform_arrays(self, arrays):
+        import jax
+        import jax.numpy as jnp
+        blocks = []
+        for idx, keys, cats in zip(arrays, self.keys, self.categories):
+            for j, k in enumerate(keys):
+                width = len(cats.get(k, [])) + 1 \
+                    + (1 if self.track_nulls else 0)
+                blocks.append(jax.nn.one_hot(idx[:, j], width))
+        if not blocks:
+            return jnp.zeros((arrays[0].shape[0], 0))
+        return jnp.concatenate(blocks, axis=1)
 
 
 class TextMapPivotVectorizer(SequenceEstimator):
@@ -288,6 +356,36 @@ class _MultiPickListMapModel(TextMapPivotVectorizerModel):
                         parent_feature_type=f.ftype.__name__,
                         grouping=k, indicator_value=NULL_INDICATOR))
         return vector_output(self.get_output().name, blocks, metas)
+
+    # -- compiled-serving lowering: like MultiPickListVectorizer, the
+    # per-key multi-hot is inherently a host dict walk, so the encoder
+    # emits the concatenated per-key blocks in transform_columns' exact
+    # layout and the device kernel is the fusing concat.
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        keys, cats = self.keys[i], self.categories[i]
+        n = col.n_rows
+        blocks = []
+        for k in keys:
+            levels = cats.get(k, [])
+            width = len(levels) + 1 + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width))
+            index = {c: q for q, c in enumerate(levels)}
+            for r, m in enumerate(col.data):
+                vals = m.get(k) if m else None
+                if not vals:
+                    if self.track_nulls:
+                        block[r, len(levels) + 1] = 1.0
+                    continue
+                for v in vals:
+                    j = index.get(str(v))
+                    block[r, j if j is not None else len(levels)] = 1.0
+            blocks.append(block)
+        return (np.concatenate(blocks, axis=1) if blocks
+                else np.zeros((n, 0)))
+
+    def transform_arrays(self, arrays):
+        import jax.numpy as jnp
+        return jnp.concatenate(arrays, axis=1)
 
 
 class GeolocationMapVectorizerModel(SequenceModel):
@@ -516,6 +614,24 @@ class DateMapToUnitCircleVectorizerModel(SequenceModel):
                         parent_feature_type=f.ftype.__name__, grouping=k,
                         descriptor_value=f"{trig}_{self.time_period}"))
         return vector_output(self.get_output().name, blocks, metas)
+
+    # -- compiled-serving lowering: host encodes (n, n_keys) phases
+    # (int64 epoch math stays on host), device projects sin/cos per key
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        from .date import TIME_PERIODS
+        phase_fn = TIME_PERIODS[self.time_period]
+        vals = _map_values_matrix(col, self.keys[i])
+        ok = ~np.isnan(vals)
+        ms = np.where(ok, vals, 0.0).astype(np.int64)
+        phase = np.asarray(phase_fn(ms), dtype=np.float64)
+        return np.where(ok, phase, np.nan)
+
+    def transform_arrays(self, arrays):
+        from .date import _unit_circle_kernel
+        return _unit_circle_kernel(arrays)
 
 
 class DateMapToUnitCircleVectorizer(SequenceEstimator):

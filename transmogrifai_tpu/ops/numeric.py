@@ -29,6 +29,20 @@ __all__ = ["RealVectorizer", "RealVectorizerModel", "IntegralVectorizer",
            "BinaryVectorizer"]
 
 
+def _numeric_kernel(arrays, fills: List[float], track_nulls: bool):
+    """Array lowering of ``_numeric_blocks`` (serving/plan.py): one (n,)
+    array per input, NaN = missing; same column order as the numpy path
+    (value, then null indicator, per input)."""
+    import jax.numpy as jnp
+    cols = []
+    for x, fill in zip(arrays, fills):
+        isnan = jnp.isnan(x)
+        cols.append(jnp.where(isnan, fill, x))
+        if track_nulls:
+            cols.append(isnan.astype(x.dtype))
+    return jnp.stack(cols, axis=1)
+
+
 def _numeric_blocks(stage, cols: List[FeatureColumn], fills: List[float],
                     track_nulls: bool):
     blocks, metas = [], []
@@ -62,6 +76,9 @@ class RealVectorizerModel(SequenceModel):
         blocks, metas = _numeric_blocks(self, cols, self.fill_values,
                                         self.track_nulls)
         return vector_output(self.get_output().name, blocks, metas)
+
+    def transform_arrays(self, arrays):
+        return _numeric_kernel(arrays, self.fill_values, self.track_nulls)
 
 
 class RealVectorizer(SequenceEstimator):
@@ -136,3 +153,7 @@ class BinaryVectorizer(SequenceTransformer):
         fills = [float(self.fill_value)] * len(cols)
         blocks, metas = _numeric_blocks(self, cols, fills, self.track_nulls)
         return vector_output(self.get_output().name, blocks, metas)
+
+    def transform_arrays(self, arrays):
+        return _numeric_kernel(arrays, [float(self.fill_value)] * len(arrays),
+                               self.track_nulls)

@@ -140,6 +140,17 @@ class SelectedModel(PredictionModel):
     def predict_arrays(self, X: np.ndarray) -> PredictionColumn:
         return self.inner.predict_arrays(X)
 
+    # compiled-serving lowering delegates to the winning model so the
+    # fused program embeds ITS kernel (serving/plan.py)
+    def raw_arrays(self, X):
+        return self.inner.raw_arrays(X)
+
+    def supports_arrays(self) -> bool:
+        return self.inner is not None and self.inner.supports_arrays()
+
+    def prediction_from_raw(self, raw: np.ndarray) -> PredictionColumn:
+        return self.inner.prediction_from_raw(raw)
+
 
 def models_x_folds(model) -> int:
     """Total (candidate, fold) evaluations recorded by the selector(s)

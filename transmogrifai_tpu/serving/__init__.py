@@ -1,0 +1,7 @@
+"""Compiled serving: fuse a fitted workflow DAG into batched, jitted,
+shape-bucketed XLA scoring programs (docs/serving.md)."""
+from .plan import (PlanCompileError, PlanCoverage, ScoringPlan,
+                   bucket_for, plan_compiles)
+
+__all__ = ["ScoringPlan", "PlanCoverage", "PlanCompileError",
+           "plan_compiles", "bucket_for"]

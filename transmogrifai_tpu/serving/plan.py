@@ -1,0 +1,433 @@
+"""ScoringPlan: freeze a fitted DAG into batched, shape-bucketed XLA
+programs.
+
+The fit side already batches whole hyperparameter grids into single
+vmapped XLA programs (parallel/cv.py); this module gives the SERVING
+side the same treatment. Instead of walking the DAG stage-by-stage in
+host numpy per batch (workflow.py) — or record-by-record in a Python
+loop (local/scoring.py) — a plan:
+
+1. **Compiles the DAG once.** ``topo_layers`` is walked and every
+   fitted stage is asked for an array-level kernel
+   (``Transformer.transform_arrays``, stages/base.py). Stages that
+   lower are composed into ONE traced function; XLA then fuses the
+   whole feature pipeline + model predict into a single program
+   (operator-fusion rationale: arxiv 2301.13062 — hand the compiler
+   the program, not one stage at a time). Stages that cannot lower run
+   through their numpy ``transform_columns`` fallback, host-side,
+   before (``pre``) or after (``post``) the device program; coverage
+   is reported, parity is guaranteed either way.
+2. **Buckets batch shapes.** Incoming batches are padded up to
+   power-of-two row buckets with a validity mask, so arbitrary request
+   sizes hit a handful of cached compilations instead of recompiling
+   per batch size. Batches beyond the largest bucket are chunked.
+   ``utils/jax_setup.enable_compilation_cache`` is enabled at plan
+   compile, so a warm-started server skips XLA entirely.
+3. **Scores in one round-trip.** One host->device transfer of the
+   encoded raw arrays, one fused program, one device->host transfer of
+   the requested outputs — with input-buffer donation on accelerator
+   backends.
+
+``plan_compiles()`` counts distinct (plan, bucket) programs — the
+compile diagnostic bench.py reports (same idiom as
+models/trees.tree_kernel_compiles): a repeated same-bucket batch adds
+zero.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..features.columns import Dataset, FeatureColumn, PredictionColumn
+from ..features.feature import Feature, topo_layers
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import Transformer
+from ..types import Prediction
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ScoringPlan", "PlanCoverage", "PlanCompileError",
+           "plan_compiles", "bucket_for", "DEFAULT_MIN_BUCKET",
+           "DEFAULT_MAX_BUCKET"]
+
+#: smallest padded batch — single-record requests share one program
+DEFAULT_MIN_BUCKET = 8
+#: largest padded batch — bigger requests are chunked so the compile
+#: count stays bounded at log2(max/min)+1 programs per plan
+DEFAULT_MAX_BUCKET = 8192
+
+#: distinct (plan, bucket) XLA programs compiled so far in this process
+_COMPILE_KEYS: set = set()
+_PLAN_IDS = itertools.count()
+
+
+def plan_compiles() -> int:
+    """Distinct compiled scoring programs so far in this process (the
+    compile-count diagnostic bench.py's score mode reports)."""
+    return len(_COMPILE_KEYS)
+
+
+def bucket_for(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
+               max_bucket: int = DEFAULT_MAX_BUCKET) -> int:
+    """Smallest power-of-two bucket >= n (clamped to the bucket range);
+    n beyond the largest bucket is the caller's cue to chunk."""
+    b = min_bucket
+    while b < n and b < max_bucket:
+        b *= 2
+    return min(b, max_bucket)
+
+
+class PlanCompileError(RuntimeError):
+    """The fitted DAG could not be frozen into a plan (e.g. a stage
+    crashed during the zero-row metadata probe). Callers fall back to
+    the per-stage numpy path."""
+
+
+@dataclass
+class _Step:
+    """One stage of the plan in execution order."""
+    stage: Transformer
+    out_name: str
+    input_names: Tuple[str, ...]
+    phase: str          # "pre" | "device" | "post"
+    reason: str = ""    # why a fallback stage did not lower
+
+
+@dataclass
+class PlanCoverage:
+    """Which stages lowered into the fused program and which fell back
+    to per-stage numpy (with the reason)."""
+    lowered: List[str] = field(default_factory=list)
+    fallback: List[Tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return len(self.lowered) + len(self.fallback)
+
+    @property
+    def lowered_fraction(self) -> float:
+        return len(self.lowered) / self.total if self.total else 1.0
+
+    def to_json(self) -> dict:
+        return {"lowered": list(self.lowered),
+                "fallback": [list(f) for f in self.fallback],
+                "lowered_fraction": round(self.lowered_fraction, 3)}
+
+
+def _empty_raw_dataset(raw_features: Sequence[Feature]) -> Dataset:
+    """Zero-row typed dataset for the metadata probe."""
+    return Dataset({f.name: FeatureColumn.from_values(f.ftype, [])
+                    for f in raw_features})
+
+
+class ScoringPlan:
+    """A fitted ``WorkflowModel`` frozen into jitted, shape-bucketed
+    scoring programs. Build once per model, reuse per batch:
+
+    >>> plan = ScoringPlan(model).compile()
+    >>> scored = plan.score(records)        # Dataset of result columns
+    """
+
+    def __init__(self, model, min_bucket: int = DEFAULT_MIN_BUCKET,
+                 max_bucket: int = DEFAULT_MAX_BUCKET,
+                 donate: Optional[bool] = None):
+        self.model = model
+        self.min_bucket = int(min_bucket)
+        self.max_bucket = int(max_bucket)
+        if self.min_bucket < 1 or self.max_bucket < self.min_bucket:
+            raise ValueError(
+                f"bad bucket range [{min_bucket}, {max_bucket}]")
+        #: donate input buffers to the program (skips one device copy);
+        #: None = auto (on for accelerators, off for CPU which does not
+        #: implement donation and would warn per call)
+        self.donate = donate
+        self._plan_id = next(_PLAN_IDS)
+        self._compiled = False
+        self.coverage = PlanCoverage()
+
+    # -- compilation -------------------------------------------------------
+    def compile(self) -> "ScoringPlan":
+        """Walk the fitted DAG, classify every stage (device kernel vs
+        numpy fallback), probe zero rows through the numpy path for
+        output metadata, and build the jitted device program. Idempotent.
+        """
+        if self._compiled:
+            return self
+        from ..utils.jax_setup import enable_compilation_cache
+        try:
+            # warm-start serving: persisted XLA artifacts skip compiles
+            enable_compilation_cache()
+        except Exception:  # pragma: no cover - cache dir not writable
+            pass
+        import jax
+
+        self._raw_features = self.model.raw_features()
+        self._result_names = [f.name for f in self.model.result_features]
+        stages = []
+        for layer in topo_layers(self.model.result_features):
+            for s in layer:
+                if isinstance(s, FeatureGeneratorStage):
+                    continue
+                if not isinstance(s, Transformer):
+                    raise PlanCompileError(
+                        f"unfitted estimator {s!r} in scoring DAG")
+                stages.append(s)
+
+        self._proto_cols = self._probe_zero_rows(stages)
+        self._classify(stages)
+        self._build_device_fn(jax)
+        self._compiled = True
+        return self
+
+    def _probe_zero_rows(self, stages: List[Transformer]
+                         ) -> Dict[str, FeatureColumn]:
+        """Run the whole DAG over ZERO rows through the numpy path —
+        milliseconds, no device code — capturing every intermediate
+        column's type/width/metadata so device outputs can be wrapped
+        back into columns exactly as the numpy path would build them.
+        Prediction outputs are skipped (they carry no metadata)."""
+        ds = _empty_raw_dataset(self._raw_features)
+        for stage in stages:
+            out = stage.get_output()
+            if issubclass(stage.static_output_type(), Prediction):
+                ds = ds.with_column(
+                    out.name, PredictionColumn.from_arrays(np.zeros(0)))
+                continue
+            try:
+                ds = stage.transform_dataset(ds)
+            except Exception as e:
+                raise PlanCompileError(
+                    f"stage {type(stage).__name__}({stage.uid}) failed "
+                    f"the zero-row probe: {e!r}") from e
+        return {name: ds[name] for name in ds.column_names}
+
+    def _classify(self, stages: List[Transformer]) -> None:
+        """Assign each stage to the device graph or a host fallback
+        phase. A stage lowers when it has an array kernel AND every
+        input is array-feedable; a fallback stage downstream of any
+        lowered stage must wait for the device outputs (phase "post"),
+        and nothing downstream of a "post" stage can lower (the device
+        program runs once)."""
+        producer: Dict[str, str] = {f.name: "host"
+                                    for f in self._raw_features}
+        steps: List[_Step] = []
+        for stage in stages:
+            out_name = stage.get_output().name
+            in_names = tuple(f.name for f in stage.input_features)
+            reason = ""
+            if not stage.supports_arrays():
+                reason = "no array kernel (transform_arrays)"
+            else:
+                for i, name in enumerate(in_names):
+                    src = producer.get(name, "host")
+                    if src == "post":
+                        reason = (f"input {name!r} is produced by a "
+                                  f"host fallback downstream of the "
+                                  f"device graph")
+                        break
+                    if src == "device":
+                        if stage.encodes_input(i):
+                            reason = (f"input {name!r} needs host "
+                                      f"encoding but is produced on "
+                                      f"device")
+                            break
+                        continue
+                    # host-materialized input: probe the encoder on the
+                    # zero-row proto column
+                    try:
+                        stage.encode_input_column(
+                            i, self._proto_cols[name])
+                    except Exception as e:
+                        reason = (f"input {name!r} not encodable: {e}")
+                        break
+            if not reason:
+                phase = "device"
+                producer[out_name] = "device"
+            else:
+                upstream = {producer.get(n, "host") for n in in_names}
+                phase = "pre" if upstream <= {"host"} else "post"
+                producer[out_name] = "host" if phase == "pre" else "post"
+                self.coverage.fallback.append(
+                    (f"{type(stage).__name__}({out_name})", reason))
+            if phase == "device":
+                self.coverage.lowered.append(
+                    f"{type(stage).__name__}({out_name})")
+            steps.append(_Step(stage, out_name, in_names, phase, reason))
+        self._steps = steps
+        self._producer = producer
+
+        # device inputs: (key, feature name, encoder) — encoders with
+        # stage-specific lookups get their own key, identity encodings
+        # share the feature name
+        self._host_inputs: List[Tuple[str, str, Callable]] = []
+        seen_keys = set()
+        for step in steps:
+            if step.phase != "device":
+                continue
+            for i, name in enumerate(step.input_names):
+                if self._producer.get(name) == "device":
+                    continue
+                if step.stage.encodes_input(i):
+                    key = f"enc:{step.stage.uid}:{i}"
+                    enc = (lambda col, s=step.stage, slot=i:
+                           s.encode_input_column(slot, col))
+                else:
+                    key = name
+                    enc = (lambda col, s=step.stage, slot=i:
+                           s.encode_input_column(slot, col))
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    self._host_inputs.append((key, name, enc))
+
+        # which device outputs must be materialized back into columns:
+        # result features + inputs of host "post" fallbacks
+        needed = set(self._result_names)
+        for step in steps:
+            if step.phase == "post":
+                needed.update(step.input_names)
+        self._device_outputs = [
+            s.out_name for s in steps
+            if s.phase == "device" and s.out_name in needed]
+
+    def _build_device_fn(self, jax) -> None:
+        """Compose the lowered kernels into ONE traced function; jit it
+        once — per-bucket shapes then hit jit's own compile cache."""
+        device_steps = [
+            (s.stage,
+             s.out_name,
+             tuple((f"enc:{s.stage.uid}:{i}"
+                    if self._producer.get(n) != "device"
+                    and s.stage.encodes_input(i) else n)
+                   for i, n in enumerate(s.input_names)))
+            for s in self._steps if s.phase == "device"]
+        in_keys = tuple(k for k, _, _ in self._host_inputs)
+        out_names = tuple(self._device_outputs)
+
+        def run(inputs, mask):
+            env = dict(zip(in_keys, inputs))
+            outs = []
+            for stage, out_name, keys in device_steps:
+                env[out_name] = stage.transform_arrays(
+                    [env[k] for k in keys])
+            for name in out_names:
+                o = env[name]
+                outs.append(o * (mask[:, None] if o.ndim == 2 else mask))
+            return tuple(outs)
+
+        if self.donate is None:
+            self.donate = jax.default_backend() != "cpu"
+        donate = (0,) if self.donate else ()
+        # one jit per PLAN (compile() runs once per model) — per-call
+        # recompiles cannot happen here, each bucket shape is cached
+        self._device_fn = jax.jit(run, donate_argnums=donate)  # tx-lint: disable=TX-J02,TX-J06
+
+    # -- execution ---------------------------------------------------------
+    def score(self, data: Any) -> Dataset:
+        """Score a Dataset / record iterable / DataReader through the
+        plan; returns the raw + result feature columns (the
+        ``Workflow.score`` contract). Compiles lazily on first use."""
+        self.compile()
+        from ..workflow.workflow import _generate_raw_data
+        ds = _generate_raw_data(self._raw_features, data,
+                                require_responses=False)
+        return self.score_raw_dataset(ds)
+
+    def score_raw_dataset(self, ds: Dataset) -> Dataset:
+        """Score an already-materialized raw Dataset (all raw feature
+        columns present; absent responses NaN-filled by the caller)."""
+        self.compile()
+        n = ds.n_rows
+        # phase "pre": numpy fallbacks feeding the device graph
+        for step in self._steps:
+            if step.phase == "pre":
+                ds = step.stage.transform_dataset(ds)
+
+        # encode once per host input, then run per bucket chunk
+        encoded = [(key, enc(ds[name]))
+                   for key, name, enc in self._host_inputs]
+        out_chunks: List[List[np.ndarray]] = [[] for _ in
+                                              self._device_outputs]
+        for start in range(0, max(n, 1), self.max_bucket):
+            stop = min(start + self.max_bucket, n)
+            rows = stop - start
+            bucket = bucket_for(rows, self.min_bucket, self.max_bucket)
+            inputs = tuple(_pad_rows(arr[start:stop], bucket)
+                           for _, arr in encoded)
+            mask = np.zeros(bucket, dtype=np.float64)
+            mask[:rows] = 1.0
+            _COMPILE_KEYS.add((self._plan_id, bucket))
+            outs = self._device_fn(inputs, mask)
+            for i, o in enumerate(outs):
+                out_chunks[i].append(np.asarray(o)[:rows])
+            if n == 0:
+                break
+
+        for name, chunks in zip(self._device_outputs, out_chunks):
+            arr = (np.concatenate(chunks, axis=0) if chunks
+                   else np.zeros(0))
+            ds = ds.with_column(name, self._wrap_output(name, arr))
+
+        # phase "post": numpy fallbacks consuming device outputs
+        for step in self._steps:
+            if step.phase == "post":
+                ds = step.stage.transform_dataset(ds)
+
+        keep = [f.name for f in self._raw_features if f.name in ds] \
+            + [nm for nm in self._result_names]
+        seen, names = set(), []
+        for nm in keep:
+            if nm not in seen:
+                seen.add(nm)
+                names.append(nm)
+        return ds.select(names)
+
+    def _wrap_output(self, name: str, arr: np.ndarray) -> FeatureColumn:
+        """Materialize a device output array as the column the numpy
+        path would have produced (metadata from the zero-row probe;
+        Prediction raws through the model's own prediction_from_raw)."""
+        step = next(s for s in self._steps if s.out_name == name)
+        stage = step.stage
+        if issubclass(stage.static_output_type(), Prediction):
+            return stage.prediction_from_raw(arr)
+        proto = self._proto_cols[name]
+        if proto.kind == "vector":
+            arr = arr.reshape(len(arr), -1)
+            return FeatureColumn(ftype=proto.ftype, data=arr,
+                                 metadata=proto.metadata)
+        return FeatureColumn(ftype=proto.ftype, data=arr.reshape(-1))
+
+    # -- introspection -----------------------------------------------------
+    def describe(self) -> dict:
+        """Plan summary for logs/benchmarks."""
+        self.compile()
+        return {
+            "stages": len(self._steps),
+            "device_stages": len(self.coverage.lowered),
+            "fallback_stages": len(self.coverage.fallback),
+            "coverage": self.coverage.to_json(),
+            "host_inputs": [k for k, _, _ in self._host_inputs],
+            "device_outputs": list(self._device_outputs),
+            "buckets": self.buckets(),
+        }
+
+    def buckets(self) -> List[int]:
+        out, b = [], self.min_bucket
+        while b < self.max_bucket:
+            out.append(b)
+            b *= 2
+        out.append(self.max_bucket)
+        return out
+
+
+def _pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad the leading (row) axis up to ``bucket`` with zeros."""
+    arr = np.ascontiguousarray(arr)
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)

@@ -254,6 +254,49 @@ class Transformer(PipelineStage):
     def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
         raise NotImplementedError
 
+    # -- compiled-serving lowering (serving/plan.py) -----------------------
+    def transform_arrays(self, arrays: List[Any]) -> Any:
+        """Array-level kernel for the compiled scoring plan: one jnp
+        array per wired input slot (as produced by
+        ``encode_input_column`` or an upstream stage's kernel), ONE jnp
+        array out. Must be traceable under ``jax.jit`` — no host numpy,
+        no Python branching on values. Stages without a lowering keep
+        this default; the plan then runs them through the per-stage
+        numpy ``transform_columns`` fallback (parity guaranteed)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no array lowering")
+
+    def supports_arrays(self) -> bool:
+        """Whether this stage lowers to an array kernel (plan coverage
+        probe). Default: ``transform_arrays`` overridden somewhere below
+        ``Transformer``."""
+        return type(self).transform_arrays is not Transformer.transform_arrays
+
+    def encodes_input(self, i: int) -> bool:
+        """True when input slot ``i`` needs a stage-specific host
+        encoder (``encode_input_column`` override) rather than the
+        identity numeric/vector encoding — e.g. a trained
+        category->index lookup. The plan only lowers such a stage when
+        that input is host-materialized (raw or numpy-fallback output),
+        never when it is produced inside the device graph."""
+        return False
+
+    def encode_input_column(self, i: int, col: "FeatureColumn") -> np.ndarray:
+        """Host-side boundary encoder: FeatureColumn -> the dense
+        numeric array input slot ``i`` of ``transform_arrays`` expects.
+        The default is the identity encoding for numeric/vector columns
+        (so in-graph arrays and host-encoded arrays are
+        interchangeable); object columns must be encoded by a
+        stage-specific override (``encodes_input`` -> True)."""
+        kind = col.kind
+        if kind == "numeric":
+            return np.asarray(col.data, dtype=np.float64)
+        if kind == "vector":
+            return np.asarray(col.data, dtype=np.float64)
+        raise TypeError(
+            f"{type(self).__name__} input {i} ({col.ftype.__name__}, "
+            f"kind={kind!r}) has no default array encoding")
+
     def transform_dataset(self, ds: Dataset) -> Dataset:
         out = self.get_output()
         cols = [ds[f.name] for f in self.input_features]

@@ -543,11 +543,32 @@ class WorkflowModel:
                 for s in layer if not isinstance(s, FeatureGeneratorStage)]
 
     # -- scoring -----------------------------------------------------------
-    def score(self, data: Any = None, keep_intermediate: bool = False
-              ) -> Dataset:
+    def score(self, data: Any = None, keep_intermediate: bool = False,
+              engine: str = "columnar") -> Dataset:
         """Transform new data through the fitted DAG
         (reference OpWorkflowModel.score:253). ``data`` is a Dataset or
-        record iterable; response features may be absent."""
+        record iterable; response features may be absent.
+
+        ``engine`` selects the execution path:
+
+        - ``"columnar"`` (default): per-stage host numpy columnar
+          kernels, layer by layer.
+        - ``"compiled"``: the serving :class:`ScoringPlan` — the DAG
+          fused into shape-bucketed jitted XLA programs with per-stage
+          numpy fallback (docs/serving.md). Compiled once per model and
+          cached; ~identical results (floating-point associativity
+          aside), much faster on large batches.
+        """
+        if engine not in ("columnar", "compiled"):
+            raise ValueError(
+                f"engine must be 'columnar' or 'compiled', got {engine!r}")
+        if engine == "compiled":
+            if keep_intermediate:
+                raise ValueError(
+                    "keep_intermediate is not supported with "
+                    "engine='compiled' (intermediates are fused away "
+                    "inside the XLA program)")
+            return self.scoring_plan().score(data)
         raw = self.raw_features()
         ds = _generate_raw_data(raw, data, require_responses=False)
         layers = topo_layers(self.result_features)
@@ -562,6 +583,18 @@ class WorkflowModel:
                 seen.add(n)
                 names.append(n)
         return scored.select(names)
+
+    def scoring_plan(self, **plan_kwargs):
+        """The compiled serving plan for this model (built and compiled
+        lazily, cached on the model; see serving/plan.py). Pass
+        ``min_bucket``/``max_bucket``/``donate`` to rebuild with a
+        different bucket policy."""
+        from ..serving import ScoringPlan
+        cached = getattr(self, "_scoring_plan", None)
+        if cached is None or plan_kwargs:
+            cached = ScoringPlan(self, **plan_kwargs).compile()
+            self._scoring_plan = cached
+        return cached
 
     def score_and_evaluate(self, data: Any, evaluator: Evaluator,
                            label_feature: Optional[Feature] = None,
