@@ -31,6 +31,45 @@ INNER_TIMEOUT_S = int(os.environ.get("TX_BENCH_TPU_TIMEOUT", "900"))
 PROBE_TIMEOUT_S = int(os.environ.get("TX_BENCH_PROBE_TIMEOUT", "60"))
 
 
+def _probe_cache_path() -> str:
+    """Probe-verdict cache file, keyed by the jax version and the
+    JAX_PLATFORMS pin — the two inputs that change what the probe would
+    see. BENCH_r05 burned 3x60s re-probing an ambient backend that
+    hangs every time; the verdict (healthy OR dead) is stable per
+    environment, so it is cached under /tmp and reused."""
+    try:
+        from importlib.metadata import version
+        jax_v = version("jax")
+    except Exception:  # pragma: no cover - defensive
+        jax_v = "unknown"
+    key = f"{jax_v}-{os.environ.get('JAX_PLATFORMS', 'ambient')}"
+    key = "".join(c if c.isalnum() or c in ".-" else "_" for c in key)
+    return os.path.join("/tmp", f"tx_bench_probe_{key}.json")
+
+
+def _load_probe_verdict():
+    """Cached (healthy, note) or None. TX_BENCH_PROBE_REFRESH=1 ignores
+    the cache; TX_BENCH_PLATFORM overrides probing entirely (handled by
+    the caller)."""
+    if os.environ.get("TX_BENCH_PROBE_REFRESH") == "1":
+        return None
+    try:
+        with open(_probe_cache_path()) as fh:
+            d = json.load(fh)
+        return bool(d["healthy"]), str(d.get("note", ""))
+    except Exception:
+        return None
+
+
+def _store_probe_verdict(healthy: bool, note: str) -> None:
+    try:
+        with open(_probe_cache_path(), "w") as fh:
+            json.dump({"healthy": healthy, "note": note,
+                       "time": time.time()}, fh)
+    except OSError:  # pragma: no cover - read-only /tmp
+        pass
+
+
 def _measure_score() -> dict:
     """TX_BENCH_MODE=score: compiled-plan scoring throughput vs the
     per-record ScoreFunction loop on a 10k-row Titanic batch. Headline
@@ -110,9 +149,108 @@ def _measure_score() -> dict:
     }
 
 
+def _selector_fit_seconds(listener) -> float:
+    """Selector-search seconds of one run: the ModelSelector stage's
+    fit time (the feature DAG ahead of it is shared by any two runs
+    compared, so this isolates what racing actually changes)."""
+    return sum(m.seconds for m in listener.metrics.stage_metrics
+               if m.phase == "fit" and "ModelSelector" in m.stage_name)
+
+
+def _selector_compile_seconds(listener) -> float:
+    """XLA trace+lower+compile seconds attributed to the selector
+    stage (utils/compile_time.py): first-call cost a warm process
+    skips. Subtracting it from the fit seconds gives the steady-state
+    execute time — on compile-bound CPU runs the cold wall-clock ratio
+    under-reports what racing saves on an accelerator."""
+    return sum(m.compile_seconds for m in listener.metrics.stage_metrics
+               if m.phase == "fit" and "ModelSelector" in m.stage_name)
+
+
+def _measure_racing() -> dict:
+    """TX_BENCH_MODE=racing: the full-CV selector search vs the
+    successive-halving racing search on the same Titanic grid (ISSUE 3
+    acceptance: racing train_eval <= 1/3 of full CV at holdout AuPR
+    within +/-0.005; rung/pruned telemetry emitted)."""
+    from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
+                                                   pin_platform_from_env)
+    pin_platform_from_env()
+    enable_compilation_cache()
+    import jax
+    platform = jax.devices()[0].platform
+    from examples.titanic import load_titanic, run, synthetic_titanic
+    from transmogrifai_tpu.selector import SelectedModel, search_compiles
+    from transmogrifai_tpu.utils.listener import WorkflowListener
+
+    try:
+        records = load_titanic()
+        data_source = "titanic_csv"
+    except FileNotFoundError:
+        # the racing-vs-exact comparison needs the grid shape and a
+        # learnable signal, not the real rows
+        records = synthetic_titanic(1309)
+        data_source = "synthetic_titanic"
+    lst_full = WorkflowListener()
+    metrics_full, fit_full, _ = run(verbose=False, listener=lst_full,
+                                    records=records)
+    c0 = search_compiles()
+    # the bench ladder: eta=3 with a 1/27 first rung (4 rungs — the
+    # default 1/9 three-rung ladder spends 50/144 fold-fit equivalents,
+    # structurally capped below the 3x target; the deeper ladder
+    # screens at ~23/144). TX_BENCH_MIN_FIDELITY overrides.
+    min_fid = float(os.environ.get("TX_BENCH_MIN_FIDELITY", 1.0 / 27.0))
+    lst_rac = WorkflowListener()
+    metrics_rac, fit_rac, model_rac = run(
+        verbose=False, listener=lst_rac, validation="racing",
+        min_fidelity=min_fid, records=records)
+    racing = {}
+    for s in model_rac.stages():
+        if isinstance(s, SelectedModel) and s.summary is not None \
+                and s.summary.racing:
+            racing = s.summary.racing
+    sel_full = _selector_fit_seconds(lst_full) or fit_full
+    sel_rac = _selector_fit_seconds(lst_rac) or fit_rac
+    # steady-state split: what a warm process (or a compute-bound
+    # accelerator) pays — cold CPU runs are compile-dominated and the
+    # raw wall ratio under-reports the pruning win
+    exec_full = max(sel_full - _selector_compile_seconds(lst_full), 1e-9)
+    exec_rac = max(sel_rac - _selector_compile_seconds(lst_rac), 1e-9)
+    aupr_full, aupr_rac = float(metrics_full.AuPR), float(metrics_rac.AuPR)
+    return {
+        "metric": "racing_train_eval_seconds",
+        "value": round(sel_rac, 2),
+        "unit": "s",
+        # headline ratio: how many x the racing search saves over exact
+        # full CV on the SAME machine/process (selector stage only —
+        # the shared feature DAG would dilute it)
+        "vs_baseline": round(sel_full / max(sel_rac, 1e-9), 2),
+        "speedup_vs_full_cv": round(sel_full / max(sel_rac, 1e-9), 2),
+        "steady_state_speedup": round(exec_full / exec_rac, 2),
+        "train_eval_seconds_full_cv": round(sel_full, 2),
+        "execute_seconds_full_cv": round(exec_full, 2),
+        "execute_seconds_racing": round(exec_rac, 2),
+        "search_seconds_saved": round(sel_full - sel_rac, 2),
+        "total_seconds_full_cv": round(fit_full, 2),
+        "total_seconds_racing": round(fit_rac, 2),
+        "aupr_full_cv": round(aupr_full, 4),
+        "aupr_racing": round(aupr_rac, 4),
+        "aupr_delta": round(aupr_rac - aupr_full, 4),
+        "rungs": racing.get("rungs", []),
+        "candidates_total": racing.get("candidatesTotal"),
+        "candidates_pruned": racing.get("candidatesPruned"),
+        "budget_spent_fold_fits": racing.get("budgetSpentFoldFits"),
+        "budget_full_cv_fold_fits": racing.get("budgetFullCvFoldFits"),
+        "racing_rung_signatures": search_compiles() - c0,
+        "platform": platform,
+        "data_source": data_source,
+    }
+
+
 def _measure() -> dict:
     if os.environ.get("TX_BENCH_MODE") == "score":
         return _measure_score()
+    if os.environ.get("TX_BENCH_MODE") == "racing":
+        return _measure_racing()
     from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
                                                    pin_platform_from_env)
     pin_platform_from_env()
@@ -157,10 +295,16 @@ def _measure() -> dict:
     # BASELINE.md): grid points x folds over the selector search
     from transmogrifai_tpu.selector.selector import models_x_folds
     n_candidates = models_x_folds(model)
+    # [stage, phase, total_s, compile_s, execute_s]: the compile split
+    # (utils/compile_time.py) tells a compile-bound CPU run from a
+    # compute-bound one; family_profile breaks the selector search down
+    # the same way per model family
     stage_top = [
-        [m.stage_name, m.phase, round(m.seconds, 2)]
+        [m.stage_name, m.phase, round(m.seconds, 2),
+         round(m.compile_seconds, 2), round(m.execute_seconds, 2)]
         for m in sorted(listener.metrics.stage_metrics,
                         key=lambda m: -m.seconds)[:3]]
+    from transmogrifai_tpu.selector.validator import family_profile
     out = {
         "metric": "titanic_holdout_aupr",
         "value": round(float(metrics.AuPR), 4),
@@ -179,6 +323,7 @@ def _measure() -> dict:
         "depth_mode": _depth_mode(),
         "hist_mode": _hist_mode(),
         "stage_profile_top": stage_top,
+        "family_profile": family_profile(),
     }
     if warm_seconds is not None:
         # same denominator as the headline per-sec key: the selector
@@ -243,6 +388,20 @@ PROBE_ATTEMPTS = int(os.environ.get("TX_BENCH_PROBE_ATTEMPTS", "3"))
 
 
 def _probe_ambient() -> tuple[bool, str, list]:
+    # explicit override: TX_BENCH_PLATFORM=cpu forces the in-process
+    # CPU path, anything else declares the ambient backend healthy —
+    # both skip probing (and the probe cache) entirely
+    forced = os.environ.get("TX_BENCH_PLATFORM")
+    if forced:
+        healthy = forced.lower() != "cpu"
+        return healthy, f"TX_BENCH_PLATFORM={forced}", [
+            f"probe skipped: TX_BENCH_PLATFORM={forced}"]
+    cached = _load_probe_verdict()
+    if cached is not None:
+        healthy, note = cached
+        return healthy, note, [
+            f"probe verdict cached ({_probe_cache_path()}): "
+            + ("ok platform=" + note if healthy else note)]
     transcript = []
     note = ""
     for i in range(PROBE_ATTEMPTS):
@@ -253,9 +412,11 @@ def _probe_ambient() -> tuple[bool, str, list]:
             f"({time.perf_counter() - t0:.1f}s): "
             + ("ok platform=" + note if ok else note))
         if ok:
+            _store_probe_verdict(True, note)
             return True, note, transcript
         if i + 1 < PROBE_ATTEMPTS:
             time.sleep(5 * (i + 1))
+    _store_probe_verdict(False, note)
     return False, note, transcript
 
 
@@ -302,6 +463,8 @@ def main() -> None:
 def _headline_metric() -> tuple:
     if os.environ.get("TX_BENCH_MODE") == "score":
         return "score_rows_per_s", "rows/s"
+    if os.environ.get("TX_BENCH_MODE") == "racing":
+        return "racing_train_eval_seconds", "s"
     return "titanic_holdout_aupr", "AuPR"
 
 

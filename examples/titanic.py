@@ -198,29 +198,42 @@ def stratified_split(records, label_key="survived", test_fraction=0.25,
     return train, test
 
 
-def default_selector(num_folds: int = 3, seed: int = 42):
+def default_selector(num_folds: int = 3, seed: int = 42,
+                     validation: str = "exact", eta: int = 3,
+                     min_fidelity: float = None):
     """BinaryClassificationModelSelector with CV over the default model
     pool (the reference README.md:61-63 runs 3 LR + 16 RF under 3-fold
     CV; our pool is whatever ``default_binary_models`` currently
-    registers — linear families always, tree families once present)."""
+    registers — linear families always, tree families once present).
+    ``validation="racing"`` races the pool under successive halving
+    (docs/selection.md) instead of training all of it to completion."""
     from transmogrifai_tpu.selector import BinaryClassificationModelSelector
     return BinaryClassificationModelSelector.with_cross_validation(
-        num_folds=num_folds, seed=seed, stratify=True)
+        num_folds=num_folds, seed=seed, stratify=True,
+        validation=validation, eta=eta, min_fidelity=min_fidelity)
 
 
 def run(csv_path: str = None, model_stage=None, verbose: bool = True,
-        workflow_cv: bool = False, listener=None):
+        workflow_cv: bool = False, listener=None,
+        validation: str = "exact", min_fidelity: float = None,
+        records=None):
     """Train on a 75% split, evaluate on the 25% holdout.
 
     ``workflow_cv=True`` enables leakage-free workflow-level CV (every
     label-consuming selector ancestor refit per fold; reference
     withWorkflowCV). ``listener`` (a WorkflowListener) collects the
-    per-stage profile. Returns (metrics, wall_clock_seconds, model).
+    per-stage profile. ``validation="racing"`` runs the selector search
+    under successive halving. ``records`` (pre-parsed dicts, e.g.
+    ``synthetic_titanic()`` in CSV-less environments) bypasses the CSV.
+    Returns (metrics, wall_clock_seconds, model).
     """
-    records = load_titanic(csv_path)
+    if records is None:
+        records = load_titanic(csv_path)
     train, test = stratified_split(records)
     survived, features = build_features()
-    stage = model_stage if model_stage is not None else default_selector()
+    stage = (model_stage if model_stage is not None
+             else default_selector(validation=validation,
+                                   min_fidelity=min_fidelity))
     prediction = stage.set_input(survived, features).get_output()
 
     t0 = time.perf_counter()

@@ -313,6 +313,91 @@ class TestJaxAstRules:
         # and transform_value loops OUTSIDE serving/ are not its business
         assert lint_source(code, "x/local/loop.py") == []
 
+    def test_j07_grid_value_into_static_argname(self):
+        findings = _src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("depth",))
+            def kern(x, depth):
+                return x * depth
+
+            def fit_fold_grid_arrays(X, grid):
+                return [kern(X, depth=p["max_depth"]) for p in grid]
+        """)
+        (f,) = [f for f in findings if f.rule_id == "TX-J07"]
+        assert "depth" in f.message and f.severity == "warning"
+        assert "fit_fold_grid_arrays" in f.message
+
+    def test_j07_grid_value_keys_memoized_builder(self):
+        findings = _src("""
+            import functools
+            import jax
+
+            @functools.lru_cache(maxsize=None)
+            def make_kernel(depth):
+                def body(x):
+                    return x * depth
+                return jax.jit(body)
+
+            def fit_fold_grid_arrays(X, grid):
+                out = []
+                for gi, p in enumerate(list(grid)):
+                    depth = p["max_depth"]
+                    out.append(make_kernel(depth)(X))
+                return out
+        """)
+        (f,) = [f for f in findings if f.rule_id == "TX-J07"]
+        assert "make_kernel" in f.message
+
+    def test_j07_aggregate_statics_are_blessed(self):
+        # whole-grid aggregates (one value per SEARCH, not per point)
+        # may shape statics — the repo's grouped-statics idiom
+        assert _src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("use_l1",))
+            def kern(x, use_l1):
+                return x
+
+            def fit_fold_grid_arrays(X, grid):
+                use_l1 = any(p.get("l1") for p in grid)
+                return kern(X, use_l1=bool(use_l1))
+        """) == []
+
+    def test_j07_taint_stops_at_nontrivial_calls(self):
+        # grid -> group_grid(...) -> groups: the grouped-statics path
+        # compiles once per GROUP, so the taint deliberately stops
+        assert _src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("shape_key",))
+            def kern(x, shape_key):
+                return x
+
+            def group_grid(grid):
+                return {}
+
+            def fit_fold_grid_arrays(X, grid):
+                groups = group_grid(grid)
+                return [kern(X, shape_key=k) for k in groups]
+        """) == []
+
+    def test_j07_outside_grid_kernel_is_silent(self):
+        assert _src("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("depth",))
+            def kern(x, depth):
+                return x * depth
+
+            def plain_fit(X, params):
+                return kern(X, depth=params["max_depth"])
+        """) == []
+
     def test_e00_parse_error(self):
         findings = lint_source("def broken(:\n", "bad.py")
         assert _rules(findings) == {"TX-E00"}

@@ -1,0 +1,299 @@
+"""Multi-fidelity racing search tests (selector/racing.py).
+
+The contracts under test, in the ISSUE's words:
+
+- the final rung evaluates survivors under the EXACT same fold protocol
+  as full CV — finalist metric vectors are bitwise identical to the
+  exact validator's, so a racing winner's reported metric is directly
+  comparable;
+- the default (non-racing) path is untouched: exact summaries carry no
+  racing keys and are byte-identical to pre-racing ones;
+- every candidate's trajectory (rung / budget_spent / pruned_at) lands
+  in the results, and the racer's report accounts for the budget saved;
+- repeated same-shape searches request zero new rung programs
+  (search_compiles, the plan_compiles()-style counter);
+- validate_prepared and validate agree on the same splits for every
+  family across the device, batched-host and sequential paths.
+"""
+import copy
+import unittest.mock as mock
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.models import (GBTClassifier, LinearSVC,
+                                      LogisticRegression)
+from transmogrifai_tpu.selector import (CrossValidation, ModelSelector,
+                                        RacingCrossValidation,
+                                        TrainValidationSplit,
+                                        search_compiles)
+
+
+def _binary(rng, n=300, d=4):
+    X = rng.normal(size=(n, d))
+    y = ((X[:, 0] * 2 - X[:, 1] + rng.logistic(size=n) * 0.5) > 0
+         ).astype(float)
+    return X, y
+
+
+def _pool():
+    return [
+        (LogisticRegression(),
+         [{"reg_param": 0.001}, {"reg_param": 0.01},
+          {"reg_param": 1.0}, {"reg_param": 100.0}]),
+        (LinearSVC(), [{"reg_param": 0.01}, {"reg_param": 10.0}]),
+    ]
+
+
+def _by_key(results):
+    return {(r.model_uid, r.grid_index): r for r in results}
+
+
+class TestRacingExactness:
+    def test_final_rung_metrics_bitwise_match_full_cv(self, rng):
+        """The exactness invariant: survivors of the last rung were
+        evaluated under the SAME folds, masks and metric kernel as
+        exact full CV — their metric vectors match bitwise, and so
+        does the winner."""
+        X, y = _binary(rng)
+        ev = BinaryClassificationEvaluator()
+        exact = CrossValidation(ev, num_folds=3, seed=7)
+        racing = RacingCrossValidation(ev, num_folds=3, seed=7, eta=2,
+                                       min_fidelity=0.25)
+        pool = _pool()
+        best_exact = exact.validate(pool, X, y)
+        best_raced = racing.validate(pool, X, y)
+        assert racing.last_report["raced"] is True
+        exact_by = _by_key(best_exact.results)
+        finalists = [r for r in best_raced.results
+                     if r.pruned_at is None and r.rung is not None]
+        assert finalists
+        for r in finalists:
+            assert r.metric_values == \
+                exact_by[(r.model_uid, r.grid_index)].metric_values
+        # the raced winner is a finalist, so its reported metric IS its
+        # exact full-CV metric — directly comparable to (and here
+        # within noise of) the exhaustive search's winner
+        winner = next(r for r in finalists
+                      if r.model_name == best_raced.name
+                      and r.params == best_raced.params)
+        assert best_raced.metric == \
+            exact_by[(winner.model_uid, winner.grid_index)].mean_metric
+        assert abs(best_raced.metric - best_exact.metric) <= 0.01
+
+    def test_pruned_candidates_spend_less_budget(self, rng):
+        X, y = _binary(rng)
+        ev = BinaryClassificationEvaluator()
+        racing = RacingCrossValidation(ev, num_folds=3, seed=7, eta=2,
+                                       min_fidelity=0.25)
+        racing.validate(_pool(), X, y)
+        rep = racing.last_report
+        assert rep["candidatesTotal"] == 6
+        assert rep["candidatesPruned"] >= 1
+        # successive halving must beat the full-CV budget
+        assert rep["budgetSpentFoldFits"] < rep["budgetFullCvFoldFits"]
+        # rung schedule: ascending budgets ending at full fidelity
+        fractions = [r["budgetFraction"] for r in rep["rungs"]]
+        assert fractions == sorted(fractions)
+        assert fractions[-1] == 1.0
+        assert rep["rungs"][-1]["folds"] == 3
+        assert rep["rungs"][-1]["rowFraction"] == 1.0
+
+    def test_every_candidate_records_trajectory(self, rng):
+        X, y = _binary(rng)
+        ev = BinaryClassificationEvaluator()
+        racing = RacingCrossValidation(ev, num_folds=3, seed=7, eta=2,
+                                       min_fidelity=0.25)
+        best = racing.validate(_pool(), X, y)
+        assert len(best.results) == 6
+        for r in best.results:
+            assert r.rung is not None
+            assert r.budget_spent > 0.0
+            j = r.to_json()
+            assert {"rung", "budgetSpent", "prunedAt"} <= set(j)
+        # a pruned candidate stopped before the final rung
+        pruned = [r for r in best.results if r.pruned_at is not None]
+        finalists = [r for r in best.results if r.pruned_at is None]
+        assert pruned and finalists
+        assert max(r.budget_spent for r in pruned) < \
+            min(r.budget_spent for r in finalists)
+
+    def test_repeated_search_requests_zero_new_programs(self, rng):
+        """Same shapes, second run: the rung-program signature set must
+        not grow (the compile-reuse acceptance gate)."""
+        X, y = _binary(rng)
+        ev = BinaryClassificationEvaluator()
+
+        def run():
+            RacingCrossValidation(ev, num_folds=3, seed=7, eta=2,
+                                  min_fidelity=0.25).validate(
+                _pool(), X, y)
+
+        run()
+        before = search_compiles()
+        run()
+        assert search_compiles() == before
+
+    def test_no_device_metric_falls_back_to_exact(self, rng):
+        """An evaluator without a device metric spec cannot race; the
+        racer degrades to exact full CV with identical results."""
+        X, y = _binary(rng, n=240)
+        ev = copy.copy(BinaryClassificationEvaluator())
+        ev.device_metric_spec = lambda: None
+        exact = CrossValidation(ev, num_folds=3, seed=7)
+        racing = RacingCrossValidation(ev, num_folds=3, seed=7, eta=2)
+        pool = [(LogisticRegression(),
+                 [{"reg_param": 0.01}, {"reg_param": 1.0}])]
+        best_exact = exact.validate(pool, X, y)
+        best_raced = racing.validate(pool, X, y)
+        assert racing.last_report["raced"] is False
+        assert best_raced.params == best_exact.params
+        for a, b in zip(best_raced.results, best_exact.results):
+            assert a.metric_values == b.metric_values
+            assert a.rung is None       # exact records carry no racing
+
+    def test_knob_validation(self):
+        ev = BinaryClassificationEvaluator()
+        with pytest.raises(ValueError, match="eta"):
+            RacingCrossValidation(ev, eta=1)
+        with pytest.raises(ValueError, match="min_fidelity"):
+            RacingCrossValidation(ev, min_fidelity=0.0)
+        with pytest.raises(ValueError, match="min_fidelity"):
+            RacingCrossValidation(ev, min_fidelity=1.5)
+
+    def test_schedule_ends_at_exactly_one(self):
+        ev = BinaryClassificationEvaluator()
+        r = RacingCrossValidation(ev, eta=3)      # default 1/9 ladder
+        assert r._rung_budgets() == [1.0 / 9.0, 1.0 / 3.0, 1.0]
+        r2 = RacingCrossValidation(ev, eta=2, min_fidelity=1.0)
+        assert r2._rung_budgets() == [1.0]
+
+
+class TestSelectorRacingKnob:
+    def test_selector_promotes_cv_to_racing(self, rng):
+        X, y = _binary(rng)
+        ev = BinaryClassificationEvaluator()
+        sel = ModelSelector(
+            models=_pool(),
+            validator=CrossValidation(ev, num_folds=3, seed=7),
+            splitter=None, validation="racing", eta=2,
+            min_fidelity=0.25)
+        assert isinstance(sel.validator, RacingCrossValidation)
+        model = sel.fit_arrays(X, y)
+        summary = model.summary
+        assert summary.racing["raced"] is True
+        assert summary.racing["rungs"]
+        j = summary.to_json()
+        assert j["racing"]["candidatesTotal"] == 6
+        # racing annotations survive the JSON round trip
+        rt = type(summary).from_json(j)
+        assert rt.racing == summary.racing
+        assert any(r.pruned_at is not None
+                   for r in rt.validation_results)
+        # pretty() marks trajectories
+        assert "[finalist]" in summary.pretty()
+        assert "[pruned@rung" in summary.pretty()
+
+    def test_default_selection_is_unchanged(self, rng):
+        """The exact path must stay byte-identical: no racing keys in
+        the summary JSON, no rung annotations in the results."""
+        X, y = _binary(rng)
+        ev = BinaryClassificationEvaluator()
+        sel = ModelSelector(
+            models=_pool(),
+            validator=CrossValidation(ev, num_folds=3, seed=7),
+            splitter=None)
+        summary = sel.fit_arrays(X, y).summary
+        j = summary.to_json()
+        assert "racing" not in j
+        for r in j["validationResults"]:
+            assert "rung" not in r and "prunedAt" not in r
+
+    def test_racing_requires_cross_validation(self):
+        ev = BinaryClassificationEvaluator()
+        with pytest.raises(ValueError, match="racing"):
+            ModelSelector(models=_pool(),
+                          validator=TrainValidationSplit(ev),
+                          validation="racing")
+        with pytest.raises(ValueError, match="validation"):
+            ModelSelector(models=_pool(),
+                          validator=CrossValidation(ev),
+                          validation="bogus")
+
+    def test_racing_validator_passes_through(self):
+        ev = BinaryClassificationEvaluator()
+        rv = RacingCrossValidation(ev, num_folds=3, eta=4)
+        sel = ModelSelector(models=_pool(), validator=rv,
+                            validation="racing")
+        assert sel.validator is rv and sel.validator.eta == 4
+
+
+class TestValidatePreparedParity:
+    """Satellite: same splits => validate and validate_prepared agree
+    for every family on each of the three validation paths."""
+
+    def _folds_of(self, cv, X, y):
+        return [(X[tr], y[tr], X[va], y[va])
+                for tr, va in cv._splits(y)]
+
+    #: per-family parity tolerance. Linear families fit identical
+    #: problems either way (mask weights vs row subsets) and agree to
+    #: float noise. Tree families bin histograms from the matrix they
+    #: are HANDED — the full masked matrix under validate, the fold's
+    #: train subset under validate_prepared — so split thresholds (and
+    #: thus metrics) agree only approximately; the documented protocol
+    #: difference of the workflow-level-CV entry point.
+    _ATOL = {"GBTClassifier": 0.06}
+
+    def _assert_parity(self, cv, pool, X, y):
+        best = cv.validate(pool, X, y)
+        best_prep = cv.validate_prepared(pool, self._folds_of(cv, X, y))
+        assert best_prep.name == best.name
+        assert best_prep.params == best.params
+        prep_by = _by_key(best_prep.results)
+        assert set(prep_by) == set(_by_key(best.results))
+        for r in best.results:
+            np.testing.assert_allclose(
+                prep_by[(r.model_uid, r.grid_index)].metric_values,
+                r.metric_values,
+                atol=self._ATOL.get(r.model_name, 1e-6),
+                err_msg=f"{r.model_name}[{r.grid_index}]")
+
+    def _pool(self):
+        return [
+            (LogisticRegression(),
+             [{"reg_param": 0.01}, {"reg_param": 1.0}]),
+            (LinearSVC(), [{"reg_param": 0.1}]),
+            (GBTClassifier(num_rounds=4, max_depth=2), [{}]),
+        ]
+
+    def test_device_path(self, rng):
+        X, y = _binary(rng, n=240)
+        cv = CrossValidation(BinaryClassificationEvaluator(),
+                             num_folds=3, seed=11)
+        self._assert_parity(cv, self._pool(), X, y)
+
+    def test_batched_host_path(self, rng):
+        X, y = _binary(rng, n=240)
+        ev = copy.copy(BinaryClassificationEvaluator())
+        ev.device_metric_spec = lambda: None
+        cv = CrossValidation(ev, num_folds=3, seed=11)
+        self._assert_parity(cv, self._pool(), X, y)
+
+    def test_sequential_path(self, rng):
+        X, y = _binary(rng, n=240)
+        ev = copy.copy(BinaryClassificationEvaluator())
+        ev.device_metric_spec = lambda: None
+        cv = CrossValidation(ev, num_folds=3, seed=11)
+        pool = self._pool()
+        with mock.patch.object(
+                LogisticRegression, "fit_fold_grid_arrays",
+                side_effect=NotImplementedError), \
+            mock.patch.object(
+                LinearSVC, "fit_fold_grid_arrays",
+                side_effect=NotImplementedError), \
+            mock.patch.object(
+                GBTClassifier, "fit_fold_grid_arrays",
+                side_effect=NotImplementedError):
+            self._assert_parity(cv, pool, X, y)

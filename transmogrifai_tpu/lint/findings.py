@@ -45,6 +45,10 @@ RULES: Dict[str, tuple] = {
                       "jitted function (concrete-shape dependence)"),
     "TX-J06": (ERROR, "serving hot path: per-call jax.jit or a Python "
                       "per-row transform_value loop inside serving code"),
+    "TX-J07": (WARNING, "hyperparameter-grid value flows into a static "
+                        "jit argument or a memoized kernel-builder key "
+                        "inside a fit kernel (G x F programs instead "
+                        "of 1)"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

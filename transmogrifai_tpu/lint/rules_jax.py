@@ -26,6 +26,17 @@ call) are caught here in milliseconds:
   over ``transform_value``, the exact pattern the compiled ScoringPlan
   exists to replace. The J02 per-call-jit patterns report as J06 (error
   severity) there.
+- TX-J07 grid value into a compile key: inside a fit kernel (a function
+  with a ``grid`` parameter / a ``fold_grid`` name), a value derived
+  from the hyperparameter grid passed for a ``static_argnames``
+  parameter of a jitted function, or into an ``lru_cache``'d kernel
+  builder — every grid point then keys a fresh XLA program (G x F
+  compiles instead of 1). Grid values must flow as TRACED vectors
+  (vmapped candidate lanes); only aggregate predicates over the whole
+  grid (``any``/``all``/``len``/...) may shape statics, and the taint
+  tracking deliberately stops at them and at non-trivial calls so the
+  repo's grouped-statics idiom (trees/mlp static shape groups) stays
+  legal.
 
 Scope discipline keeps the rules precise: J01/J04/J05 only fire INSIDE
 functions statically known to be jitted (decorated with ``jax.jit`` or
@@ -55,6 +66,18 @@ _NP_SAFE_CALLS = {"iinfo", "finfo", "dtype"}
 _HOST_METHODS = {"item", "tolist", "block_until_ready", "to_py"}
 
 _F64_NAMES = {"float64", "f64", "double"}
+
+#: calls that REDUCE over the whole grid — their result is one value
+#: per search, not one per grid point, so TX-J07 taint stops there
+#: (``use_l1 = bool(np.any(grid[:, 0] * grid[:, 1] > 0))`` is the
+#: blessed aggregate-static idiom)
+_AGGREGATE_CALLS = {"len", "any", "all", "bool", "max", "min", "sum",
+                    "set", "frozenset"}
+
+#: calls that merely re-wrap a sequence — taint flows THROUGH them
+#: (``for p in list(grid)``, ``for gi, p in enumerate(grid)``)
+_PASSTHROUGH_CALLS = {"list", "tuple", "dict", "enumerate", "zip",
+                      "reversed", "sorted", "iter"}
 
 
 # ---------------------------------------------------------------------------
@@ -236,6 +259,15 @@ class _Visitor(ast.NodeVisitor):
         self.jit_fn_name = ""
         #: module-level registry: jitted fn name -> static argnames
         self.jitted_statics: Dict[str, Set[str]] = {}
+        #: TX-J07: when non-None we are inside a fit-kernel function
+        #: (a ``grid`` parameter / ``fold_grid`` name): names tainted
+        #: by per-grid-point values
+        self.grid_ctx: Optional[Set[str]] = None
+        self.grid_fn_name = ""
+        #: module-level registry: lru_cache'd builder names (the
+        #: memoized jit-builder idiom — their ARGUMENTS are compile
+        #: cache keys)
+        self.memoized_builders: Set[str] = set()
 
     # -- helpers -----------------------------------------------------------
     def add(self, rule: str, node: ast.AST, message: str,
@@ -252,10 +284,76 @@ class _Visitor(ast.NodeVisitor):
             any(self.al.is_lru_cache(d) for d in fn.decorator_list)
             for fn in self.fn_stack)
 
+    # -- TX-J07 grid-taint helpers -----------------------------------------
+    def _is_grid_alias(self, v: ast.AST) -> bool:
+        """Does this VALUE carry per-grid-point taint through a trivial
+        re-wrapping only? Deliberately narrow: taint flows through
+        aliases, subscripts and list()/dict()-style re-wraps, but stops
+        at aggregates and at any non-trivial call — so the repo's
+        grouped-statics idiom (grid -> with_params -> static groups,
+        one compile per GROUP) stays untainted, while ``p["max_depth"]``
+        of a per-point loop is caught."""
+        if self.grid_ctx is None:
+            return False
+        if isinstance(v, ast.Name):
+            return v.id in self.grid_ctx
+        if isinstance(v, ast.Subscript):
+            return self._is_grid_alias(v.value)
+        if isinstance(v, ast.Call):
+            fn = v.func
+            if isinstance(fn, ast.Name) and fn.id in _PASSTHROUGH_CALLS:
+                return any(self._is_grid_alias(a) for a in v.args)
+            return False
+        if isinstance(v, (ast.ListComp, ast.GeneratorExp)):
+            return any(self._is_grid_alias(g.iter) for g in v.generators)
+        if isinstance(v, ast.BoolOp):      # list(grid) or [{}]
+            return any(self._is_grid_alias(x) for x in v.values)
+        return False
+
+    def _mentions_grid(self, node: ast.AST) -> bool:
+        """Does this CALL-SITE expression reference a tainted name —
+        descending through arithmetic and non-aggregate calls, stopping
+        at whole-grid aggregates?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.grid_ctx
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else "")
+            if name in _AGGREGATE_CALLS:
+                return False
+            parts = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(fn, ast.Attribute):
+                parts.append(fn.value)     # p.get(...) taints via p
+            return any(self._mentions_grid(p) for p in parts)
+        return any(self._mentions_grid(c)
+                   for c in ast.iter_child_nodes(node))
+
+    def _taint_targets(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.grid_ctx.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._taint_targets(elt)
+
+    @staticmethod
+    def _is_grid_kernel(node: ast.FunctionDef) -> bool:
+        params = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                  + node.args.kwonlyargs)}
+        return "grid" in params or "fold_grid" in node.name
+
     # -- function defs -----------------------------------------------------
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         statics = _jit_decoration(node, self.al)
         outer_ctx, outer_name = self.jit_ctx, self.jit_fn_name
+        outer_grid, outer_grid_name = self.grid_ctx, self.grid_fn_name
+        if self._is_grid_kernel(node):
+            self.grid_ctx = {"grid"}
+            self.grid_fn_name = node.name
+        elif self.grid_ctx is None:
+            # a nested helper outside any grid kernel resets nothing;
+            # inside one, the enclosing taint set stays visible
+            self.grid_ctx = None
         outer_loops = self.loop_depth
         if statics is not None:
             # a jitted function: params minus statics are traced values
@@ -286,12 +384,17 @@ class _Visitor(ast.NodeVisitor):
         self.fn_stack.pop()
         self.loop_depth = outer_loops
         self.jit_ctx, self.jit_fn_name = outer_ctx, outer_name
+        self.grid_ctx, self.grid_fn_name = outer_grid, outer_grid_name
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
     # -- loops -------------------------------------------------------------
     def visit_For(self, node: ast.For) -> None:
         self._check_serving_row_loop(node)
+        if self.grid_ctx is not None and self._is_grid_alias(node.iter):
+            # `for p in grid:` / `for gi, p in enumerate(grid):` —
+            # the loop variable is one grid point
+            self._taint_targets(node.target)
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
@@ -314,6 +417,28 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_ListComp(self, node: ast.ListComp) -> None:
         self._check_serving_row_loop(node)
+        self._taint_comprehension(node)
+        self.generic_visit(node)
+
+    def _taint_comprehension(self, node) -> None:
+        # `[kern(..., p[k]) for p in grid]` — comprehension targets
+        # carry per-grid-point taint exactly like for-loop targets
+        if self.grid_ctx is None:
+            return
+        for gen in node.generators:
+            if self._is_grid_alias(gen.iter):
+                self._taint_targets(gen.target)
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        self._taint_comprehension(node)
+        self.generic_visit(node)
+
+    def visit_DictComp(self, node: ast.DictComp) -> None:
+        self._taint_comprehension(node)
+        self.generic_visit(node)
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self._taint_comprehension(node)
         self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:
@@ -387,6 +512,40 @@ class _Visitor(ast.NodeVisitor):
                         ERROR,
                         hint="pass a tuple (hashable) instead; static "
                              "args key the compilation cache")
+        # TX-J07: grid values flowing into compile cache keys --------------
+        if self.grid_ctx is not None and isinstance(node.func, ast.Name):
+            callee = node.func.id
+            if callee in self.jitted_statics:
+                statics = self.jitted_statics[callee]
+                for kw in node.keywords:
+                    if kw.arg in statics and self._mentions_grid(kw.value):
+                        self.add(
+                            "TX-J07", node,
+                            f"grid-derived value reaches static "
+                            f"argument {kw.arg!r} of jitted {callee!r} "
+                            f"inside {self.grid_fn_name!r} — one XLA "
+                            f"compile per grid point (G x F programs "
+                            f"instead of 1)",
+                            WARNING,
+                            hint="make the hyperparameter a traced "
+                                 "array and vmap the candidate axis; "
+                                 "only whole-grid aggregates (any/all/"
+                                 "len) may shape statics")
+            if callee in self.memoized_builders:
+                parts = list(node.args) + [kw.value
+                                           for kw in node.keywords]
+                if any(self._mentions_grid(p) for p in parts):
+                    self.add(
+                        "TX-J07", node,
+                        f"grid-derived value keys the memoized kernel "
+                        f"builder {callee!r} inside "
+                        f"{self.grid_fn_name!r} — a fresh jitted "
+                        f"program per grid point (G x F compiles "
+                        f"instead of 1)",
+                        WARNING,
+                        hint="key the builder by family config only; "
+                             "pass grid values as traced vmapped "
+                             "vectors into ONE kernel")
         # TX-J01: host transfers inside jit --------------------------------
         if self.jit_ctx is not None:
             self._check_host_transfer(node)
@@ -484,6 +643,13 @@ class _Visitor(ast.NodeVisitor):
                 and isinstance(node.targets[0], ast.Name):
             self.jitted_statics[node.targets[0].id] = \
                 _static_names_from_call(node.value, None)
+        # TX-J07: per-grid-point taint flows through plain aliasing
+        # (`p = grid[i]`, `depth = p["max_depth"]`, `cfg = dict(p)`) but
+        # stops at aggregates and non-trivial calls (grouped statics)
+        if self.grid_ctx is not None \
+                and self._is_grid_alias(node.value):
+            for target in node.targets:
+                self._taint_targets(target)
         self.generic_visit(node)
 
 
@@ -501,6 +667,10 @@ def _register_module_jits(tree: ast.Module, al: _Aliases,
             statics = _jit_decoration(node, al)
             if statics is not None:
                 visitor.jitted_statics[node.name] = statics
+            if any(al.is_lru_cache(d) for d in node.decorator_list):
+                # memoized kernel builders: their ARGUMENTS key the
+                # compile cache, so grid taint reaching them is TX-J07
+                visitor.memoized_builders.add(node.name)
         elif isinstance(node, ast.Assign) \
                 and isinstance(node.value, ast.Call) \
                 and al.is_jax_jit(node.value.func) \

@@ -29,7 +29,7 @@ from ..types import OPVector, Prediction, RealNN
 
 __all__ = ["Predictor", "PredictionModel", "ClassifierModel",
            "RegressionModel", "check_is_response_values",
-           "FamilyPreconditionError"]
+           "FamilyPreconditionError", "subset_grid"]
 
 
 class FamilyPreconditionError(ValueError):
@@ -58,6 +58,20 @@ def num_classes(y) -> int:
     (binary) — the single definition of the idiom every classifier
     family uses."""
     return max(2, int(np.max(y)) + 1 if len(y) else 2)
+
+
+def subset_grid(grid, cand_idx):
+    """Candidate-subset selection for the racing scheduler
+    (selector/racing.py): ``cand_idx`` is an index vector into ``grid``;
+    the batched fold x grid kernels then evaluate only those candidates
+    — the returned metric matrix column order follows ``cand_idx``.
+    None selects the whole grid. Subsetting happens at the grid-dict
+    level BEFORE hyperparameters become traced vectors, so fidelity
+    stays a dynamic-value/shape change, never a new static."""
+    grid = list(grid) or [{}]
+    if cand_idx is None:
+        return grid
+    return [grid[int(i)] for i in np.asarray(cand_idx).ravel()]
 
 
 def check_fold_classes(y, masks) -> None:

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import (ClassifierModel, FamilyPreconditionError,
-                   Predictor, check_fold_classes, num_classes)
+                   Predictor, check_fold_classes, num_classes,
+                   subset_grid)
 
 __all__ = ["NaiveBayes", "NaiveBayesModel"]
 
@@ -212,7 +213,7 @@ class NaiveBayes(Predictor):
         return models
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search: fused fit + validation metric, (F, G)
         matrix out (candidate grouping mirrors fit_fold_grid_arrays)."""
         if spec[0] not in ("binary", "multiclass"):
@@ -225,7 +226,7 @@ class NaiveBayes(Predictor):
         if spec[0] == "binary" and k != 2:
             raise NotImplementedError(
                 "binary device eval needs binary labels")
-        grid = [dict(p) for p in (list(grid) or [{}])]
+        grid = [dict(p) for p in subset_grid(grid, cand_idx)]
         allowed = {"smoothing", "model_type"}
         for p in grid:
             extra = set(p) - allowed

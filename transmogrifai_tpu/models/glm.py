@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import Predictor, RegressionModel
+from .base import Predictor, RegressionModel, subset_grid
 
 __all__ = ["GeneralizedLinearRegression",
            "GeneralizedLinearRegressionModel"]
@@ -325,7 +325,7 @@ class GeneralizedLinearRegression(Predictor):
         return models
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search: fused IRLS fit + validation metric,
         (F, G) matrix out."""
         from ..parallel.mesh import to_host
@@ -335,7 +335,8 @@ class GeneralizedLinearRegression(Predictor):
         X_j, y_j = jnp.asarray(X), jnp.asarray(y)
         Xv_j = jnp.asarray(np.asarray(X_val, dtype=np.float64))
         yv_j = jnp.asarray(np.asarray(y_val, dtype=np.float64))
-        grid, F, batches = self._batched_groups(grid, masks, mesh)
+        grid, F, batches = self._batched_groups(
+            subset_grid(grid, cand_idx), masks, mesh)
         metric_mat = np.full((F, len(grid)), np.nan)
         for (family, link, fit_int, mi), members, masks_c, regs, vps, \
                 fidx, count in batches:

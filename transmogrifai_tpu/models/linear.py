@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..features.columns import PredictionColumn
-from .base import ClassifierModel, Predictor, RegressionModel
+from .base import ClassifierModel, Predictor, RegressionModel, subset_grid
 from .solvers import design_lipschitz, fista_minimize, lbfgs_minimize
 
 __all__ = ["LogisticRegression", "LogisticRegressionModel",
@@ -324,16 +324,19 @@ class LogisticRegression(Predictor):
                 for row in params]
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search: fit + validation metric for every
         candidate in one program, (F, G) metric matrix out (see
-        parallel/cv.eval_linear_fold_grid). Binary margins."""
+        parallel/cv.eval_linear_fold_grid). Binary margins.
+        ``cand_idx`` (racing rungs) restricts to a candidate subset —
+        the (reg, alpha) vectors stay traced values, so subsetting is a
+        shape change, never a retrace of new statics."""
         if spec[0] != "binary":
             raise NotImplementedError("logistic device eval is binary-only")
         if len(y) and int(np.max(y)) + 1 > 2:
             raise NotImplementedError("batched kernel is binary-only")
         from ..parallel.cv import eval_linear_fold_grid
-        ga = _grid_to_reg_alpha(self, grid)
+        ga = _grid_to_reg_alpha(self, subset_grid(grid, cand_idx))
         return eval_linear_fold_grid(
             "logistic", X, y, masks, ga, X_val, y_val, spec, mesh=mesh,
             fit_intercept=self.fit_intercept,
@@ -415,14 +418,14 @@ class LinearRegression(Predictor):
                 for row in params]
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search (see LogisticRegression); predicted
         values feed the regression metric kernel."""
         if spec[0] != "regression":
             raise NotImplementedError(
                 "linear-regression device eval needs a regression metric")
         from ..parallel.cv import eval_linear_fold_grid
-        ga = _grid_to_reg_alpha(self, grid)
+        ga = _grid_to_reg_alpha(self, subset_grid(grid, cand_idx))
         return eval_linear_fold_grid(
             "squared", X, y, masks, ga, X_val, y_val, spec, mesh=mesh,
             fit_intercept=self.fit_intercept,
@@ -491,13 +494,14 @@ class LinearSVC(Predictor):
                 for row in params]
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search (see LogisticRegression); SVC margins
         rank identically to the host raw-prediction score."""
         if spec[0] != "binary":
             raise NotImplementedError("SVC device eval is binary-only")
         from ..parallel.cv import eval_linear_fold_grid
-        ga = _grid_to_reg_alpha(self, grid, allowed=("reg_param",))
+        ga = _grid_to_reg_alpha(self, subset_grid(grid, cand_idx),
+                                allowed=("reg_param",))
         return eval_linear_fold_grid(
             "svc", X, y, masks, ga, X_val, y_val, spec, mesh=mesh,
             fit_intercept=self.fit_intercept,

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import (ClassifierModel, Predictor,
-                   check_fold_classes, num_classes)
+                   check_fold_classes, num_classes, subset_grid)
 from .solvers import lbfgs_minimize
 
 __all__ = ["MultilayerPerceptronClassifier",
@@ -281,7 +281,7 @@ class MultilayerPerceptronClassifier(Predictor):
         return models
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search: fused fold fit + validation metric,
         (F, G) matrix out (grouping mirrors fit_fold_grid_arrays)."""
         if spec[0] not in ("binary", "multiclass"):
@@ -291,7 +291,7 @@ class MultilayerPerceptronClassifier(Predictor):
         if spec[0] == "binary" and k != 2:
             raise NotImplementedError(
                 "binary device eval needs binary labels")
-        grid = [dict(p) for p in (list(grid) or [{}])]
+        grid = [dict(p) for p in subset_grid(grid, cand_idx)]
         allowed = {"hidden_layers", "max_iter", "tol", "seed"}
         for p in grid:
             extra = set(p) - allowed

@@ -56,7 +56,7 @@ _log = logging.getLogger(__name__)
 
 from ..features.columns import PredictionColumn
 from .base import (ClassifierModel, Predictor, RegressionModel,
-                   check_fold_classes, num_classes)
+                   check_fold_classes, num_classes, subset_grid)
 from ..parallel.mesh import to_host
 
 __all__ = [
@@ -2329,9 +2329,12 @@ class _ForestClassifierBase(Predictor):
         return _forest_fold_grid(self, X, y, masks, grid, mesh, True)
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search: fused fit + validation metric, (F, G)
-        matrix out (see _forest_fold_grid eval_ctx)."""
+        matrix out (see _forest_fold_grid eval_ctx). ``cand_idx``
+        (racing rungs) restricts to a candidate subset — traced
+        hyperparameters stay dynamic lanes; static groups a rung prunes
+        entirely simply stop being compiled."""
         if spec[0] == "binary" and num_classes(y) != 2:
             raise NotImplementedError(
                 "binary device eval needs binary labels")
@@ -2339,7 +2342,8 @@ class _ForestClassifierBase(Predictor):
             raise NotImplementedError(
                 "forest-classifier device eval needs a classification "
                 "metric")
-        return _forest_fold_grid(self, X, y, masks, grid, mesh, True,
+        return _forest_fold_grid(self, X, y, masks,
+                                 subset_grid(grid, cand_idx), mesh, True,
                                  eval_ctx=(X_val, y_val, spec))
 
     def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
@@ -2410,12 +2414,13 @@ class _ForestRegressorBase(Predictor):
         return _forest_fold_grid(self, X, y, masks, grid, mesh, False)
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """See _ForestClassifierBase.eval_fold_grid_arrays."""
         if spec[0] != "regression":
             raise NotImplementedError(
                 "forest-regressor device eval needs a regression metric")
-        return _forest_fold_grid(self, X, y, masks, grid, mesh, False,
+        return _forest_fold_grid(self, X, y, masks,
+                                 subset_grid(grid, cand_idx), mesh, False,
                                  eval_ctx=(X_val, y_val, spec))
 
     def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
@@ -2589,7 +2594,7 @@ class GBTClassifier(Predictor):
         return _gbt_fold_grid(self, X, y, masks, grid, mesh, "logistic")
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident search: fused fit + validation metric, (F, G)
         matrix out (see _gbt_fold_grid eval_ctx)."""
         if spec[0] != "binary":
@@ -2599,8 +2604,9 @@ class GBTClassifier(Predictor):
         if bad.size:
             raise NotImplementedError(
                 "batched GBT kernel requires binary labels {0, 1}")
-        return _gbt_fold_grid(self, X, y, masks, grid, mesh, "logistic",
-                              eval_ctx=(X_val, y_val, spec))
+        return _gbt_fold_grid(self, X, y, masks,
+                              subset_grid(grid, cand_idx), mesh,
+                              "logistic", eval_ctx=(X_val, y_val, spec))
 
     def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
                            ) -> GBTClassifierModel:
@@ -2658,13 +2664,14 @@ class GBTRegressor(Predictor):
         return _gbt_fold_grid(self, X, y, masks, grid, mesh, "squared")
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """See GBTClassifier.eval_fold_grid_arrays."""
         if spec[0] != "regression":
             raise NotImplementedError(
                 "GBT-regressor device eval needs a regression metric")
-        return _gbt_fold_grid(self, X, y, masks, grid, mesh, "squared",
-                              eval_ctx=(X_val, y_val, spec))
+        return _gbt_fold_grid(self, X, y, masks,
+                              subset_grid(grid, cand_idx), mesh,
+                              "squared", eval_ctx=(X_val, y_val, spec))
 
     def fit_arrays_sharded(self, X, y, mesh, axis: str = "data"
                            ) -> GBTRegressorModel:
@@ -2727,20 +2734,22 @@ class XGBoostClassifier(GBTClassifier):
         return _gbt_softmax_fold_grid(self, X, y, masks, grid, mesh, k)
 
     def eval_fold_grid_arrays(self, X, y, masks, grid, X_val, y_val,
-                              spec, mesh=None):
+                              spec, mesh=None, cand_idx=None):
         """Device-resident multiclass search: fused softmax fit +
         metric, (F, G) matrix out (_gbt_softmax_eval_kernel)."""
         k = num_classes(y)
         if k <= 2:
             return GBTClassifier.eval_fold_grid_arrays(
-                self, X, y, masks, grid, X_val, y_val, spec, mesh=mesh)
+                self, X, y, masks, grid, X_val, y_val, spec, mesh=mesh,
+                cand_idx=cand_idx)
         if spec[0] != "multiclass":
             raise NotImplementedError(
                 "softmax-GBT device eval needs a multiclass metric")
         self._check_multiclass_labels(y, k)
         check_fold_classes(y, masks)
-        return _gbt_softmax_fold_grid(self, X, y, masks, grid, mesh, k,
-                                      eval_ctx=(X_val, y_val, spec))
+        return _gbt_softmax_fold_grid(self, X, y, masks,
+                                      subset_grid(grid, cand_idx), mesh,
+                                      k, eval_ctx=(X_val, y_val, spec))
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray):
         k = num_classes(y)

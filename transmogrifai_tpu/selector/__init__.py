@@ -3,6 +3,7 @@ ModelSelector.scala:74 — the north-star TPU-acceleration target)."""
 from .factories import (BinaryClassificationModelSelector,
                         MultiClassificationModelSelector,
                         RegressionModelSelector)
+from .racing import RacingCrossValidation, search_compiles
 from .random_params import RandomParamBuilder
 from .selector import ModelSelector, ModelSelectorSummary, SelectedModel
 from .splitters import (DataBalancer, DataCutter, DataSplitter, Splitter,
@@ -18,4 +19,5 @@ __all__ = [
     "DataCutter",
     "CrossValidation", "TrainValidationSplit", "BestEstimator",
     "ValidationResult", "RandomParamBuilder",
+    "RacingCrossValidation", "search_compiles",
 ]

@@ -100,9 +100,19 @@ class _SelectorFactory:
                               models: Optional[Sequence] = None,
                               model_types_to_use: Optional[Sequence] = None,
                               stratify: bool = False,
+                              validation: str = "exact",
+                              eta: int = 3,
+                              min_fidelity: Optional[float] = None,
                               mesh=None) -> ModelSelector:
         """(reference withCrossValidation:159; ``mesh`` shards the
-        fold x grid candidate axis over chips, parallel/cv.py)"""
+        fold x grid candidate axis over chips, parallel/cv.py).
+
+        ``validation="racing"`` switches the search to multi-fidelity
+        successive halving (docs/selection.md): the candidate pool is
+        screened at low fidelity and only the top ``1/eta`` per rung
+        trains on. The final rung is exact full CV for the survivors;
+        ``min_fidelity`` sets the first rung's budget fraction
+        (default ``1/eta**2``)."""
         ev = evaluator or cls.default_evaluator()
         return ModelSelector(
             models=cls._pool(models, model_types_to_use),
@@ -110,6 +120,7 @@ class _SelectorFactory:
                                       stratify=stratify, mesh=mesh),
             splitter=(splitter if splitter is not None
                       else cls.default_splitter(seed=seed)),
+            validation=validation, eta=eta, min_fidelity=min_fidelity,
             problem_type=cls.problem_type)
 
     @classmethod

@@ -42,9 +42,14 @@ class ModelSelectorSummary:
     train_evaluation: Optional[EvaluationMetrics] = None
     holdout_evaluation: Optional[EvaluationMetrics] = None
     metric_larger_better: bool = True
+    #: multi-fidelity racing telemetry (selector/racing.py
+    #: RacingCrossValidation.last_report): rung schedule, budgets,
+    #: pruned counts. Empty — and absent from the JSON — under exact
+    #: validation, keeping default summaries byte-identical.
+    racing: Dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "validationType": self.validation_type,
             "validationParameters": self.validation_parameters,
             "dataPrepParameters": self.data_prep_parameters,
@@ -73,6 +78,9 @@ class ModelSelectorSummary:
                 or type(self.holdout_evaluation).__name__
                 if self.holdout_evaluation else None),
         }
+        if self.racing:
+            out["racing"] = self.racing
+        return out
 
     @classmethod
     def from_json(cls, d: dict) -> "ModelSelectorSummary":
@@ -101,6 +109,7 @@ class ModelSelectorSummary:
             train_evaluation=metrics("trainEvaluation"),
             holdout_evaluation=metrics("holdoutEvaluation"),
             metric_larger_better=d.get("metricLargerBetter", True),
+            racing=d.get("racing") or {},
         )
 
     def pretty(self) -> str:
@@ -120,8 +129,16 @@ class ModelSelectorSummary:
             return sign * m if np.isfinite(m) else np.inf
 
         for r in sorted(self.validation_results, key=rank):
+            # racing records annotate their trajectory (a pruned
+            # candidate's low-fidelity mean is not comparable to a
+            # full-CV one); exact records render exactly as before
+            racing = ""
+            if r.rung is not None:
+                racing = (f"  [pruned@rung{r.pruned_at}]"
+                          if r.pruned_at is not None
+                          else "  [finalist]")
             lines.append(f"  {r.model_name}[{r.grid_index}] "
-                         f"{r.params} -> {r.mean_metric:.4f}")
+                         f"{r.params} -> {r.mean_metric:.4f}{racing}")
         return "\n".join(lines)
 
 
@@ -173,8 +190,31 @@ class ModelSelector(Predictor):
                  validator: Optional[_ValidatorBase] = None,
                  splitter: Optional[Splitter] = None,
                  problem_type: str = "",
+                 validation: str = "exact",
+                 eta: int = 3,
+                 min_fidelity: Optional[float] = None,
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
+        if validation not in ("exact", "racing"):
+            raise ValueError(
+                f"validation must be 'exact' or 'racing', got "
+                f"{validation!r}")
+        if validation == "racing" and validator is not None:
+            # multi-fidelity successive halving (selector/racing.py):
+            # same folds/seed/evaluator as the exact validator, but
+            # losing candidates stop training early. Opt-in — the
+            # default stays exact full CV with a bit-identical winner.
+            from .racing import RacingCrossValidation
+            if isinstance(validator, RacingCrossValidation):
+                pass
+            elif isinstance(validator, CrossValidation):
+                validator = RacingCrossValidation.from_cross_validation(
+                    validator, eta=eta, min_fidelity=min_fidelity)
+            else:
+                raise ValueError(
+                    "validation='racing' requires a CrossValidation "
+                    "validator (train/validation split has a single "
+                    "fold — nothing to race)")
         self.models = list(models)
         self.validator = validator
         self.splitter = splitter
@@ -257,6 +297,7 @@ class ModelSelector(Predictor):
         summary = ModelSelectorSummary(
             validation_type=type(self.validator).__name__,
             validation_parameters=self.validator.get_params(),
+            racing=dict(getattr(self.validator, "last_report", {}) or {}),
             data_prep_parameters=prep_params,
             data_prep_results=prep_results,
             evaluation_metric=evaluator.default_metric,

@@ -15,12 +15,40 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 _log = logging.getLogger(__name__)
+
+#: per-family dispatch accounting (wall + compile seconds, call count),
+#: accumulated across every search in this process — bench.py reads it
+#: to tell a compile-bound search from a compute-bound one family by
+#: family (the thread is named ``tx-family-<Name>`` while the family's
+#: kernels run, so profiler lanes carry the same attribution)
+_FAMILY_PROFILE: Dict[str, Dict[str, float]] = {}
+
+
+def family_profile() -> List[dict]:
+    """Per-family device-dispatch profile rows, slowest first:
+    ``{"family", "seconds", "compileSeconds", "executeSeconds",
+    "calls"}``. compileSeconds is the XLA trace+lower+compile time
+    observed on the family's dispatch thread (utils/compile_time.py) —
+    a warm process pays only executeSeconds."""
+    return [
+        {"family": k, "seconds": round(v["seconds"], 4),
+         "compileSeconds": round(min(v["compile"], v["seconds"]), 4),
+         "executeSeconds": round(
+             max(0.0, v["seconds"] - v["compile"]), 4),
+         "calls": int(v["calls"])}
+        for k, v in sorted(_FAMILY_PROFILE.items(),
+                           key=lambda kv: -kv[1]["seconds"])]
+
+
+def reset_family_profile() -> None:
+    _FAMILY_PROFILE.clear()
 
 from ..evaluators.base import Evaluator
 from ..models.base import (FamilyPreconditionError,
@@ -45,28 +73,48 @@ def _async_dispatch_bytes(X, masks, X_val_st, y_val_st) -> int:
 @dataclass
 class ValidationResult:
     """Metric record for one (model family, grid point)
-    (reference ValidatedModel, OpValidator.scala:72)."""
+    (reference ValidatedModel, OpValidator.scala:72).
+
+    The racing scheduler (selector/racing.py) annotates each record with
+    its multi-fidelity trajectory: ``rung`` is the highest rung the
+    candidate was evaluated at, ``budget_spent`` the fold-fit
+    equivalents consumed (full CV = num_folds per candidate), and
+    ``pruned_at`` the rung where the racer dropped it (None = survived
+    to the final full-fidelity rung). All three stay None/0 — and OUT of
+    the JSON — under exact validation, so default summaries are
+    byte-identical to pre-racing ones."""
     model_name: str
     model_uid: str
     grid_index: int
     params: Dict
     metric_values: List[float] = field(default_factory=list)
+    rung: Optional[int] = None
+    budget_spent: float = 0.0
+    pruned_at: Optional[int] = None
 
     @property
     def mean_metric(self) -> float:
         return float(np.mean(self.metric_values))
 
     def to_json(self) -> dict:
-        return {"modelName": self.model_name, "modelUID": self.model_uid,
-                "gridIndex": self.grid_index, "params": self.params,
-                "metricValues": [float(v) for v in self.metric_values],
-                "meanMetric": self.mean_metric}
+        out = {"modelName": self.model_name, "modelUID": self.model_uid,
+               "gridIndex": self.grid_index, "params": self.params,
+               "metricValues": [float(v) for v in self.metric_values],
+               "meanMetric": self.mean_metric}
+        if self.rung is not None:
+            out["rung"] = self.rung
+            out["budgetSpent"] = float(self.budget_spent)
+            out["prunedAt"] = self.pruned_at
+        return out
 
     @classmethod
     def from_json(cls, d: dict) -> "ValidationResult":
         return cls(model_name=d["modelName"], model_uid=d["modelUID"],
                    grid_index=d["gridIndex"], params=dict(d["params"]),
-                   metric_values=list(d["metricValues"]))
+                   metric_values=list(d["metricValues"]),
+                   rung=d.get("rung"),
+                   budget_spent=d.get("budgetSpent", 0.0),
+                   pruned_at=d.get("prunedAt"))
 
 
 @dataclass
@@ -141,20 +189,23 @@ class _ValidatorBase:
         return hasattr(estimator, "fit_fold_grid_arrays")
 
     def _try_device_eval(self, estimator, grid, X, y, masks,
-                         X_val_st, y_val_st, spec):
+                         X_val_st, y_val_st, spec, cand_idx=None):
         """(F, G) metric matrix from the family's fused fit+metric
         device kernel, or None to fall through to the host paths.
         This is the device-resident search: candidates' fitted
         parameters never reach the host — only these floats do (the
-        winner is refit from scratch by the selector afterwards)."""
+        winner is refit from scratch by the selector afterwards).
+        ``cand_idx`` (racing rungs) evaluates only that candidate
+        subset: the returned matrix is then (F, len(cand_idx))."""
         if (X_val_st is None or spec is None
                 or not hasattr(estimator, "eval_fold_grid_arrays")
                 or not self._use_batched_kernel(estimator)):
             return None
+        kwargs = {} if cand_idx is None else {"cand_idx": cand_idx}
         try:
             return estimator.eval_fold_grid_arrays(
                 X, y, masks, grid, X_val_st, y_val_st, spec,
-                mesh=self.mesh)
+                mesh=self.mesh, **kwargs)
         except NotImplementedError:
             return None         # grid/labels not traceable -> host path
         except FamilyPreconditionError as e:
@@ -178,17 +229,17 @@ class _ValidatorBase:
                 metric_values=[float(v) for v in mm[:, gi]])
             for gi, params in enumerate(grid)]
 
-    # -- main loop (reference getSummary, OpValidator.scala:270-310) -------
-    def validate(self,
-                 models: Sequence[Tuple[Predictor, Sequence[Dict]]],
-                 X: np.ndarray, y: np.ndarray) -> BestEstimator:
+    # -- shared fold/array preparation -------------------------------------
+    def _build_fold_arrays(self, X: np.ndarray, y: np.ndarray):
+        """(splits, masks, fold_data, spec, X_val_st, y_val_st) — the
+        arrays every validation strategy (exact and racing) shares.
+        fold_data is materialized ONCE per search; stable array identity
+        also lets the tree family's host-side binning memoize per
+        fold."""
         splits = self._splits(y)
         masks = np.zeros((len(splits), len(y)))
         for f, (train_idx, _) in enumerate(splits):
             masks[f, train_idx] = 1.0
-        # fold arrays materialized ONCE and shared across every family
-        # and grid point — stable array identity also lets the tree
-        # family's host-side binning memoize per fold
         fold_data = [(X[tr], y[tr], X[va], y[va]) for tr, va in splits]
         # stacked validation folds for the device-resident fast path
         # (fold sizes are equal by _assignments construction)
@@ -197,97 +248,158 @@ class _ValidatorBase:
         if spec is not None and len({len(va) for _, va in splits}) == 1:
             X_val_st = np.stack([fd[2] for fd in fold_data])
             y_val_st = np.stack([fd[3] for fd in fold_data])
-        results: List[ValidationResult] = []
-        models = [(est, list(grid) or [{}]) for est, grid in models]
-        # dispatch every family's device kernel BEFORE fetching any
-        # result: each kernel ends in a blocking device->host fetch, so
-        # a sequential loop would stall family B's dispatch on family
-        # A's transfer. Threads overlap host orchestration + transfers
-        # with on-chip compute (the chip still serializes the programs);
-        # JAX tracing/dispatch is thread-safe and the shared binning
-        # memo in models/trees serializes under its own lock.
-        # size guard: concurrent dispatch keeps EVERY family's input
-        # buffers + intermediates resident at once — at search sizes
-        # that's noise, but a huge matrix could push peak HBM past the
-        # chip where the sequential loop (family A freed before B
-        # uploads) would have fit. Beyond the cap, dispatch sequentially.
+        return splits, masks, fold_data, spec, X_val_st, y_val_st
+
+    def _dispatch_device_evals(self, tasks, X, masks, X_val_st, y_val_st,
+                               spec):
+        """Run per-family device-eval thunks, threaded when profitable.
+
+        ``tasks`` is [(family_name, thunk), ...]; returns thunk results
+        in order. Dispatch every family's device kernel BEFORE fetching
+        any result: each kernel ends in a blocking device->host fetch,
+        so a sequential loop would stall family B's dispatch on family
+        A's transfer. Threads overlap host orchestration + transfers
+        with on-chip compute (the chip still serializes the programs);
+        JAX tracing/dispatch is thread-safe and the shared binning memo
+        in models/trees serializes under its own lock.
+        size guard: concurrent dispatch keeps EVERY family's input
+        buffers + intermediates resident at once — at search sizes
+        that's noise, but a huge matrix could push peak HBM past the
+        chip where the sequential loop (family A freed before B
+        uploads) would have fit. Beyond the cap, dispatch sequentially.
+        Workers are capped at os.cpu_count() (more threads than cores
+        only adds GIL churn) and each task renames its worker thread to
+        ``tx-family-<Name>`` so profiler lanes and the compile-time
+        accumulator (utils/compile_time.py) attribute work to a
+        family."""
+        import threading
+
+        from ..utils import compile_time
+        compile_time.install()
+
+        def named(name, thunk):
+            th = threading.current_thread()
+            label = f"tx-family-{name}"
+            prev, th.name = th.name, label
+            t0 = time.perf_counter()
+            c0 = compile_time.compile_seconds_by_thread().get(label, 0.0)
+            try:
+                return thunk()
+            finally:
+                rec = _FAMILY_PROFILE.setdefault(
+                    name, {"seconds": 0.0, "compile": 0.0, "calls": 0})
+                rec["seconds"] += time.perf_counter() - t0
+                rec["compile"] += (compile_time.compile_seconds_by_thread()
+                                   .get(label, 0.0) - c0)
+                rec["calls"] += 1
+                th.name = prev
+
         async_cap = int(os.environ.get("TX_ASYNC_FAMILIES_MAX_BYTES",
                                        256 * 1024 * 1024))
         dispatch_bytes = _async_dispatch_bytes(X, masks, X_val_st,
                                                y_val_st)
-        if (len(models) > 1 and spec is not None
+        if (len(tasks) > 1 and spec is not None
                 and dispatch_bytes <= async_cap
                 and os.environ.get("TX_ASYNC_FAMILIES", "1") != "0"):
             from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=len(models)) as ex:
-                futures = [
-                    ex.submit(self._try_device_eval, est, grid, X, y,
-                              masks, X_val_st, y_val_st, spec)
-                    for est, grid in models]
-                device_mm = [f.result() for f in futures]
-        else:
-            device_mm = [self._try_device_eval(est, grid, X, y, masks,
-                                               X_val_st, y_val_st, spec)
-                         for est, grid in models]
+            workers = min(len(tasks), os.cpu_count() or 1)
+            with ThreadPoolExecutor(max_workers=workers,
+                                    thread_name_prefix="tx-family") as ex:
+                futures = [ex.submit(named, name, thunk)
+                           for name, thunk in tasks]
+                return [f.result() for f in futures]
+        return [named(name, thunk) for name, thunk in tasks]
+
+    def _device_matrices(self, models, X, y, masks, X_val_st, y_val_st,
+                         spec):
+        """Per-family (F, G) device metric matrices (None entries fall
+        through to the host paths)."""
+        tasks = [
+            (type(est).__name__,
+             (lambda e=est, g=grid: self._try_device_eval(
+                 e, g, X, y, masks, X_val_st, y_val_st, spec)))
+            for est, grid in models]
+        return self._dispatch_device_evals(tasks, X, masks, X_val_st,
+                                           y_val_st, spec)
+
+    def _family_host_results(self, estimator, grid, X, y, masks,
+                             fold_data) -> List[ValidationResult]:
+        """Host evaluation of one family: batched fold x grid kernel when
+        available, per-candidate sequential fits otherwise."""
+        results: List[ValidationResult] = []
+        # fast path: families exposing a fold x grid kernel train all
+        # candidates in ONE batched XLA program (mesh-sharded when
+        # self.mesh is set) instead of len(grid) x folds fits
+        fitted = None
+        if self._use_batched_kernel(estimator):
+            try:
+                fitted = estimator.fit_fold_grid_arrays(
+                    X, y, masks, grid, mesh=self.mesh)
+            except NotImplementedError:
+                fitted = None   # grid not traceable -> sequential
+            except FamilyPreconditionError as e:
+                # family precondition violated (e.g. NaiveBayes on
+                # negative features): the sequential path raises it
+                # per fold below, dropping the family out of the
+                # race with NaN metrics instead of failing the search
+                _log.warning("batched kernel for %s rejected the "
+                             "data: %s", type(estimator).__name__, e)
+                fitted = None
+        # batched evaluation: all tree-family candidates of a fold
+        # predict in ONE device program (others fall through to the
+        # per-candidate path)
+        fold_raw = ([_batched_fold_raw(fitted[f], fold_data[f][2])
+                     for f in range(len(fold_data))]
+                    if fitted is not None else None)
+        for gi, params in enumerate(grid):
+            candidate = (None if fitted is not None
+                         else estimator.with_params(**params))
+            res = ValidationResult(
+                model_name=type(estimator).__name__,
+                model_uid=estimator.uid, grid_index=gi,
+                params=dict(params))
+            for f, (X_tr, y_tr, X_val, y_val) in enumerate(fold_data):
+                try:
+                    if fitted is not None:
+                        model: PredictionModel = fitted[f][gi]
+                        raw = fold_raw[f].get(gi)
+                        pred = (model.prediction_from_raw(raw)
+                                if raw is not None
+                                else model.predict_arrays(X_val))
+                    else:
+                        model = candidate.fit_arrays(X_tr, y_tr)
+                        pred = model.predict_arrays(X_val)
+                    metrics = self.evaluator.evaluate_arrays(
+                        y_val, pred)
+                    res.metric_values.append(
+                        self.evaluator.metric_from(metrics))
+                except (ValueError, FloatingPointError) as e:
+                    # a family whose preconditions the data violates
+                    # (e.g. NaiveBayes on negative features) drops out
+                    # of the race instead of failing the whole search
+                    _log.warning("candidate %s%s failed on a fold: %s",
+                                 res.model_name, params, e)
+                    res.metric_values.append(float("nan"))
+            results.append(res)
+        return results
+
+    # -- main loop (reference getSummary, OpValidator.scala:270-310) -------
+    def validate(self,
+                 models: Sequence[Tuple[Predictor, Sequence[Dict]]],
+                 X: np.ndarray, y: np.ndarray) -> BestEstimator:
+        _, masks, fold_data, spec, X_val_st, y_val_st = \
+            self._build_fold_arrays(X, y)
+        results: List[ValidationResult] = []
+        models = [(est, list(grid) or [{}]) for est, grid in models]
+        device_mm = self._device_matrices(models, X, y, masks, X_val_st,
+                                          y_val_st, spec)
         for (estimator, grid), mm in zip(models, device_mm):
             if mm is not None:
                 results.extend(self._results_from_matrix(
                     estimator, grid, mm))
                 continue
-            # fast path: families exposing a fold x grid kernel train all
-            # candidates in ONE batched XLA program (mesh-sharded when
-            # self.mesh is set) instead of len(grid) x folds fits
-            fitted = None
-            if self._use_batched_kernel(estimator):
-                try:
-                    fitted = estimator.fit_fold_grid_arrays(
-                        X, y, masks, grid, mesh=self.mesh)
-                except NotImplementedError:
-                    fitted = None   # grid not traceable -> sequential
-                except FamilyPreconditionError as e:
-                    # family precondition violated (e.g. NaiveBayes on
-                    # negative features): the sequential path raises it
-                    # per fold below, dropping the family out of the
-                    # race with NaN metrics instead of failing the search
-                    _log.warning("batched kernel for %s rejected the "
-                                 "data: %s", type(estimator).__name__, e)
-                    fitted = None
-            # batched evaluation: all tree-family candidates of a fold
-            # predict in ONE device program (others fall through to the
-            # per-candidate path)
-            fold_raw = ([_batched_fold_raw(fitted[f], fold_data[f][2])
-                         for f in range(len(fold_data))]
-                        if fitted is not None else None)
-            for gi, params in enumerate(grid):
-                candidate = (None if fitted is not None
-                             else estimator.with_params(**params))
-                res = ValidationResult(
-                    model_name=type(estimator).__name__,
-                    model_uid=estimator.uid, grid_index=gi,
-                    params=dict(params))
-                for f, (X_tr, y_tr, X_val, y_val) in enumerate(fold_data):
-                    try:
-                        if fitted is not None:
-                            model: PredictionModel = fitted[f][gi]
-                            raw = fold_raw[f].get(gi)
-                            pred = (model.prediction_from_raw(raw)
-                                    if raw is not None
-                                    else model.predict_arrays(X_val))
-                        else:
-                            model = candidate.fit_arrays(X_tr, y_tr)
-                            pred = model.predict_arrays(X_val)
-                        metrics = self.evaluator.evaluate_arrays(
-                            y_val, pred)
-                        res.metric_values.append(
-                            self.evaluator.metric_from(metrics))
-                    except (ValueError, FloatingPointError) as e:
-                        # a family whose preconditions the data violates
-                        # (e.g. NaiveBayes on negative features) drops out
-                        # of the race instead of failing the whole search
-                        _log.warning("candidate %s%s failed on a fold: %s",
-                                     res.model_name, params, e)
-                        res.metric_values.append(float("nan"))
-                results.append(res)
+            results.extend(self._family_host_results(
+                estimator, grid, X, y, masks, fold_data))
 
         return self._pick_best(models, results)
 
@@ -369,10 +481,16 @@ class _ValidatorBase:
                 results.append(res)
         return self._pick_best(models, results)
 
-    def _pick_best(self, models, results: List[ValidationResult]
+    def _pick_best(self, models, results: List[ValidationResult],
+                   rank_pool: Optional[List[ValidationResult]] = None
                    ) -> BestEstimator:
+        """Winner among ``rank_pool`` (default: all results). Racing
+        passes only full-fidelity finalists — a pruned candidate's
+        low-fidelity metric is not comparable to a full-CV one — while
+        every record still lands in ``BestEstimator.results``."""
         sign = 1.0 if self.evaluator.is_larger_better else -1.0
-        finite = [r for r in results if np.isfinite(r.mean_metric)]
+        pool = results if rank_pool is None else rank_pool
+        finite = [r for r in pool if np.isfinite(r.mean_metric)]
         if not finite:
             raise ValueError(
                 "all validation metrics are non-finite; cannot select a "

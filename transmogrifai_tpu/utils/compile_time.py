@@ -1,0 +1,74 @@
+"""Process-wide XLA compile-time accounting via ``jax.monitoring``.
+
+CPU benchmark runs are frequently COMPILE-bound (tracing + XLA
+compilation dominates the wall clock) while accelerator runs are
+compute-bound — a single per-stage wall-time number cannot tell the two
+apart. JAX publishes internal event durations (``.../backend_compile``
+and friends) through ``jax.monitoring``; this module installs one
+listener that accumulates them
+
+- globally (``compile_seconds()``), snapshotted around each workflow
+  stage so ``StageMetric.compile_seconds`` splits first-call compile
+  time from steady-state execute time, and
+- per thread NAME (``compile_seconds_by_thread()``): the validator
+  renames its dispatch workers ``tx-family-<Name>``
+  (selector/validator.py), so a model-selection search attributes its
+  compile bill family by family.
+
+Installation is lazy and idempotent; on a JAX without the monitoring
+API everything degrades to zeros (callers must treat 0.0 as "unknown",
+not "free").
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+__all__ = ["install", "compile_seconds", "compile_seconds_by_thread"]
+
+_LOCK = threading.Lock()
+_TOTAL = {"seconds": 0.0}
+_BY_THREAD: Dict[str, float] = defaultdict(float)
+_STATE = {"installed": False, "available": False}
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    # '/jax/core/compile/backend_compile_duration' and the pjit
+    # trace/lower events all carry 'compile' or 'trace' in the key;
+    # anything else (transfer, execution) is not compile cost
+    if "compile" not in event and "trace" not in event and \
+            "lower" not in event:
+        return
+    with _LOCK:
+        _TOTAL["seconds"] += duration
+        _BY_THREAD[threading.current_thread().name] += duration
+
+
+def install() -> bool:
+    """Register the listener once; True when the monitoring API exists."""
+    if _STATE["installed"]:
+        return _STATE["available"]
+    _STATE["installed"] = True
+    try:
+        import jax.monitoring as monitoring
+        monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _STATE["available"] = True
+    except Exception:  # pragma: no cover - older jax without the API
+        _STATE["available"] = False
+    return _STATE["available"]
+
+
+def compile_seconds() -> float:
+    """Total compile/trace seconds observed so far in this process."""
+    with _LOCK:
+        return _TOTAL["seconds"]
+
+
+def compile_seconds_by_thread(prefix: str = "") -> Dict[str, float]:
+    """Snapshot of compile seconds keyed by the OBSERVING thread's name
+    at event time (filtered to names starting with ``prefix``)."""
+    with _LOCK:
+        return {k: v for k, v in _BY_THREAD.items()
+                if k.startswith(prefix)}

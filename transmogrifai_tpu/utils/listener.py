@@ -22,16 +22,29 @@ __all__ = ["StageMetric", "AppMetrics", "WorkflowListener"]
 
 @dataclass
 class StageMetric:
-    """(reference StageMetrics, OpSparkListener.scala:164)"""
+    """(reference StageMetrics, OpSparkListener.scala:164)
+
+    ``compile_seconds`` is the XLA trace+lower+compile time observed
+    while the stage ran (utils/compile_time.py) — first-call cost that
+    a warm process never pays again. ``execute_seconds`` is the
+    steady-state remainder; a compile-bound CPU run and a compute-bound
+    accelerator run are indistinguishable without the split."""
     stage_name: str
     stage_uid: str
     phase: str             # "fit" | "transform"
     seconds: float
     n_rows: int
+    compile_seconds: float = 0.0
+
+    @property
+    def execute_seconds(self) -> float:
+        return max(0.0, self.seconds - self.compile_seconds)
 
     def to_json(self) -> dict:
         return {"stageName": self.stage_name, "stageUid": self.stage_uid,
                 "phase": self.phase, "seconds": round(self.seconds, 6),
+                "compileSeconds": round(self.compile_seconds, 6),
+                "executeSeconds": round(self.execute_seconds, 6),
                 "nRows": self.n_rows}
 
 
@@ -67,8 +80,10 @@ class AppMetrics:
             rows = rows[:top]
         total = sum(m.seconds for m in self.stage_metrics) or 1.0
         t = Table(
-            ["stage", "phase", "seconds", "% of total", "rows"],
+            ["stage", "phase", "seconds", "compile", "execute",
+             "% of total", "rows"],
             [[m.stage_name, m.phase, f"{m.seconds:.3f}",
+              f"{m.compile_seconds:.3f}", f"{m.execute_seconds:.3f}",
               f"{100.0 * m.seconds / total:.1f}%", m.n_rows]
              for m in rows],
             name=f"Stage profile ({self.app_name}, "
@@ -89,9 +104,11 @@ class WorkflowListener:
         self._end_handlers: List[Callable[[AppMetrics], None]] = []
 
     def on_stage_completed(self, stage, phase: str, seconds: float,
-                           n_rows: int) -> None:
+                           n_rows: int,
+                           compile_seconds: float = 0.0) -> None:
         m = StageMetric(stage_name=stage.stage_name(), stage_uid=stage.uid,
-                        phase=phase, seconds=seconds, n_rows=n_rows)
+                        phase=phase, seconds=seconds, n_rows=n_rows,
+                        compile_seconds=min(compile_seconds, seconds))
         if self.collect_stage_metrics:
             self.metrics.stage_metrics.append(m)
         if self.log_stage_metrics:

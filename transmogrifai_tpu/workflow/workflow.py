@@ -125,13 +125,20 @@ def _fit_and_transform_layers(
     are not fitted twice."""
     import time as _time
     fitted: Dict[str, PipelineStage] = {}
+    if listener is not None:
+        # per-stage compile/execute split (utils/compile_time.py);
+        # no-op zeros on a jax without the monitoring API
+        from ..utils import compile_time
+        compile_time.install()
 
     def timed(stage, phase, fn):
         t0 = _time.perf_counter()
+        c0 = compile_time.compile_seconds() if listener is not None else 0.0
         result = fn()
         if listener is not None:
             listener.on_stage_completed(
-                stage, phase, _time.perf_counter() - t0, ds.n_rows)
+                stage, phase, _time.perf_counter() - t0, ds.n_rows,
+                compile_seconds=compile_time.compile_seconds() - c0)
         return result
 
     for layer in layers:
