@@ -246,11 +246,129 @@ def _measure_racing() -> dict:
     }
 
 
+def _measure_faults() -> dict:
+    """TX_BENCH_MODE=faults: fault-tolerance telemetry (ISSUE 4). Three
+    deterministic drills on one small synthetic search (runtime/faults
+    .py injector): (a) a transient preemption at first dispatch —
+    retried, search unharmed; (b) a persistent OOM in one family —
+    quarantined, survivors win; (c) a kill at a racing rung boundary,
+    then ``resume_from`` — the journal replays completed rungs and the
+    resumed winner is bitwise identical. Emits retries / quarantines /
+    resume-savings."""
+    from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
+                                                   pin_platform_from_env)
+    pin_platform_from_env()
+    enable_compilation_cache()
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    platform = jax.devices()[0].platform
+    from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+    from transmogrifai_tpu.models import LinearSVC, LogisticRegression
+    from transmogrifai_tpu.runtime import (FaultInjector, KillPoint,
+                                           RetryPolicy, telemetry)
+    from transmogrifai_tpu.selector import (CrossValidation,
+                                            RacingCrossValidation)
+
+    rng = np.random.default_rng(7)
+    n = int(os.environ.get("TX_BENCH_FAULT_ROWS", "600"))
+    X = rng.normal(size=(n, 6))
+    y = ((X[:, 0] * 2 - X[:, 1] + rng.logistic(size=n) * 0.5) > 0
+         ).astype(float)
+
+    def pool():
+        return [
+            (LogisticRegression(),
+             [{"reg_param": v} for v in (1e-3, 1e-2, 1e-1, 1.0)]),
+            (LinearSVC(), [{"reg_param": v} for v in (1e-2, 10.0)])]
+
+    ev = BinaryClassificationEvaluator()
+    retry = RetryPolicy(max_attempts=3, base_delay=0.01)
+
+    # (a) transient preemption at first dispatch: retried, no loss
+    telemetry.reset()
+    cv = CrossValidation(ev, num_folds=3, seed=7)
+    cv.retry_policy = retry
+    t0 = time.perf_counter()
+    with FaultInjector.plan(
+            "family:LogisticRegression:dispatch:1=preempt"):
+        best_retry = cv.validate(pool(), X, y)
+    retry_s = time.perf_counter() - t0
+    retries = telemetry.counters().get("retries", 0)
+
+    # (b) persistent OOM in one family: quarantined, survivors win
+    telemetry.reset()
+    cv2 = CrossValidation(ev, num_folds=3, seed=7)
+    cv2.retry_policy = retry
+    with FaultInjector.plan("family:LinearSVC:dispatch:*=oom"):
+        best_quar = cv2.validate(pool(), X, y)
+    quarantines = telemetry.counters().get("quarantines", 0)
+    quarantined = [r.to_json() for r in cv2.last_runtime.quarantined]
+
+    # (c) kill at a racing rung boundary, then resume from the journal
+    ckpt = tempfile.mkdtemp(prefix="tx-bench-journal-")
+    try:
+        racer = RacingCrossValidation(ev, num_folds=3, seed=7, eta=2,
+                                      min_fidelity=0.25)
+        clean = racer.validate(pool(), X, y)
+        r1 = RacingCrossValidation(ev, num_folds=3, seed=7, eta=2,
+                                   min_fidelity=0.25)
+        r1.checkpoint_dir = ckpt
+        killed = False
+        try:
+            with FaultInjector.plan("rung:1:boundary:1=kill"):
+                r1.validate(pool(), X, y)
+        except KillPoint:
+            killed = True
+        telemetry.reset()
+        r2 = RacingCrossValidation(ev, num_folds=3, seed=7, eta=2,
+                                   min_fidelity=0.25)
+        r2.checkpoint_dir = ckpt
+        t0 = time.perf_counter()
+        resumed = r2.validate(pool(), X, y)
+        resume_s = time.perf_counter() - t0
+        counters = telemetry.counters()
+        replayed = counters.get("journal_replayed_entries", 0)
+        dispatched = counters.get("candidate_fold_dispatches", 0)
+        total = replayed + dispatched
+        saved_fraction = replayed / total if total else 0.0
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+
+    return {
+        "metric": "resume_saved_fraction",
+        # headline: fraction of the resumed search's candidate-fold
+        # fits replayed from the journal instead of re-dispatched
+        "value": round(saved_fraction, 4),
+        "unit": "fraction",
+        "vs_baseline": round(saved_fraction, 4),
+        "retries_on_transient": retries,
+        "retry_search_seconds": round(retry_s, 3),
+        "retry_winner": best_retry.name,
+        "quarantines": quarantines,
+        "quarantine_ledger": quarantined,
+        "quarantine_survivor_winner": best_quar.name,
+        "kill_fired": killed,
+        "resume_replayed_fold_fits": replayed,
+        "resume_dispatched_fold_fits": dispatched,
+        "resume_bitwise_winner": bool(
+            resumed.name == clean.name
+            and resumed.params == clean.params
+            and resumed.metric == clean.metric),
+        "resume_search_seconds": round(resume_s, 3),
+        "platform": platform,
+    }
+
+
 def _measure() -> dict:
     if os.environ.get("TX_BENCH_MODE") == "score":
         return _measure_score()
     if os.environ.get("TX_BENCH_MODE") == "racing":
         return _measure_racing()
+    if os.environ.get("TX_BENCH_MODE") == "faults":
+        return _measure_faults()
     from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
                                                    pin_platform_from_env)
     pin_platform_from_env()
@@ -465,6 +583,8 @@ def _headline_metric() -> tuple:
         return "score_rows_per_s", "rows/s"
     if os.environ.get("TX_BENCH_MODE") == "racing":
         return "racing_train_eval_seconds", "s"
+    if os.environ.get("TX_BENCH_MODE") == "faults":
+        return "resume_saved_fraction", "fraction"
     return "titanic_holdout_aupr", "AuPR"
 
 

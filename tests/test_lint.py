@@ -655,3 +655,90 @@ class TestAsyncDispatchGuard:
         masks = np.zeros((3, 10))
         assert _async_dispatch_bytes(X, masks, None, None) == \
             X.nbytes + masks.nbytes
+
+
+class TestR01ExceptionSwallow:
+    """TX-R01: broad excepts in selector/serving hot paths must
+    re-raise, quarantine or record a fallback (docs/lint.md)."""
+
+    SEL = "transmogrifai_tpu/selector/myvalidator.py"
+
+    def _lint(self, code, path=None):
+        return lint_source(textwrap.dedent(code), path or self.SEL)
+
+    def test_swallowing_except_exception_flagged(self):
+        findings = self._lint("""
+            def dispatch(thunk):
+                try:
+                    return thunk()
+                except Exception:
+                    return None
+        """)
+        assert "TX-R01" in _rules(findings)
+        f = [x for x in findings if x.rule_id == "TX-R01"][0]
+        assert f.severity == "error"
+        assert "quarantine" in (f.hint or "")
+
+    def test_bare_except_flagged(self):
+        findings = self._lint("""
+            def dispatch(thunk):
+                try:
+                    return thunk()
+                except:
+                    pass
+        """)
+        assert "TX-R01" in _rules(findings)
+
+    def test_reraise_is_clean(self):
+        findings = self._lint("""
+            def dispatch(thunk):
+                try:
+                    return thunk()
+                except Exception as e:
+                    if classify_error(e) == "bug":
+                        raise
+                    return None
+        """)
+        assert "TX-R01" not in _rules(findings)
+
+    def test_quarantine_routing_is_clean(self):
+        findings = self._lint("""
+            def dispatch(ctx, name, thunk):
+                try:
+                    return thunk()
+                except Exception as e:
+                    ctx.quarantine(name, str(e))
+                    return None
+        """)
+        assert "TX-R01" not in _rules(findings)
+
+    def test_recorded_fallback_is_clean(self):
+        findings = self._lint("""
+            def encode(stage, col):
+                try:
+                    return stage.encode(col)
+                except Exception as e:
+                    reason = _fallback_reason("encode", e)
+                    return reason
+        """, path="transmogrifai_tpu/serving/myplan.py")
+        assert "TX-R01" not in _rules(findings)
+
+    def test_narrow_except_is_clean(self):
+        findings = self._lint("""
+            def dispatch(thunk):
+                try:
+                    return thunk()
+                except (ValueError, FloatingPointError):
+                    return None
+        """)
+        assert "TX-R01" not in _rules(findings)
+
+    def test_outside_hot_paths_is_silent(self):
+        findings = self._lint("""
+            def handler(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass
+        """, path="transmogrifai_tpu/utils/mylistener.py")
+        assert "TX-R01" not in _rules(findings)

@@ -267,3 +267,87 @@ class TestSelectorModelPersistence:
         served = load_score_function(path)(dict(recs[0]))
         assert pred.name in served
         assert served[pred.name]["prediction"] in (0.0, 1.0)
+
+
+class TestAtomicSave:
+    """r4 satellite: save_model stages into a temp dir + os.rename swap,
+    so a crash mid-save never leaves a half-written model; load_model
+    rejects partial dirs with a clear error."""
+
+    def test_kill_mid_save_leaves_no_target(self, trained, tmp_path):
+        from transmogrifai_tpu.runtime import FaultInjector, KillPoint
+        model, _, _ = trained
+        path = str(tmp_path / "fresh")
+        with pytest.raises(KillPoint):
+            with FaultInjector.plan("workflow:save:save:1=kill"):
+                model.save(path)
+        import os
+        assert not os.path.exists(path)
+        # the staging dir is the crash's only trace, and loading it is
+        # refused loudly (op-model.json present, arrays.npz missing)
+        staged = [p for p in os.listdir(str(tmp_path))
+                  if p.startswith("fresh.tmp-save")]
+        assert staged
+        with pytest.raises(ValueError, match="partial|interrupted"):
+            load_model(str(tmp_path / staged[0]))
+
+    def test_kill_mid_overwrite_preserves_old_model(self, trained,
+                                                    tmp_path):
+        from transmogrifai_tpu.runtime import FaultInjector, KillPoint
+        model, _, records = trained
+        path = str(tmp_path / "overwrite")
+        model.save(path)
+        before = load_model(path).score(records)
+        with pytest.raises(KillPoint):
+            with FaultInjector.plan("workflow:save:save:1=kill"):
+                model.save(path)
+        after = load_model(path).score(records)
+        name = model.result_features[0].name
+        np.testing.assert_array_equal(after[name].data, before[name].data)
+
+    def test_resave_over_existing_model_works(self, trained, tmp_path):
+        model, _, records = trained
+        path = str(tmp_path / "resave")
+        model.save(path)
+        model.save(path)          # overwrite via the rename swap
+        import os
+        assert not [p for p in os.listdir(str(tmp_path))
+                    if "tmp-save" in p or "old-save" in p]
+        loaded = load_model(path)
+        name = model.result_features[0].name
+        np.testing.assert_allclose(loaded.score(records)[name].data,
+                                   model.score(records)[name].data,
+                                   atol=1e-12)
+
+    def test_load_rejects_non_model_dir(self, tmp_path):
+        with pytest.raises(ValueError, match="not a saved model"):
+            load_model(str(tmp_path / "missing"))
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(ValueError, match="not a saved model"):
+            load_model(str(empty))
+
+    def test_load_rejects_missing_referenced_arrays(self, trained,
+                                                    tmp_path):
+        import os
+        import shutil
+        model, _, _ = trained
+        path = str(tmp_path / "partial")
+        model.save(path)
+        os.remove(os.path.join(path, "arrays.npz"))
+        with pytest.raises(ValueError, match="partial|interrupted"):
+            load_model(path)
+        shutil.rmtree(path)
+
+    def test_load_rejects_truncated_json(self, trained, tmp_path):
+        import os
+        model, _, _ = trained
+        path = str(tmp_path / "torn")
+        model.save(path)
+        jp = os.path.join(path, "op-model.json")
+        with open(jp) as fh:
+            text = fh.read()
+        with open(jp, "w") as fh:
+            fh.write(text[:len(text) // 2])
+        with pytest.raises(ValueError, match="corrupt|truncated"):
+            load_model(path)

@@ -398,7 +398,18 @@ class TestJoinedAggregateReaders:
 
 class TestDataprepExamples:
     """The reference helloworld dataprep flows reproduce end-to-end
-    (examples/dataprep.py asserts the expected per-key outputs)."""
+    (examples/dataprep.py asserts the expected per-key outputs).
+    Skipped where the reference checkout's CSV fixtures are absent —
+    these flows have no synthetic fallback (cf. examples/titanic)."""
+
+    def setup_method(self):
+        import os as _os
+
+        import pytest
+
+        from examples.dataprep import REF
+        if not _os.path.isdir(REF):
+            pytest.skip(f"reference CSV fixtures not present at {REF}")
 
     def test_joins_and_aggregates(self):
         from examples.dataprep import joins_and_aggregates

@@ -1,0 +1,591 @@
+"""Fault-tolerant training runtime tests (transmogrifai_tpu/runtime/).
+
+The acceptance contracts, in the ISSUE's words:
+
+- kill-at-rung-boundary resume: a search interrupted by an injected
+  fault and resumed via ``resume_from`` picks the BITWISE-identical
+  winner while re-dispatching zero journaled (family, cand, fold)
+  entries (asserted via dispatch counters) — for both
+  ``validation="exact"`` and ``validation="racing"``;
+- single-family OOM quarantine: ``train()`` completes with survivors,
+  the summary names the quarantined family and reason, and default
+  (no-fault) summaries are byte-identical to pre-runtime output;
+- all-families-failed aggregation: one ``AllFamiliesFailedError``
+  naming every family and reason;
+- retry-then-succeed on injected transient errors;
+- deadline-expired hung family;
+- atomic model persistence (crash mid-save never corrupts a model
+  dir; partial dirs are rejected with a clear error).
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LinearSVC, LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.runtime import (AllFamiliesFailedError,
+                                       FaultInjector, KillPoint,
+                                       RetryPolicy, classify_error,
+                                       read_journal)
+from transmogrifai_tpu.runtime import telemetry
+from transmogrifai_tpu.runtime.faults import (InjectedFamilyBug,
+                                              InjectedOom,
+                                              InjectedPreemption)
+from transmogrifai_tpu.runtime.journal import (SearchJournal,
+                                               search_fingerprint)
+from transmogrifai_tpu.selector import (CrossValidation, ModelSelector,
+                                        RacingCrossValidation,
+                                        SelectedModel)
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _binary(seed=42, n=300, d=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    y = ((X[:, 0] * 2 - X[:, 1] + rng.logistic(size=n) * 0.5) > 0
+         ).astype(float)
+    return X, y
+
+
+def _pool():
+    return [
+        (LogisticRegression(),
+         [{"reg_param": 0.001}, {"reg_param": 0.01},
+          {"reg_param": 1.0}]),
+        (LinearSVC(), [{"reg_param": 0.01}, {"reg_param": 10.0}]),
+    ]
+
+
+def _cv(**kw):
+    return CrossValidation(BinaryClassificationEvaluator(),
+                           num_folds=3, seed=7, **kw)
+
+
+def _racing(**kw):
+    return RacingCrossValidation(BinaryClassificationEvaluator(),
+                                 num_folds=3, seed=7, eta=2,
+                                 min_fidelity=0.25, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# classifier + retry + injector units
+# ---------------------------------------------------------------------------
+
+class TestClassifier:
+    def test_transient_shapes(self):
+        assert classify_error(InjectedOom("x")) == "transient"
+        assert classify_error(InjectedPreemption("x")) == "transient"
+        assert classify_error(ConnectionError("reset")) == "transient"
+        assert classify_error(
+            RuntimeError("RESOURCE_EXHAUSTED: oom")) == "transient"
+
+    def test_family_shapes(self):
+        assert classify_error(InjectedFamilyBug("x")) == "family"
+        assert classify_error(MemoryError()) == "family"
+        assert classify_error(FloatingPointError("nan")) == "family"
+        # XlaRuntimeError matched by TYPE NAME, no jaxlib import needed
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        assert classify_error(
+            XlaRuntimeError("INTERNAL: lowering failed")) == "family"
+        assert classify_error(
+            XlaRuntimeError("RESOURCE_EXHAUSTED")) == "transient"
+
+    def test_bugs_propagate(self):
+        assert classify_error(KeyError("oops")) == "bug"
+        assert classify_error(TypeError("bad arg")) == "bug"
+
+
+class TestRetryPolicy:
+    def test_retries_transient_then_succeeds(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise InjectedPreemption("t")
+            return "ok"
+
+        p = RetryPolicy(max_attempts=3, base_delay=0.001)
+        assert p.call(flaky) == "ok"
+        assert calls["n"] == 3
+
+    def test_does_not_retry_bugs(self):
+        p = RetryPolicy(max_attempts=5, base_delay=0.001)
+        calls = {"n": 0}
+
+        def bug():
+            calls["n"] += 1
+            raise KeyError("bug")
+
+        with pytest.raises(KeyError):
+            p.call(bug)
+        assert calls["n"] == 1
+
+    def test_exhausts_and_reraises(self):
+        p = RetryPolicy(max_attempts=2, base_delay=0.001)
+        with pytest.raises(InjectedOom):
+            p.call(lambda: (_ for _ in ()).throw(InjectedOom("t")))
+
+    def test_deterministic_jitter(self):
+        p = RetryPolicy(seed=3)
+        assert p.delay_for(1, "x") == p.delay_for(1, "x")
+        assert p.delay_for(1, "x") != p.delay_for(1, "y")
+
+
+class TestFaultInjector:
+    def test_plan_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            FaultInjector("nonsense")
+        with pytest.raises(ValueError):
+            FaultInjector("family:A:dispatch:0=oom")
+
+    def test_fires_at_exact_nth_occurrence(self):
+        with FaultInjector.plan("family:A:dispatch:2=oom") as inj:
+            assert inj.check("family", "A", "dispatch") is None
+            with pytest.raises(InjectedOom):
+                inj.check("family", "A", "dispatch")
+            assert inj.check("family", "A", "dispatch") is None
+
+    def test_wildcards_and_nan(self):
+        with FaultInjector.plan("family:*:metric:*=nan") as inj:
+            assert inj.check("family", "Z", "metric") == "nan"
+            assert inj.check("family", "Q", "metric") == "nan"
+            assert inj.check("family", "Z", "dispatch") is None
+
+    def test_env_plan_activation(self, monkeypatch):
+        from transmogrifai_tpu.runtime.faults import maybe_inject
+        monkeypatch.setenv("TX_FAULT_PLAN", "family:E:metric:1=nan")
+        assert maybe_inject("family", "E", "metric") == "nan"
+        monkeypatch.delenv("TX_FAULT_PLAN")
+        assert maybe_inject("family", "E", "metric") is None
+
+
+# ---------------------------------------------------------------------------
+# journal units
+# ---------------------------------------------------------------------------
+
+class TestSearchJournal:
+    def test_round_trip_is_bit_exact(self, tmp_path):
+        j = SearchJournal(str(tmp_path)).open("fp1")
+        vals = [[0.1 + 1e-17, float("nan")], [2.0 / 3.0, 0.953267196814]]
+        j.record("0:LR", "rung0", [0, 2], vals, folds=2)
+        j.close()
+        j2 = SearchJournal(str(tmp_path)).open("fp1")
+        got = j2.lookup("0:LR", "rung0", [0, 2])
+        assert got[0][0] == vals[0][0] and np.isnan(got[0][1])
+        assert got[1] == vals[1]
+        # candidate-subset mismatch must NOT replay
+        assert j2.lookup("0:LR", "rung0", [0, 1]) is None
+        j2.close()
+
+    def test_fingerprint_mismatch_rotates_stale(self, tmp_path):
+        j = SearchJournal(str(tmp_path)).open("fp1")
+        j.record("0:LR", "exact", [0], [[1.0]], folds=1)
+        j.close()
+        j2 = SearchJournal(str(tmp_path)).open("fp2")
+        assert j2.lookup("0:LR", "exact", [0]) is None
+        assert os.path.exists(j2.path + ".stale")
+        j2.close()
+
+    def test_torn_tail_is_dropped(self, tmp_path):
+        j = SearchJournal(str(tmp_path)).open("fp1")
+        j.record("0:LR", "exact", [0], [[1.0]], folds=1)
+        j.close()
+        with open(j.path, "a") as fh:
+            fh.write('{"kind": "eval", "family": "1:SVC", "ru')
+        j2 = SearchJournal(str(tmp_path)).open("fp1")
+        assert j2.lookup("0:LR", "exact", [0]) == [[1.0]]
+        assert j2.lookup("1:SVC", "exact", [0]) is None
+        j2.close()
+
+    def test_fingerprint_sensitivity(self):
+        X, y = _binary()
+        pool = _pool()
+        p = {"numFolds": 3, "seed": 7}
+        fp = search_fingerprint(pool, p, X, y)
+        assert fp == search_fingerprint(_pool(), dict(p), X, y)
+        assert fp != search_fingerprint(pool, {"numFolds": 3, "seed": 8},
+                                        X, y)
+        assert fp != search_fingerprint(pool, p, X, 1.0 - y)
+        assert fp != search_fingerprint(pool[:1], p, X, y)
+
+
+# ---------------------------------------------------------------------------
+# quarantine + retry + deadline in the search
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_single_family_oom_quarantine(self):
+        X, y = _binary()
+        cv = _cv()
+        cv.retry_policy = RetryPolicy(max_attempts=2, base_delay=0.001)
+        with FaultInjector.plan("family:LinearSVC:dispatch:*=oom"):
+            best = cv.validate(_pool(), X, y)
+        assert best.name == "LogisticRegression"
+        recs = cv.last_runtime.quarantined
+        assert [r.family for r in recs] == ["LinearSVC"]
+        assert "RESOURCE_EXHAUSTED" in recs[0].reason
+        assert recs[0].retries == 1
+        # the quarantined family contributes NO validation results
+        assert all(r.model_name != "LinearSVC" for r in best.results)
+
+    def test_retry_then_succeed_matches_clean_run(self):
+        X, y = _binary()
+        clean = _cv().validate(_pool(), X, y)
+        telemetry.reset()
+        cv = _cv()
+        cv.retry_policy = RetryPolicy(max_attempts=3, base_delay=0.001)
+        with FaultInjector.plan(
+                "family:LogisticRegression:dispatch:1=preempt"):
+            best = cv.validate(_pool(), X, y)
+        assert telemetry.counters()["retries"] == 1
+        assert cv.last_runtime.quarantined == []
+        assert (best.name, best.params, best.metric) == \
+            (clean.name, clean.params, clean.metric)
+
+    def test_all_families_failed_aggregates(self):
+        X, y = _binary()
+        cv = _cv()
+        cv.retry_policy = RetryPolicy(max_attempts=1)
+        with pytest.raises(AllFamiliesFailedError) as ei:
+            with FaultInjector.plan("family:*:dispatch:*=oom"):
+                cv.validate(_pool(), X, y)
+        assert sorted(r.family for r in ei.value.records) == \
+            ["LinearSVC", "LogisticRegression"]
+        assert "LogisticRegression" in str(ei.value)
+        assert "LinearSVC" in str(ei.value)
+
+    def test_nan_poisoned_metrics_quarantine(self):
+        X, y = _binary()
+        cv = _cv()
+        with FaultInjector.plan("family:LinearSVC:metric:1=nan"):
+            best = cv.validate(_pool(), X, y)
+        assert best.name == "LogisticRegression"
+        recs = cv.last_runtime.quarantined
+        assert recs and recs[0].kind == "metrics"
+        assert "non-finite" in recs[0].reason
+
+    def test_deadline_expired_hung_family(self):
+        X, y = _binary()
+        pool = _pool()
+        _cv().validate(pool, X, y)        # warm the kernels first
+        cv = _cv()
+        cv.family_deadline = 0.6
+        cv.retry_policy = RetryPolicy(max_attempts=1)
+        t0 = time.perf_counter()
+        with FaultInjector.plan("family:LinearSVC:dispatch:*=hang:2"):
+            best = cv.validate(pool, X, y)
+        wall = time.perf_counter() - t0
+        assert best.name == "LogisticRegression"
+        recs = cv.last_runtime.quarantined
+        assert recs and recs[0].kind == "deadline"
+        assert "deadline" in recs[0].reason
+        # the rung barrier was NOT stalled by the 2s hang
+        assert wall < 1.9
+
+    def test_bug_still_propagates(self):
+        """A classified bug must NOT be absorbed into quarantine."""
+        X, y = _binary()
+        cv = _cv()
+        orig = LogisticRegression.eval_fold_grid_arrays
+
+        def broken(self, *a, **k):
+            raise TypeError("genuine kernel bug")
+
+        LogisticRegression.eval_fold_grid_arrays = broken
+        try:
+            with pytest.raises(TypeError, match="genuine kernel bug"):
+                cv.validate(_pool(), X, y)
+        finally:
+            LogisticRegression.eval_fold_grid_arrays = orig
+
+    def test_host_path_fit_fault_quarantines(self):
+        """The 'fit' injection site covers sequential host-path
+        candidate fits (families without batched/device kernels)."""
+
+        class SeqLR(LogisticRegression):
+            # no batched or device kernels: the validator falls to the
+            # per-candidate sequential path through fit_arrays_guarded
+            def fit_fold_grid_arrays(self, *a, **k):
+                raise NotImplementedError
+
+            def eval_fold_grid_arrays(self, *a, **k):
+                raise NotImplementedError
+
+        X, y = _binary()
+        cv = _cv()
+        cv.retry_policy = RetryPolicy(max_attempts=1)
+        pool = [(SeqLR(), [{"reg_param": 0.01}]),
+                (LinearSVC(), [{"reg_param": 0.01}])]
+        with FaultInjector.plan("family:SeqLR:fit:*=oom"):
+            best = cv.validate(pool, X, y)
+        fams = [r.family for r in cv.last_runtime.quarantined]
+        assert fams == ["SeqLR"]
+        assert best.name == "LinearSVC"
+
+
+# ---------------------------------------------------------------------------
+# journal + resume: the kill/resume acceptance gate
+# ---------------------------------------------------------------------------
+
+def _journaled_keys(ckpt):
+    return {(e["family"], e["rung"])
+            for e in read_journal(str(ckpt))["entries"]}
+
+
+class TestKillResume:
+    def test_exact_kill_and_resume_bitwise(self, tmp_path):
+        X, y = _binary()
+        clean = _cv().validate(_pool(), X, y)
+        ckpt = str(tmp_path / "ckpt")
+        cv1 = _cv()
+        cv1.checkpoint_dir = ckpt
+        with pytest.raises(KillPoint):
+            with FaultInjector.plan("family:LinearSVC:dispatch:1=kill"):
+                cv1.validate(_pool(), X, y)
+        journaled = _journaled_keys(ckpt)
+        assert journaled, "the surviving family must be journaled"
+        telemetry.reset()
+        cv2 = _cv()
+        cv2.checkpoint_dir = ckpt
+        resumed = cv2.validate(_pool(), X, y)
+        # bitwise-identical winner AND metric vectors
+        assert (resumed.name, resumed.params) == (clean.name, clean.params)
+        assert resumed.metric == clean.metric
+        by_key = {(r.model_name, r.grid_index): r.metric_values
+                  for r in clean.results}
+        for r in resumed.results:
+            assert r.metric_values == by_key[(r.model_name, r.grid_index)]
+        # zero re-dispatch of journaled (family, cand, fold) entries
+        redispatched = {(k, rung) for k, rung, _, _ in
+                        telemetry.dispatch_log()}
+        assert redispatched.isdisjoint(journaled)
+        assert telemetry.counters()["journal_hits"] >= 1
+
+    def test_racing_kill_at_rung_boundary_and_resume_bitwise(
+            self, tmp_path):
+        X, y = _binary()
+        clean = _racing().validate(_pool(), X, y)
+        ckpt = str(tmp_path / "ckpt")
+        r1 = _racing()
+        r1.checkpoint_dir = ckpt
+        with pytest.raises(KillPoint):
+            with FaultInjector.plan("rung:1:boundary:1=kill"):
+                r1.validate(_pool(), X, y)
+        journaled = _journaled_keys(ckpt)
+        assert all(rung == "rung0" for _, rung in journaled)
+        telemetry.reset()
+        r2 = _racing()
+        r2.checkpoint_dir = ckpt
+        resumed = r2.validate(_pool(), X, y)
+        assert (resumed.name, resumed.params) == (clean.name, clean.params)
+        assert resumed.metric == clean.metric
+        by_key = {(r.model_name, r.grid_index):
+                  (r.metric_values, r.rung, r.pruned_at)
+                  for r in clean.results}
+        for r in resumed.results:
+            vals, rung, pruned = by_key[(r.model_name, r.grid_index)]
+            assert r.metric_values == vals
+            assert (r.rung, r.pruned_at) == (rung, pruned)
+        # rung 0 replayed from the journal, never re-dispatched
+        redispatched = {(k, rung) for k, rung, _, _ in
+                        telemetry.dispatch_log()}
+        assert redispatched.isdisjoint(journaled)
+        assert telemetry.counters()["journal_hits"] >= 2
+
+    def test_completed_journal_resume_dispatches_nothing(self, tmp_path):
+        X, y = _binary()
+        ckpt = str(tmp_path / "ckpt")
+        cv1 = _cv()
+        cv1.checkpoint_dir = ckpt
+        first = cv1.validate(_pool(), X, y)
+        telemetry.reset()
+        cv2 = _cv()
+        cv2.checkpoint_dir = ckpt
+        again = cv2.validate(_pool(), X, y)
+        assert telemetry.dispatch_log() == []
+        assert again.metric == first.metric
+
+    def test_stale_journal_is_not_replayed(self, tmp_path):
+        X, y = _binary()
+        ckpt = str(tmp_path / "ckpt")
+        cv1 = _cv()
+        cv1.checkpoint_dir = ckpt
+        cv1.validate(_pool(), X, y)
+        # a DIFFERENT search (other seed) must not reuse the journal
+        telemetry.reset()
+        cv2 = CrossValidation(BinaryClassificationEvaluator(),
+                              num_folds=3, seed=8)
+        cv2.checkpoint_dir = ckpt
+        cv2.validate(_pool(), X, y)
+        assert telemetry.counters().get("journal_hits", 0) == 0
+        assert telemetry.dispatch_log()
+
+
+# ---------------------------------------------------------------------------
+# workflow-level: train(resume_from=...), summary surfacing
+# ---------------------------------------------------------------------------
+
+def _records(n=150, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for _ in range(n):
+        a, b = rng.normal(), rng.normal()
+        recs.append({"a": float(a), "b": float(b),
+                     "label": float(a * 2 - b + rng.logistic() * 0.5 > 0)})
+    return recs
+
+
+def _workflow(validation="exact", checkpoint_dir=None):
+    a = FeatureBuilder.of("a", Real).extract(
+        lambda r: r.get("a")).as_predictor()
+    b = FeatureBuilder.of("b", Real).extract(
+        lambda r: r.get("b")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    feats = transmogrify([a, b])
+    selector = ModelSelector(
+        models=_pool(), validator=_cv(), validation=validation,
+        eta=2, min_fidelity=0.25, checkpoint_dir=checkpoint_dir,
+        retry_policy=RetryPolicy(max_attempts=2, base_delay=0.001))
+    pred = selector.set_input(label, feats).get_output()
+    return (Workflow().set_result_features(pred)
+            .set_input_records(_records())), pred
+
+
+def _summary(model):
+    for s in model.stages():
+        if isinstance(s, SelectedModel) and s.summary is not None:
+            return s.summary
+    raise AssertionError("no SelectedModel in trained workflow")
+
+
+class TestWorkflowResilience:
+    def test_train_completes_with_survivors_and_names_quarantine(self):
+        wf, _ = _workflow()
+        with FaultInjector.plan("family:LinearSVC:dispatch:*=oom"):
+            model = wf.train()
+        summ = _summary(model)
+        assert summ.best_model_name == "LogisticRegression"
+        assert [q["family"] for q in summ.quarantined] == ["LinearSVC"]
+        assert "RESOURCE_EXHAUSTED" in summ.quarantined[0]["reason"]
+        # quarantine surfaces in the JSON summary, pretty() and
+        # model_insights()
+        assert "quarantined" in summ.to_json()
+        assert "Quarantined families" in summ.pretty()
+        sel = model.model_insights().selected_model
+        assert [q["family"] for q in sel["quarantined"]] == ["LinearSVC"]
+
+    def test_no_fault_summary_byte_identical(self):
+        from transmogrifai_tpu.utils.uid import reset as reset_uids
+        reset_uids(deterministic=True)
+        wf1, _ = _workflow()
+        s1 = json.dumps(_summary(wf1.train()).to_json(), sort_keys=True)
+        reset_uids(deterministic=True)
+        wf2, _ = _workflow()
+        s2 = json.dumps(_summary(wf2.train()).to_json(), sort_keys=True)
+        assert s1 == s2
+        assert '"quarantined"' not in s1
+        assert '"faultEvents"' not in s1
+        # the pre-runtime key set, exactly — no new keys on the
+        # fault-free path
+        assert set(json.loads(s1).keys()) == {
+            "validationType", "validationParameters",
+            "dataPrepParameters", "dataPrepResults", "evaluationMetric",
+            "problemType", "bestModelName", "bestModelUID",
+            "bestModelParams", "bestValidationMetric",
+            "validationResults", "metricLargerBetter", "trainEvaluation",
+            "trainEvaluationClass", "holdoutEvaluation",
+            "holdoutEvaluationClass"}
+
+    @pytest.mark.parametrize("validation", ["exact", "racing"])
+    def test_train_resume_from_bitwise_winner(self, validation, tmp_path):
+        clean_wf, _ = _workflow(validation=validation)
+        clean = _summary(clean_wf.train())
+        ckpt = str(tmp_path / "ckpt")
+        kill = ("family:LinearSVC:dispatch:1=kill" if validation == "exact"
+                else "rung:1:boundary:1=kill")
+        wf1, _ = _workflow(validation=validation, checkpoint_dir=ckpt)
+        with pytest.raises(KillPoint):
+            with FaultInjector.plan(kill):
+                wf1.train()
+        journaled = _journaled_keys(ckpt)
+        assert journaled
+        telemetry.reset()
+        wf2, _ = _workflow(validation=validation)
+        resumed = _summary(wf2.train(resume_from=ckpt))
+        assert resumed.best_model_name == clean.best_model_name
+        assert resumed.best_model_params == clean.best_model_params
+        assert resumed.best_validation_metric == \
+            clean.best_validation_metric
+        by_key = {(r.model_name, r.grid_index): r.metric_values
+                  for r in clean.validation_results}
+        for r in resumed.validation_results:
+            assert r.metric_values == by_key[(r.model_name, r.grid_index)]
+        redispatched = {(k, rung) for k, rung, _, _ in
+                        telemetry.dispatch_log()}
+        assert redispatched.isdisjoint(journaled)
+        assert telemetry.counters()["journal_hits"] >= 1
+
+    def test_resume_from_without_selector_raises(self):
+        a = FeatureBuilder.of("a", Real).extract(
+            lambda r: r.get("a")).as_predictor()
+        b = FeatureBuilder.of("b", Real).extract(
+            lambda r: r.get("b")).as_predictor()
+        label = FeatureBuilder.of("label", RealNN).extract(
+            lambda r: r.get("label")).as_response()
+        feats = transmogrify([a, b])
+        pred = LogisticRegression().set_input(label, feats).get_output()
+        wf = (Workflow().set_result_features(pred)
+              .set_input_records(_records()))
+        with pytest.raises(ValueError, match="resume_from"):
+            wf.train(resume_from="/nonexistent")
+
+    def test_listener_collects_fault_events(self):
+        from transmogrifai_tpu.utils.listener import WorkflowListener
+        wf, _ = _workflow()
+        listener = WorkflowListener()
+        wf.with_listener(listener)
+        with FaultInjector.plan("family:LinearSVC:dispatch:*=oom"):
+            wf.train()
+        kinds = {e["event"] for e in listener.metrics.fault_events}
+        assert "quarantine" in kinds
+        assert "retry" in kinds
+        assert "faultEvents" in listener.metrics.to_json()
+
+
+# ---------------------------------------------------------------------------
+# the tx journal CLI
+# ---------------------------------------------------------------------------
+
+class TestJournalCli:
+    def test_journal_inspection(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main
+        X, y = _binary()
+        ckpt = str(tmp_path / "ckpt")
+        cv = _cv()
+        cv.checkpoint_dir = ckpt
+        cv.validate(_pool(), X, y)
+        assert main(["journal", ckpt]) == 0
+        out = capsys.readouterr().out
+        assert "LogisticRegression" in out and "resume would skip" in out
+        assert main(["journal", ckpt, "--format", "json"]) == 0
+        info = json.loads(capsys.readouterr().out)
+        assert info["resumeSavedFoldFits"] > 0
+
+    def test_journal_missing_dir(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main
+        assert main(["journal", str(tmp_path / "nope")]) == 2

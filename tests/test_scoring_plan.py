@@ -295,3 +295,56 @@ class TestSatelliteFixes:
         # batch path counts through the same counter
         fn.score_batch(records[55:], engine="records")
         assert fn.extract_error_fields["x"] == 14
+
+
+class TestGracefulDegradation:
+    """r4 satellite: a stage kernel that fails to compile is demoted to
+    its host transform_columns fallback (plan.fallbacks() counter +
+    recorded reason) instead of failing the plan build; transient
+    dispatch errors retry."""
+
+    def test_injected_compile_fault_demotes_stage_with_parity(
+            self, family_model):
+        from transmogrifai_tpu.runtime import FaultInjector
+        model, pred = family_model
+        records = _records(64, seed0=100)
+        base = model.score(records, engine="columnar")
+        clean = ScoringPlan(model).compile()
+        n0 = clean.fallbacks()
+        assert n0 == len(clean.coverage.fallback)
+        victim = clean.coverage.lowered[0].split("(")[0]
+        with FaultInjector.plan(f"plan:{victim}:compile:1=bug"):
+            degraded = ScoringPlan(model).compile()
+        assert degraded.fallbacks() == n0 + 1
+        names = [n for n, _ in degraded.coverage.fallback]
+        reasons = [r for _, r in degraded.coverage.fallback]
+        assert any(victim in n for n in names)
+        assert any("injected compile fault" in r for r in reasons)
+        scored = degraded.score(records)
+        np.testing.assert_allclose(scored[pred.name].data,
+                                   base[pred.name].data, atol=1e-9)
+
+    def test_transient_dispatch_error_retries(self, family_model):
+        from transmogrifai_tpu.runtime import FaultInjector, telemetry
+        model, pred = family_model
+        records = _records(32, seed0=100)
+        base = model.score(records, engine="columnar")
+        plan = ScoringPlan(model).compile()
+        telemetry.reset()
+        try:
+            with FaultInjector.plan("plan:*:dispatch:1=preempt"):
+                scored = plan.score(records)
+            assert telemetry.counters()["retries"] == 1
+        finally:
+            telemetry.reset()
+        np.testing.assert_allclose(scored[pred.name].data,
+                                   base[pred.name].data, atol=1e-9)
+
+    def test_persistent_dispatch_error_propagates(self, family_model):
+        from transmogrifai_tpu.runtime import FaultInjector
+        from transmogrifai_tpu.runtime.faults import InjectedFamilyBug
+        model, _ = family_model
+        plan = ScoringPlan(model).compile()
+        with pytest.raises(InjectedFamilyBug):
+            with FaultInjector.plan("plan:*:dispatch:*=bug"):
+                plan.score(_records(8, seed0=100))

@@ -1,0 +1,51 @@
+"""``python -m transmogrifai_tpu.cli journal`` — inspect a search
+checkpoint directory (docs/resilience.md).
+
+The operator's view of a crashed run: which families/rungs the journal
+already holds, the search fingerprint a resume must match, and the
+fold-fit equivalents ``Workflow.train(resume_from=DIR)`` would skip::
+
+    python -m transmogrifai_tpu.cli journal CHECKPOINT_DIR [--format json]
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["add_journal_parser", "run_journal"]
+
+
+def add_journal_parser(sub) -> None:
+    j = sub.add_parser(
+        "journal",
+        help="inspect a search checkpoint (journal entries, "
+             "fingerprint, resume savings)")
+    j.add_argument("checkpoint_dir",
+                   help="directory passed to ModelSelector("
+                        "checkpoint_dir=...) / train(resume_from=...)")
+    j.add_argument("--format", choices=["text", "json"], default="text",
+                   help="output format (default: text)")
+
+
+def run_journal(args) -> int:
+    from ..runtime.journal import read_journal
+    try:
+        info = read_journal(args.checkpoint_dir)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"tx-journal: {e}")
+        return 2
+    if args.format == "json":
+        print(json.dumps(info, indent=1))
+        return 0
+    fp = info.get("fingerprint") or "?"
+    print(f"search journal: {info['path']}")
+    print(f"  schema v{info.get('version')}  fingerprint {fp[:16]}…")
+    print(f"  {len(info['entries'])} completed family evaluation(s) "
+          f"across rungs {', '.join(info['rungs']) or '-'}")
+    for e in sorted(info["entries"],
+                    key=lambda e: (e["rung"], e["family"])):
+        print(f"    {e['family']:<28} {e['rung']:<11} "
+              f"{len(e['cands'])} cand(s) x {e['folds']} fold(s)")
+    print(f"  resume would skip {info['resumeSavedFoldFits']} "
+          f"candidate-fold fit(s): "
+          f"Workflow.train(resume_from={args.checkpoint_dir!r})")
+    return 0

@@ -49,6 +49,11 @@ RULES: Dict[str, tuple] = {
                         "jit argument or a memoized kernel-builder key "
                         "inside a fit kernel (G x F programs instead "
                         "of 1)"),
+    # -- resilience rules (selector/serving hot paths only) ----------------
+    "TX-R01": (ERROR, "except Exception / bare except in a selector or "
+                      "serving hot path swallows XlaRuntimeError "
+                      "without re-raise, quarantine or a recorded "
+                      "fallback"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

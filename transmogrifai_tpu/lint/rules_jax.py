@@ -26,6 +26,12 @@ call) are caught here in milliseconds:
   over ``transform_value``, the exact pattern the compiled ScoringPlan
   exists to replace. The J02 per-call-jit patterns report as J06 (error
   severity) there.
+- TX-R01 swallowed backend error (``selector/`` + ``serving/`` files
+  only): an ``except Exception`` / bare ``except`` whose body neither
+  re-raises nor routes the error through the fault runtime's recovery
+  vocabulary (quarantine / classify_error / a recorded fallback /
+  maybe_inject) — hides XlaRuntimeErrors, silently degrading searches
+  to the slow path (docs/resilience.md).
 - TX-J07 grid value into a compile key: inside a fit kernel (a function
   with a ``grid`` parameter / a ``fold_grid`` name), a value derived
   from the hyperparameter grid passed for a ``static_argnames``
@@ -233,6 +239,54 @@ def _is_serving_path(path: str) -> bool:
     return "serving" in re.split(r"[/\\]", path)
 
 
+def _is_resilience_path(path: str) -> bool:
+    """selector/ and serving/ files get the TX-R01 exception-swallow
+    rule: these are the hot paths where a swallowed XlaRuntimeError
+    silently degrades a whole search/request instead of being retried,
+    quarantined or surfaced."""
+    import re
+    parts = re.split(r"[/\\]", path)
+    return "selector" in parts or "serving" in parts
+
+
+#: a broad except handler is acceptable when its body does one of
+#: these: re-raise, or route the error through the runtime's recovery
+#: vocabulary (quarantine/classify/fallback/inject) so the degradation
+#: is RECORDED rather than swallowed
+_RECOVERY_NAME_PARTS = ("quarantine", "classify", "fallback",
+                        "maybe_inject")
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or ``except Exception`` (possibly in a
+    tuple)."""
+    t = h.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return "Exception" in names
+
+
+def _handler_recovers(h: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, or call into the recovery
+    vocabulary (``quarantine``/``classify_error``/``*fallback*``/
+    ``maybe_inject``)?"""
+    for sub in ast.walk(h):
+        if isinstance(sub, ast.Raise):
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else "")
+            if any(p in name for p in _RECOVERY_NAME_PARTS):
+                return True
+    return False
+
+
 def _calls_transform_value(node: ast.AST) -> bool:
     """Does the subtree call ``<x>.transform_value(...)``?"""
     for sub in ast.walk(node):
@@ -247,6 +301,7 @@ class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, al: _Aliases):
         self.path = path
         self.serving = _is_serving_path(path)
+        self.resilience = _is_resilience_path(path)
         self.al = al
         self.findings: List[LintFinding] = []
         #: stack of enclosing FunctionDefs, innermost last
@@ -439,6 +494,31 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
         self._taint_comprehension(node)
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        # TX-R01: a broad except in a selector/serving hot path that
+        # swallows the error (no re-raise, no quarantine/classify/
+        # fallback routing) hides XlaRuntimeErrors — real kernel bugs
+        # silently degrade every search to the slow path (the exact
+        # defect r4's satellite fixed at selector/validator.py:138)
+        if self.resilience:
+            for h in node.handlers:
+                if _handler_is_broad(h) and not _handler_recovers(h):
+                    what = ("bare except" if h.type is None
+                            else "except Exception")
+                    self.add(
+                        "TX-R01", h,
+                        f"{what} in a selector/serving hot path "
+                        f"swallows backend errors (XlaRuntimeError "
+                        f"included) without re-raise, quarantine or a "
+                        f"recorded fallback",
+                        ERROR,
+                        hint="narrow the except, re-raise classified "
+                             "bugs (runtime.errors.classify_error), or "
+                             "route the family through "
+                             "RuntimeContext.quarantine / a recorded "
+                             "fallback reason")
         self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:

@@ -108,6 +108,18 @@ class Predictor(BinaryEstimator):
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> "PredictionModel":
         raise NotImplementedError
 
+    def fit_arrays_guarded(self, X: np.ndarray, y: np.ndarray
+                           ) -> "PredictionModel":
+        """``fit_arrays`` behind the runtime fault-injection site
+        (runtime/faults.py, scope ``family`` / site ``fit``). The
+        sequential validation paths dispatch candidates through here so
+        host-path fits are deterministically fault-injectable — and
+        hence quarantine-testable — exactly like device dispatches.
+        Free when no injector is active."""
+        from ..runtime.faults import maybe_inject
+        maybe_inject("family", type(self).__name__, "fit")
+        return self.fit_arrays(X, y)
+
     # -- hyperparameter grid support ---------------------------------------
     def with_params(self, **params) -> "Predictor":
         """A copy of this estimator with ctor params overridden — the

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jax_setup import shard_map
 from .base import (ClassifierModel, FamilyPreconditionError,
                    Predictor, check_fold_classes, num_classes,
                    subset_grid)
@@ -127,7 +128,7 @@ def _nb_eval_mesh_kernel(num_classes: int, model_type: str, spec: tuple,
                              num_classes=num_classes,
                              model_type=model_type, spec=spec)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P("models"),
                   P(), P(), P(), P()),
@@ -145,7 +146,7 @@ def _nb_mesh_kernel(num_classes: int, model_type: str, mesh):
                                num_classes=num_classes,
                                model_type=model_type)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P(), P()),
         out_specs=(P("models", None), P("models", None, None)),

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jax_setup import shard_map
 from .base import Predictor, RegressionModel, subset_grid
 
 __all__ = ["GeneralizedLinearRegression",
@@ -194,7 +195,7 @@ def _glm_fit_mesh_kernel(family: str, link: str, max_iter: int,
                 max_iter=max_iter, fit_intercept=fit_intercept)
         )(masks, regs, vps)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P("models"),
                   P(), P(), P()),
@@ -216,7 +217,7 @@ def _glm_eval_mesh_kernel(family: str, link: str, max_iter: int,
             return mfn(yv[fi], _glm_predict(beta, b0, link, Xv[fi]))
         return jax.vmap(one)(masks, regs, vps, fidx)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P("models"),
                   P("models"), P(), P(), P(), P(), P()),

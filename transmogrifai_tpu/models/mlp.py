@@ -22,6 +22,7 @@ import numpy as np
 from .base import (ClassifierModel, Predictor,
                    check_fold_classes, num_classes, subset_grid)
 from .solvers import lbfgs_minimize
+from ..utils.jax_setup import shard_map
 
 __all__ = ["MultilayerPerceptronClassifier",
            "MultilayerPerceptronClassifierModel"]
@@ -195,7 +196,7 @@ def _mlp_eval_mesh_kernel(sizes: Tuple[int, ...], max_iter: int,
                               sizes=sizes, max_iter=max_iter,
                               spec=spec)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P(), P(), P(), P(),
                   P()),
@@ -216,7 +217,7 @@ def _mlp_mesh_kernel(sizes: Tuple[int, ...], max_iter: int, mesh):
         return _mlp_fold_body(X, y, masks, key, sizes=sizes,
                               max_iter=max_iter)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P(), P(), P()),
         out_specs=out_specs, check_vma=False))

@@ -58,6 +58,7 @@ from ..features.columns import PredictionColumn
 from .base import (ClassifierModel, Predictor, RegressionModel,
                    check_fold_classes, num_classes, subset_grid)
 from ..parallel.mesh import to_host
+from ..utils.jax_setup import shard_map
 
 __all__ = [
     "DecisionTreeClassifier", "DecisionTreeRegressor",
@@ -1260,7 +1261,7 @@ def _forest_fg_kernel(statics: tuple, mesh=None):
     from jax.sharding import PartitionSpec as P
     leaves_spec = (P("models", None, None, None) if kind == "cls"
                    else P("models", None, None))
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P("models"),
                   P("models"), P("models")) + (P(),) * 10,
@@ -1286,7 +1287,7 @@ def _gbt_fg_kernel(statics: tuple, mesh=None):
     if mesh is None:
         return jax.jit(batched)
     from jax.sharding import PartitionSpec as P
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None),) + (P("models"),) * 6 + (P(),) * 6,
         out_specs=(P("models", None, None), P("models", None, None),
@@ -1353,7 +1354,7 @@ def _forest_eval_kernel(statics: tuple, spec: tuple, mesh=None):
     if mesh is None:
         return jax.jit(batched)
     from jax.sharding import PartitionSpec as P
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None), P("models"), P("models"),
                   P("models"), P("models"), P("models")) + (P(),) * 12,
@@ -1386,7 +1387,7 @@ def _gbt_eval_kernel(statics: tuple, spec: tuple, mesh=None):
     if mesh is None:
         return jax.jit(batched)
     from jax.sharding import PartitionSpec as P
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None),) + (P("models"),) * 7 + (P(),) * 8,
         out_specs=P("models"), check_vma=False))
@@ -1413,7 +1414,7 @@ def _gbt_softmax_fg_kernel(statics: tuple, mesh=None):
     if mesh is None:
         return jax.jit(batched)
     from jax.sharding import PartitionSpec as P
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None),) + (P("models"),) * 6 + (P(),) * 6,
         out_specs=(P("models", None, None, None),
@@ -1463,7 +1464,7 @@ def _gbt_softmax_eval_kernel(statics: tuple, spec: tuple, mesh=None):
     if mesh is None:
         return jax.jit(batched)
     from jax.sharding import PartitionSpec as P
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         batched, mesh=mesh,
         in_specs=(P("models", None),) + (P("models"),) * 7 + (P(),) * 8,
         out_specs=P("models"), check_vma=False))
@@ -1561,7 +1562,7 @@ def _forest_sharded_kernel(statics: tuple, mesh, axis: str):
 
     # outputs replicate: every shard reaches identical split decisions
     # from the psum'd reductions
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis), P(axis))
         + (P(),) * 10,
@@ -1581,7 +1582,7 @@ def _gbt_sharded_kernel(statics: tuple, mesh, axis: str):
                          hist_mode=hist_mode, axis_name=axis,
                          row_total=row_total)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(axis)) + (P(),) * 9,
         out_specs=(P(), P(), P(), P()), check_vma=False))

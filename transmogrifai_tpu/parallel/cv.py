@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .mesh import to_host
+from ..utils.jax_setup import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.linear import (binary_logistic_core, linear_regression_core,
@@ -249,7 +250,7 @@ def _mesh_eval_kernel(cfg, spec, mesh):
             return _candidate_eval(cfg, spec, params, fi, Xv, yv)
         return jax.vmap(one)(w_loc, r_loc, a_loc, fi_loc)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         shard_body, mesh=mesh,
         in_specs=(P("models", data_ax), P("models"), P("models"),
                   P("models"), P(data_ax, None), P(data_ax), P(), P()),
@@ -299,7 +300,7 @@ def _mesh_kernel(cfg, mesh):
     # invariant; gradient correctness under it comes from the SHARD-LOCAL
     # objective + explicit grad psum in fista_minimize — autodiff never
     # transposes a collective (silently wrong with vma checking off)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         shard_body, mesh=mesh,
         in_specs=(P("models", data_ax), P("models"), P("models"),
                   P(data_ax, None), P(data_ax)),

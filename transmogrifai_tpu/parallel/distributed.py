@@ -47,6 +47,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     """
     try:
         if coordinator_address is not None:
+            try:
+                # XLA:CPU refuses cross-process programs unless a CPU
+                # collectives backend is selected BEFORE bring-up; on
+                # TPU/GPU this knob is inert, so set it unconditionally
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except AttributeError:
+                pass
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes, process_id=process_id)

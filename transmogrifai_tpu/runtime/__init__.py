@@ -1,0 +1,37 @@
+"""Fault-tolerant training runtime (docs/resilience.md).
+
+The Spark reference inherits executor-level fault tolerance for free;
+this package rebuilds the equivalent for the JAX port as four
+composable pieces threaded through the selector, workflow and serving
+paths:
+
+- **journal** — append-only, fsync'd, fingerprint-keyed JSONL of
+  completed family evaluations; ``ModelSelector(checkpoint_dir=...)``
+  writes it and ``Workflow.train(resume_from=...)`` replays it to a
+  bitwise-identical winner with zero re-dispatched work.
+- **errors + retry** — a transient-error classifier (preemption /
+  RESOURCE_EXHAUSTED shapes) and an exponential-backoff
+  ``RetryPolicy`` with deterministic jitter wrapping per-family
+  dispatch and compiled-program dispatch.
+- **context** — per-search ``RuntimeContext`` carrying the quarantine
+  ledger: a family that keeps failing is removed with a recorded
+  reason and the search degrades to survivors, raising one aggregated
+  :class:`AllFamiliesFailedError` only when nothing is left.
+- **faults** — the deterministic fault injector
+  (``TX_FAULT_PLAN="family:GBTClassifier:dispatch:2=oom"``) that makes
+  every recovery path testable.
+"""
+from .context import RuntimeContext
+from .errors import (AllFamiliesFailedError, QuarantineRecord,
+                     classify_error)
+from .faults import (FaultInjector, InjectedFault, KillPoint,
+                     maybe_inject)
+from .journal import (SearchJournal, read_journal, search_fingerprint)
+from .retry import RetryPolicy
+
+__all__ = [
+    "RuntimeContext", "RetryPolicy",
+    "AllFamiliesFailedError", "QuarantineRecord", "classify_error",
+    "FaultInjector", "InjectedFault", "KillPoint", "maybe_inject",
+    "SearchJournal", "read_journal", "search_fingerprint",
+]

@@ -1,0 +1,207 @@
+"""Deterministic fault injection: the test harness for the runtime.
+
+Fault tolerance that is only exercised by real TPU preemptions is
+fault tolerance that has never been tested. This module plants named
+**injection sites** through the training/serving paths (family
+dispatch, host fits, rung boundaries, model save, plan compile/
+dispatch) and fires *planned* faults at exact occurrence counts, so
+every recovery path — retry, quarantine, journal resume, atomic save
+— is provable in a unit test and reproducible byte-for-byte.
+
+A plan is a comma-separated list of rules::
+
+    TX_FAULT_PLAN="family:GBTClassifier:dispatch:2=oom"
+
+with the grammar ``scope:name:site:n=fault``:
+
+- ``scope``  — ``family`` (name = model family class), ``rung``
+  (name = rung index), ``workflow`` (save/load path), ``plan``
+  (serving ScoringPlan; name = stage class).
+- ``name``   — exact match or ``*``.
+- ``site``   — where the probe sits: ``dispatch`` (per-family device
+  eval, once per retry attempt), ``fit`` (host-path candidate fit),
+  ``metric`` (after a family's metric matrix lands), ``boundary``
+  (between racing rungs), ``save``, ``compile``.
+- ``n``      — fire at the Nth matching probe (1-based), or ``*`` for
+  every one.
+- ``fault``  — ``oom`` (RESOURCE_EXHAUSTED-shaped — transient, then
+  quarantined when persistent), ``preempt`` (UNAVAILABLE preemption —
+  transient), ``bug`` (non-transient InjectedFamilyBug), ``kill``
+  (:class:`KillPoint` — simulated process death, a BaseException the
+  quarantine layer deliberately does NOT absorb), ``nan`` (poison the
+  metric matrix), ``hang:<seconds>`` (sleep — the deadline test).
+
+Activate with the context manager (tests) or ``TX_FAULT_PLAN`` (bench,
+reproducing a field failure)::
+
+    with FaultInjector.plan("family:LinearSVC:dispatch:*=oom"):
+        selector.fit_arrays(X, y)
+
+Probes are free when no injector is active (one global ``None``
+check), so production paths keep the instrumentation permanently.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["FaultInjector", "maybe_inject", "KillPoint", "InjectedFault",
+           "InjectedOom", "InjectedPreemption", "InjectedFamilyBug"]
+
+
+class InjectedFault(Exception):
+    """Base for injector-raised exceptions. Messages are shaped so the
+    runtime classifier (runtime/errors.py) triages them exactly like
+    their real-world counterparts."""
+
+
+class InjectedOom(InjectedFault):
+    def __init__(self, site: str = ""):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: out of memory allocating device "
+            f"buffer (injected at {site})")
+
+
+class InjectedPreemption(InjectedFault):
+    def __init__(self, site: str = ""):
+        super().__init__(
+            f"UNAVAILABLE: TPU worker preempted, replica restarting "
+            f"(injected at {site})")
+
+
+class InjectedFamilyBug(InjectedFault):
+    def __init__(self, site: str = ""):
+        super().__init__(f"injected family fault at {site} "
+                         f"(non-transient)")
+
+
+class KillPoint(BaseException):
+    """Simulated process death (VM preempted mid-search, OOM-killer,
+    ctrl-C). A ``BaseException`` on purpose: the quarantine layer's
+    ``except Exception`` must NOT absorb it — the run dies exactly as
+    a real kill would, and only the journal survives."""
+
+    def __init__(self, site: str = ""):
+        super().__init__(f"injected kill point at {site}")
+
+
+@dataclass(frozen=True)
+class _Rule:
+    scope: str
+    name: str        # exact or "*"
+    site: str
+    nth: Optional[int]   # None = every occurrence
+    fault: str           # "oom"|"preempt"|"bug"|"kill"|"nan"|"hang:<s>"
+
+
+def _parse_plan(text: str) -> List[_Rule]:
+    rules: List[_Rule] = []
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        try:
+            spec, fault = part.split("=", 1)
+            scope, name, site, n = spec.split(":")
+        except ValueError:
+            raise ValueError(
+                f"bad fault rule {part!r}: expected "
+                f"'scope:name:site:n=fault' "
+                f"(e.g. 'family:GBTClassifier:dispatch:2=oom')")
+        nth = None if n == "*" else int(n)
+        if nth is not None and nth < 1:
+            raise ValueError(f"bad fault rule {part!r}: n is 1-based")
+        rules.append(_Rule(scope, name, site, nth, fault))
+    return rules
+
+
+class FaultInjector:
+    """Holds a parsed plan + per-(scope, name, site) occurrence
+    counters. Install via the :meth:`plan` context manager or let
+    :func:`maybe_inject` pick up ``TX_FAULT_PLAN`` from the
+    environment."""
+
+    def __init__(self, plan_text: str):
+        self.plan_text = plan_text
+        self.rules = _parse_plan(plan_text)
+        self._counts: Dict[Tuple[str, str, str], int] = {}
+        self._lock = threading.Lock()
+        #: fired (rule, occurrence) log, for assertions in tests
+        self.fired: List[Tuple[_Rule, int]] = []
+
+    # -- installation ------------------------------------------------------
+    @classmethod
+    def plan(cls, plan_text: str) -> "FaultInjector":
+        return cls(plan_text)
+
+    def __enter__(self) -> "FaultInjector":
+        global _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = None
+
+    # -- the probe ---------------------------------------------------------
+    def check(self, scope: str, name: str, site: str) -> Optional[str]:
+        """Count this probe occurrence; fire the first matching rule.
+        Raising faults raise; ``nan`` returns ``"nan"`` for the caller
+        to poison its metrics; ``hang`` sleeps then returns None."""
+        with self._lock:
+            key = (scope, name, site)
+            self._counts[key] = n = self._counts.get(key, 0) + 1
+            rule = next(
+                (r for r in self.rules
+                 if r.scope == scope and r.site == site
+                 and r.name in ("*", name)
+                 and (r.nth is None or r.nth == n)), None)
+            if rule is None:
+                return None
+            self.fired.append((rule, n))
+        where = f"{scope}:{name}:{site}#{n}"
+        _log.warning("fault injector firing %s at %s", rule.fault, where)
+        if rule.fault == "oom":
+            raise InjectedOom(where)
+        if rule.fault == "preempt":
+            raise InjectedPreemption(where)
+        if rule.fault == "bug":
+            raise InjectedFamilyBug(where)
+        if rule.fault == "kill":
+            raise KillPoint(where)
+        if rule.fault == "nan":
+            return "nan"
+        if rule.fault.startswith("hang"):
+            _, _, secs = rule.fault.partition(":")
+            time.sleep(float(secs or "60"))
+            return None
+        raise ValueError(f"unknown fault {rule.fault!r} in plan "
+                         f"{self.plan_text!r}")
+
+
+_ACTIVE: Optional[FaultInjector] = None
+_ENV_CACHE: Tuple[str, Optional[FaultInjector]] = ("", None)
+
+
+def _active() -> Optional[FaultInjector]:
+    if _ACTIVE is not None:
+        return _ACTIVE
+    global _ENV_CACHE
+    text = os.environ.get("TX_FAULT_PLAN", "")
+    if not text:
+        return None
+    if _ENV_CACHE[0] != text:
+        _ENV_CACHE = (text, FaultInjector(text))
+    return _ENV_CACHE[1]
+
+
+def maybe_inject(scope: str, name: str, site: str) -> Optional[str]:
+    """The injection-site probe. No-op (returns None) unless an
+    injector is active and a rule matches this occurrence."""
+    inj = _active()
+    if inj is None:
+        return None
+    return inj.check(scope, name, site)

@@ -1,0 +1,91 @@
+"""Process-wide fault-tolerance telemetry: counters, dispatch log,
+event stream.
+
+The same idiom as ``serving.plan_compiles()`` / ``racing
+.search_compiles()``: module-level accumulators that bench.py and the
+resilience tests read to prove runtime behavior (zero re-dispatch of
+journaled work, retry counts, quarantine counts) rather than infer it
+from timing. ``WorkflowListener`` snapshots the event stream into
+``AppMetrics.fault_events`` so one training run's retries and
+quarantines land next to its stage profile.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Tuple
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["count", "counters", "reset", "note_dispatch", "dispatch_log",
+           "event", "events_mark", "events_since"]
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, int] = {}
+#: every ACTUAL family dispatch of this process:
+#: (family, rung_label, cand_indices, folds) — the unit the resume
+#: acceptance gate asserts over ("zero re-dispatch of journaled
+#: (family, cand, fold) entries")
+_DISPATCH_LOG: List[Tuple[str, str, Tuple[int, ...], int]] = []
+_EVENTS: List[dict] = []
+
+
+def count(name: str, n: int = 1) -> None:
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of all counters (``retries``, ``quarantines``,
+    ``journal_hits``, ``journal_replayed_entries``,
+    ``candidate_fold_dispatches``, ``family_dispatches``, ...)."""
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def note_dispatch(family: str, rung_label: str,
+                  cands: Tuple[int, ...], folds: int) -> None:
+    """Record one REAL family dispatch (journal replays never land
+    here) of ``len(cands) x folds`` candidate-fold evaluations."""
+    with _LOCK:
+        _DISPATCH_LOG.append((family, rung_label, tuple(cands),
+                              int(folds)))
+        _COUNTERS["family_dispatches"] = \
+            _COUNTERS.get("family_dispatches", 0) + 1
+        _COUNTERS["candidate_fold_dispatches"] = \
+            _COUNTERS.get("candidate_fold_dispatches", 0) \
+            + len(cands) * int(folds)
+
+
+def dispatch_log() -> List[Tuple[str, str, Tuple[int, ...], int]]:
+    with _LOCK:
+        return list(_DISPATCH_LOG)
+
+
+def event(event_name: str, **fields) -> None:
+    """Append one fault event (``retry`` / ``quarantine`` /
+    ``journal_resume`` / ``plan_fallback`` / ...) and log it — the
+    runtime degrades LOUDLY, never silently."""
+    rec = {"event": event_name, **fields}
+    with _LOCK:
+        _EVENTS.append(rec)
+    _log.warning("runtime: %s %s", event_name,
+                 " ".join(f"{k}={v}" for k, v in fields.items()))
+
+
+def events_mark() -> int:
+    with _LOCK:
+        return len(_EVENTS)
+
+
+def events_since(mark: int) -> List[dict]:
+    with _LOCK:
+        return [dict(e) for e in _EVENTS[mark:]]
+
+
+def reset() -> None:
+    """Zero every accumulator (tests / bench isolation)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _DISPATCH_LOG.clear()
+        _EVENTS.clear()

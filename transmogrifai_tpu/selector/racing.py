@@ -42,7 +42,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..models.base import Predictor
-from .validator import BestEstimator, CrossValidation, ValidationResult
+from ..runtime import telemetry as _telemetry
+from ..runtime.errors import BUG, classify_error
+from ..runtime.faults import maybe_inject
+from .validator import (_QUARANTINED, BestEstimator, CrossValidation,
+                        ValidationResult)
 
 __all__ = ["RacingCrossValidation", "search_compiles"]
 
@@ -211,13 +215,31 @@ class RacingCrossValidation(CrossValidation):
                 "searchSeconds": round(time.perf_counter() - t0, 3)}
             return best
 
+        ctx = self._begin_runtime(models, X, y)
+        try:
+            return self._validate_raced(models, X, y, masks, fold_data,
+                                        spec, X_val_st, y_val_st,
+                                        budgets, n_total, ctx, t0)
+        finally:
+            ctx.close_journal()
+
+    def _validate_raced(self, models, X, y, masks, fold_data, spec,
+                        X_val_st, y_val_st, budgets, n_total, ctx, t0
+                        ) -> BestEstimator:
+        F = masks.shape[0]
         racers: Dict[Tuple[int, int], _Racer] = {
             (fi, gi): _Racer(fi, gi)
             for fi, (_, grid) in enumerate(models)
             for gi in range(len(grid))}
         host_fams: List[int] = []       # families validated exactly
+        quarantined_fams: set = set()   # families out of the search
         rung_rows: List[Dict] = []
         for r, b in enumerate(budgets):
+            # the rung-boundary kill-point: a simulated preemption here
+            # loses NOTHING — every completed rung below is journaled
+            # (fsync'd), so a resume replays rungs 0..r-1 and dispatches
+            # only from here on (tests/test_resilience.py)
+            maybe_inject("rung", str(r), "boundary")
             final = r == len(budgets) - 1
             folds_r, row_frac = self._fidelity(b, F)
             X_r, y_r = X, y
@@ -260,15 +282,26 @@ class RacingCrossValidation(CrossValidation):
                 _note_rung_programs(type(est).__name__, folds_r,
                                     rung_masks.shape[1], len(alive), spec)
                 tasks.append((
-                    type(est).__name__,
+                    type(est).__name__, self._family_key(fi, est),
+                    tuple(alive),
                     lambda e=est, g=grid, a=alive: self._try_device_eval(
                         e, g, X_r, y_r, rung_masks, Xv_r, yv_r, spec,
                         cand_idx=np.asarray(a, dtype=np.int64))))
             mats = self._dispatch_device_evals(
-                tasks, X_r, rung_masks, Xv_r, yv_r, spec)
+                tasks, X_r, rung_masks, Xv_r, yv_r, spec, ctx=ctx,
+                rung=r, rung_label=f"rung{r}")
             n_evaluated = 0
             for (fi, alive), mm in zip(fam_idx, mats):
                 est, grid = models[fi]
+                if mm is _QUARANTINED:
+                    # the family is out of THIS search entirely: no
+                    # results, no exact fallback — the quarantine
+                    # ledger (ModelSelectorSummary.quarantined) records
+                    # why, and the race continues with survivors
+                    quarantined_fams.add(fi)
+                    for gi in range(len(grid)):
+                        racers[(fi, gi)].alive = False
+                    continue
                 if mm is None:
                     # family can't race (non-traceable grid, labels,
                     # precondition): validate it exactly at full
@@ -308,20 +341,46 @@ class RacingCrossValidation(CrossValidation):
                 "folds": folds_r, "rowFraction": round(row_frac, 6),
                 "candidates": n_evaluated, "promoted": promoted})
         # exact validation for the families that left the race
+        # (journaled under "exact" — a resume replays them too, and a
+        # classified failure here quarantines instead of dying)
         host_results: Dict[int, List[ValidationResult]] = {}
         for fi in host_fams:
             est, grid = models[fi]
-            mm = self._try_device_eval(est, grid, X, y, masks, X_val_st,
-                                       y_val_st, spec)
-            host_results[fi] = (
-                self._results_from_matrix(est, grid, mm)
-                if mm is not None else
-                self._family_host_results(est, grid, X, y, masks,
-                                          fold_data))
+            key = self._family_key(fi, est)
+            cands = tuple(range(len(grid)))
+            cached = ctx.journal_lookup(key, "exact", cands)
+            if cached is not None:
+                host_results[fi] = self._results_from_journal(
+                    est, grid, cached)
+                continue
+            try:
+                mm = self._try_device_eval(est, grid, X, y, masks,
+                                           X_val_st, y_val_st, spec)
+                host_results[fi] = (
+                    self._results_from_matrix(est, grid, mm)
+                    if mm is not None else
+                    self._family_host_results(est, grid, X, y, masks,
+                                              fold_data))
+            except Exception as e:
+                kind = classify_error(e)
+                if kind == BUG:
+                    raise
+                ctx.quarantine(type(est).__name__,
+                               f"{type(e).__name__}: {e}", kind=kind,
+                               error_type=type(e).__name__)
+                quarantined_fams.add(fi)
+                host_results[fi] = []
+                continue
+            _telemetry.note_dispatch(key, "exact", cands, F)
+            ctx.journal_record(
+                key, "exact", cands,
+                [r.metric_values for r in host_results[fi]], F)
         # assemble results in the exact-path family/grid order
         results: List[ValidationResult] = []
         rank_pool: List[ValidationResult] = []
         for fi, (est, grid) in enumerate(models):
+            if fi in quarantined_fams:
+                continue
             if fi in host_fams:
                 results.extend(host_results[fi])
                 # full-fidelity metrics: they compete with finalists
@@ -340,7 +399,8 @@ class RacingCrossValidation(CrossValidation):
                 if rc.pruned_at is None and rc.rung is not None:
                     rank_pool.append(res)
         spent = sum(rc.budget for rc in racers.values()) \
-            + float(sum(len(models[fi][1]) for fi in host_fams)) * F
+            + float(sum(len(models[fi][1]) for fi in host_fams
+                        if fi not in quarantined_fams)) * F
         self.last_report = {
             "raced": True, "eta": self.eta,
             "minFidelity": self.min_fidelity, "rungs": rung_rows,
@@ -350,4 +410,5 @@ class RacingCrossValidation(CrossValidation):
             "budgetSpentFoldFits": round(spent, 3),
             "budgetFullCvFoldFits": float(n_total * F),
             "searchSeconds": round(time.perf_counter() - t0, 3)}
-        return self._pick_best(models, results, rank_pool=rank_pool)
+        return self._pick_best(models, results, rank_pool=rank_pool,
+                               ctx=ctx)

@@ -47,6 +47,12 @@ class ModelSelectorSummary:
     #: pruned counts. Empty — and absent from the JSON — under exact
     #: validation, keeping default summaries byte-identical.
     racing: Dict = field(default_factory=dict)
+    #: quarantine ledger (runtime/errors.QuarantineRecord.to_json rows):
+    #: families removed from this search and why (OOM, XlaRuntimeError,
+    #: poisoned metrics, deadline). Empty — and absent from the JSON —
+    #: on a fault-free search, keeping default summaries byte-identical
+    #: to pre-runtime output.
+    quarantined: List[Dict] = field(default_factory=list)
 
     def to_json(self) -> dict:
         out = {
@@ -80,6 +86,8 @@ class ModelSelectorSummary:
         }
         if self.racing:
             out["racing"] = self.racing
+        if self.quarantined:
+            out["quarantined"] = self.quarantined
         return out
 
     @classmethod
@@ -110,6 +118,7 @@ class ModelSelectorSummary:
             holdout_evaluation=metrics("holdoutEvaluation"),
             metric_larger_better=d.get("metricLargerBetter", True),
             racing=d.get("racing") or {},
+            quarantined=d.get("quarantined") or [],
         )
 
     def pretty(self) -> str:
@@ -139,6 +148,14 @@ class ModelSelectorSummary:
                           else "  [finalist]")
             lines.append(f"  {r.model_name}[{r.grid_index}] "
                          f"{r.params} -> {r.mean_metric:.4f}{racing}")
+        if self.quarantined:
+            lines.append("Quarantined families (search degraded to "
+                         "survivors; docs/resilience.md):")
+            for q in self.quarantined:
+                retries = (f" after {q.get('retries')} retries"
+                           if q.get("retries") else "")
+                lines.append(f"  {q.get('family')}: [{q.get('kind')}] "
+                             f"{q.get('reason')}{retries}")
         return "\n".join(lines)
 
 
@@ -193,6 +210,9 @@ class ModelSelector(Predictor):
                  validation: str = "exact",
                  eta: int = 3,
                  min_fidelity: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 retry_policy=None,
+                 family_deadline: Optional[float] = None,
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
         if validation not in ("exact", "racing"):
@@ -219,6 +239,17 @@ class ModelSelector(Predictor):
         self.validator = validator
         self.splitter = splitter
         self.problem_type = problem_type
+        #: fault-tolerant runtime knobs (runtime/, docs/resilience.md):
+        #: journal completed (family, cands, rung) evaluations under
+        #: this directory so an interrupted search resumes via
+        #: ``Workflow.train(resume_from=...)`` with zero re-dispatch
+        self.checkpoint_dir = checkpoint_dir
+        #: RetryPolicy for transient (preemption/RESOURCE_EXHAUSTED-
+        #: shaped) dispatch failures; None = TX_RETRY_* env defaults
+        self.retry_policy = retry_policy
+        #: per-family dispatch deadline in wall-clock seconds (None =
+        #: off; also TX_FAMILY_DEADLINE_S)
+        self.family_deadline = family_deadline
         #: pre-computed winner from workflow-level CV (reference
         #: findBestEstimator, ModelSelector.scala:113): when set, fit
         #: skips validation and refits this estimator on the full data
@@ -279,11 +310,30 @@ class ModelSelector(Predictor):
         if self.best_estimator is not None:
             best, self.best_estimator = self.best_estimator, None
         else:
+            # thread the fault-tolerance knobs into the validator for
+            # THIS search (runtime/): journal + retry + deadline
+            v = self.validator
+            if self.checkpoint_dir is not None:
+                v.checkpoint_dir = self.checkpoint_dir
+            if self.retry_policy is not None:
+                v.retry_policy = self.retry_policy
+            if self.family_deadline is not None:
+                v.family_deadline = self.family_deadline
             best = self.validator.validate(self.models, Xp, yp)
+        rt = getattr(self.validator, "last_runtime", None)
+        quarantined = ([r.to_json() for r in rt.quarantined]
+                       if rt is not None else [])
 
         # 3. refit winner on the full prepared train set
-        # (reference ModelSelector.scala:163)
-        inner = best.estimator.fit_arrays(Xp, yp)
+        # (reference ModelSelector.scala:163) — behind the retry
+        # policy: a preemption during the refit must not discard the
+        # whole (journaled) search
+        from ..runtime.retry import RetryPolicy
+        retry = (self.retry_policy
+                 or getattr(self.validator, "retry_policy", None)
+                 or RetryPolicy.from_env())
+        inner = retry.call(lambda: best.estimator.fit_arrays(Xp, yp),
+                           description=f"winner-refit:{best.name}")
 
         # 4. training-set evaluation (reference :172)
         evaluator = self.validator.evaluator
@@ -298,6 +348,7 @@ class ModelSelector(Predictor):
             validation_type=type(self.validator).__name__,
             validation_parameters=self.validator.get_params(),
             racing=dict(getattr(self.validator, "last_report", {}) or {}),
+            quarantined=quarantined,
             data_prep_parameters=prep_params,
             data_prep_results=prep_results,
             evaluation_metric=evaluator.default_metric,

@@ -53,9 +53,18 @@ def reset_family_profile() -> None:
 from ..evaluators.base import Evaluator
 from ..models.base import (FamilyPreconditionError,
                            PredictionModel, Predictor)
+from ..runtime import telemetry as _telemetry
+from ..runtime.context import RuntimeContext
+from ..runtime.errors import (AllFamiliesFailedError, BUG,
+                              classify_error)
+from ..runtime.faults import maybe_inject
 
 __all__ = ["ValidationResult", "BestEstimator", "CrossValidation",
            "TrainValidationSplit"]
+
+#: sentinel a dispatch returns for a quarantined family — distinct from
+#: None (= no device path; fall through to the host evaluation)
+_QUARANTINED = object()
 
 
 def _async_dispatch_bytes(X, masks, X_val_st, y_val_st) -> int:
@@ -130,14 +139,23 @@ class BestEstimator:
 
 def _batched_fold_raw(fitted_fold_models, X_val):
     """Raw predictions for every tree-family candidate of one fold in
-    one device program (models/trees.batch_predict_raw); {} on any
-    failure so the per-candidate path silently takes over."""
+    one device program (models/trees.batch_predict_raw); {} on a
+    backend-shaped failure so the per-candidate path takes over. A
+    genuine kernel bug PROPAGATES (r4 narrowed the former blanket
+    ``except Exception`` to the runtime's transient/family classifier
+    — silently degrading every search to the slow path used to hide
+    real defects; lint rule TX-R01 now flags that pattern)."""
     try:
         from ..models.trees import batch_predict_raw
         return batch_predict_raw(fitted_fold_models, X_val)
-    except Exception:                      # pragma: no cover - defensive
-        _log.warning("batched fold evaluation failed; falling back to "
-                     "per-candidate predicts", exc_info=True)
+    except NotImplementedError:
+        return {}
+    except Exception as e:
+        if classify_error(e) == BUG:
+            raise
+        _log.warning("batched fold evaluation failed (%s: %s); falling "
+                     "back to per-candidate predicts",
+                     type(e).__name__, e)
         return {}
 
 
@@ -152,6 +170,56 @@ class _ValidatorBase:
         #: chips (see parallel/cv.py); without it they still batch into
         #: one vmapped program on the local device.
         self.mesh = mesh
+        #: fault-tolerance knobs (runtime/; docs/resilience.md) — set
+        #: directly or via ModelSelector(checkpoint_dir=..., ...):
+        #: journal completed family evaluations here and replay them on
+        #: a resumed search
+        self.checkpoint_dir: Optional[str] = None
+        #: RetryPolicy for transient dispatch failures (None = env
+        #: defaults, runtime/retry.py)
+        self.retry_policy = None
+        #: wall-clock seconds one family's threaded dispatch may take
+        #: before it is abandoned + quarantined (None = no deadline)
+        self.family_deadline: Optional[float] = None
+        #: RuntimeContext of the most recent validate() call — the
+        #: selector reads the quarantine ledger from here
+        self.last_runtime: Optional[RuntimeContext] = None
+
+    # -- fault-tolerant runtime --------------------------------------------
+    @staticmethod
+    def _family_key(fi: int, estimator) -> str:
+        """Journal/dispatch identity of one family in THIS pool: the
+        pool index disambiguates two instances of the same class."""
+        return f"{fi}:{type(estimator).__name__}"
+
+    def _begin_runtime(self, models, X, y) -> RuntimeContext:
+        """Open this search's RuntimeContext (quarantine ledger + retry
+        + optional journal). The journal is keyed by the search
+        fingerprint — grid x splits x seed x data — so a stale
+        checkpoint from a different search is rotated aside instead of
+        mis-replayed."""
+        ctx = RuntimeContext(retry=self.retry_policy,
+                             family_deadline=self.family_deadline)
+        if self.checkpoint_dir and X is not None:
+            from ..runtime.journal import search_fingerprint
+            params = dict(self.get_params(),
+                          validationType=type(self).__name__)
+            ctx.open_journal(self.checkpoint_dir,
+                             search_fingerprint(models, params, X, y))
+        self.last_runtime = ctx
+        return ctx
+
+    def _results_from_journal(self, estimator, grid, metric_rows
+                              ) -> List["ValidationResult"]:
+        """ValidationResults rebuilt from journaled per-candidate fold
+        vectors — bit-exact (JSON doubles round-trip via repr)."""
+        return [
+            ValidationResult(
+                model_name=type(estimator).__name__,
+                model_uid=estimator.uid, grid_index=gi,
+                params=dict(params),
+                metric_values=[float(v) for v in metric_rows[gi]])
+            for gi, params in enumerate(grid)]
 
     # -- split construction ------------------------------------------------
     def _splits(self, y: np.ndarray
@@ -251,17 +319,23 @@ class _ValidatorBase:
         return splits, masks, fold_data, spec, X_val_st, y_val_st
 
     def _dispatch_device_evals(self, tasks, X, masks, X_val_st, y_val_st,
-                               spec):
+                               spec, ctx: Optional[RuntimeContext] = None,
+                               rung: Optional[int] = None,
+                               rung_label: str = "exact"):
         """Run per-family device-eval thunks, threaded when profitable.
 
-        ``tasks`` is [(family_name, thunk), ...]; returns thunk results
-        in order. Dispatch every family's device kernel BEFORE fetching
-        any result: each kernel ends in a blocking device->host fetch,
-        so a sequential loop would stall family B's dispatch on family
-        A's transfer. Threads overlap host orchestration + transfers
-        with on-chip compute (the chip still serializes the programs);
-        JAX tracing/dispatch is thread-safe and the shared binning memo
-        in models/trees serializes under its own lock.
+        ``tasks`` is [(family_name, family_key, cand_indices, thunk),
+        ...]; returns per-task results in order: an (F, G) metric
+        matrix, None (no device path — host evaluation takes over), or
+        the ``_QUARANTINED`` sentinel.
+
+        Dispatch every family's device kernel BEFORE fetching any
+        result: each kernel ends in a blocking device->host fetch, so a
+        sequential loop would stall family B's dispatch on family A's
+        transfer. Threads overlap host orchestration + transfers with
+        on-chip compute (the chip still serializes the programs); JAX
+        tracing/dispatch is thread-safe and the shared binning memo in
+        models/trees serializes under its own lock.
         size guard: concurrent dispatch keeps EVERY family's input
         buffers + intermediates resident at once — at search sizes
         that's noise, but a huge matrix could push peak HBM past the
@@ -271,20 +345,36 @@ class _ValidatorBase:
         only adds GIL churn) and each task renames its worker thread to
         ``tx-family-<Name>`` so profiler lanes and the compile-time
         accumulator (utils/compile_time.py) attribute work to a
-        family."""
+        family.
+
+        Fault tolerance (runtime/, docs/resilience.md), active when a
+        RuntimeContext is supplied:
+
+        - journaled (family, cands, rung) evaluations replay from the
+          checkpoint without dispatching anything;
+        - transient backend errors (preemption / RESOURCE_EXHAUSTED
+          shapes) retry under ``ctx.retry`` with backoff; persistent or
+          family-fatal errors quarantine the family (the sentinel) and
+          the search continues with survivors — only a classified BUG
+          propagates;
+        - with ``ctx.family_deadline`` set, a family whose dispatch
+          outlives the deadline is abandoned on its thread and
+          quarantined, so one hung backend cannot stall the rung
+          barrier forever."""
         import threading
 
         from ..utils import compile_time
         compile_time.install()
+        folds = int(masks.shape[0])
 
-        def named(name, thunk):
+        def named(name, fn):
             th = threading.current_thread()
             label = f"tx-family-{name}"
             prev, th.name = th.name, label
             t0 = time.perf_counter()
             c0 = compile_time.compile_seconds_by_thread().get(label, 0.0)
             try:
-                return thunk()
+                return fn()
             finally:
                 rec = _FAMILY_PROFILE.setdefault(
                     name, {"seconds": 0.0, "compile": 0.0, "calls": 0})
@@ -294,33 +384,117 @@ class _ValidatorBase:
                 rec["calls"] += 1
                 th.name = prev
 
+        def run_task(name, key, cands, thunk):
+            if ctx is not None:
+                cached = ctx.journal_lookup(key, rung_label, cands)
+                if cached is not None:
+                    # journal stores per-candidate fold vectors; the
+                    # dispatch contract is (folds, candidates)
+                    return np.asarray(cached, dtype=np.float64).T
+
+            def attempt():
+                maybe_inject("family", name, "dispatch")
+                return thunk()
+
+            retries = [0]
+            try:
+                if ctx is not None:
+                    mm = named(name, lambda: ctx.retry.call(
+                        attempt, description=f"dispatch:{name}",
+                        on_retry=lambda a, e: retries.__setitem__(
+                            0, a + 1)))
+                else:
+                    mm = named(name, attempt)
+            except Exception as e:
+                kind = classify_error(e)
+                if ctx is None or kind == BUG:
+                    raise
+                ctx.quarantine(
+                    name, f"{type(e).__name__}: {e}", kind=kind,
+                    error_type=type(e).__name__, rung=rung,
+                    retries=retries[0])
+                return _QUARANTINED
+            if mm is None:
+                return None
+            if maybe_inject("family", name, "metric") == "nan":
+                mm = np.full_like(np.asarray(mm, dtype=np.float64),
+                                  np.nan)
+            arr = np.asarray(mm, dtype=np.float64)
+            if ctx is not None and arr.size:
+                bad = 1.0 - float(np.mean(np.isfinite(arr)))
+                if bad >= ctx.nan_quarantine_fraction:
+                    ctx.quarantine(
+                        name,
+                        f"{bad:.0%} of device metrics non-finite",
+                        kind="metrics", rung=rung)
+                    return _QUARANTINED
+            _telemetry.note_dispatch(key, rung_label, tuple(cands),
+                                     folds)
+            if ctx is not None:
+                ctx.journal_record(key, rung_label, cands,
+                                   arr.T.tolist(), folds)
+            return arr
+
         async_cap = int(os.environ.get("TX_ASYNC_FAMILIES_MAX_BYTES",
                                        256 * 1024 * 1024))
         dispatch_bytes = _async_dispatch_bytes(X, masks, X_val_st,
                                                y_val_st)
+        deadline = ctx.family_deadline if ctx is not None else None
         if (len(tasks) > 1 and spec is not None
                 and dispatch_bytes <= async_cap
                 and os.environ.get("TX_ASYNC_FAMILIES", "1") != "0"):
             from concurrent.futures import ThreadPoolExecutor
+            from concurrent.futures import TimeoutError as _FutTimeout
+            from concurrent.futures import wait as _fut_wait
             workers = min(len(tasks), os.cpu_count() or 1)
-            with ThreadPoolExecutor(max_workers=workers,
-                                    thread_name_prefix="tx-family") as ex:
-                futures = [ex.submit(named, name, thunk)
-                           for name, thunk in tasks]
-                return [f.result() for f in futures]
-        return [named(name, thunk) for name, thunk in tasks]
+            ex = ThreadPoolExecutor(max_workers=workers,
+                                    thread_name_prefix="tx-family")
+            futures = [ex.submit(run_task, *t) for t in tasks]
+            t_submit = time.monotonic()
+            results, kill = [], None
+            for (name, _, _, _), f in zip(tasks, futures):
+                try:
+                    timeout = (None if deadline is None else max(
+                        0.05, deadline - (time.monotonic() - t_submit)))
+                    results.append(f.result(timeout=timeout))
+                except _FutTimeout:
+                    ctx.quarantine(
+                        name,
+                        f"family dispatch exceeded the {deadline:g}s "
+                        f"deadline (backend hung or wedged); thread "
+                        f"abandoned", kind="deadline", rung=rung)
+                    results.append(_QUARANTINED)
+                except BaseException as e:
+                    # only classified bugs and KillPoints reach here —
+                    # run_task absorbs everything quarantinable. Drain
+                    # the remaining in-flight families first so their
+                    # journal records land (a resumed search must not
+                    # lose work that actually completed), then re-raise.
+                    kill = e
+                    results.append(_QUARANTINED)
+            if kill is not None:
+                _fut_wait(futures, timeout=deadline or 30.0)
+                ex.shutdown(wait=False)
+                raise kill
+            # with a deadline, an abandoned thread may still be running:
+            # do not join it — the whole point is not to wait forever
+            ex.shutdown(wait=deadline is None)
+            return results
+        return [run_task(*t) for t in tasks]
 
     def _device_matrices(self, models, X, y, masks, X_val_st, y_val_st,
-                         spec):
+                         spec, ctx: Optional[RuntimeContext] = None):
         """Per-family (F, G) device metric matrices (None entries fall
-        through to the host paths)."""
+        through to the host paths; ``_QUARANTINED`` entries are out of
+        the search)."""
         tasks = [
-            (type(est).__name__,
+            (type(est).__name__, self._family_key(fi, est),
+             tuple(range(len(grid))),
              (lambda e=est, g=grid: self._try_device_eval(
                  e, g, X, y, masks, X_val_st, y_val_st, spec)))
-            for est, grid in models]
+            for fi, (est, grid) in enumerate(models)]
         return self._dispatch_device_evals(tasks, X, masks, X_val_st,
-                                           y_val_st, spec)
+                                           y_val_st, spec, ctx=ctx)
 
     def _family_host_results(self, estimator, grid, X, y, masks,
                              fold_data) -> List[ValidationResult]:
@@ -367,7 +541,7 @@ class _ValidatorBase:
                                 if raw is not None
                                 else model.predict_arrays(X_val))
                     else:
-                        model = candidate.fit_arrays(X_tr, y_tr)
+                        model = candidate.fit_arrays_guarded(X_tr, y_tr)
                         pred = model.predict_arrays(X_val)
                     metrics = self.evaluator.evaluate_arrays(
                         y_val, pred)
@@ -383,25 +557,64 @@ class _ValidatorBase:
             results.append(res)
         return results
 
+    def _host_results_journaled(self, fi, estimator, grid, X, y, masks,
+                                fold_data, ctx: RuntimeContext
+                                ) -> List[ValidationResult]:
+        """Host evaluation of one family behind the runtime: journal
+        replay first, quarantine-on-classified-failure, journal append
+        on success. Label ``"exact-host"`` keeps host metric vectors
+        from ever replaying into the device-matrix path (they are
+        float-identical in theory, but the journal's contract is
+        bit-exactness, not theory)."""
+        key = self._family_key(fi, estimator)
+        cands = tuple(range(len(grid)))
+        cached = ctx.journal_lookup(key, "exact-host", cands)
+        if cached is not None:
+            return self._results_from_journal(estimator, grid, cached)
+        try:
+            host = self._family_host_results(estimator, grid, X, y,
+                                             masks, fold_data)
+        except Exception as e:
+            kind = classify_error(e)
+            if kind == BUG:
+                raise
+            ctx.quarantine(type(estimator).__name__,
+                           f"{type(e).__name__}: {e}", kind=kind,
+                           error_type=type(e).__name__)
+            return []
+        _telemetry.note_dispatch(key, "exact-host", cands,
+                                 len(fold_data))
+        ctx.journal_record(key, "exact-host", cands,
+                           [r.metric_values for r in host],
+                           len(fold_data))
+        return host
+
     # -- main loop (reference getSummary, OpValidator.scala:270-310) -------
     def validate(self,
                  models: Sequence[Tuple[Predictor, Sequence[Dict]]],
                  X: np.ndarray, y: np.ndarray) -> BestEstimator:
-        _, masks, fold_data, spec, X_val_st, y_val_st = \
-            self._build_fold_arrays(X, y)
-        results: List[ValidationResult] = []
         models = [(est, list(grid) or [{}]) for est, grid in models]
-        device_mm = self._device_matrices(models, X, y, masks, X_val_st,
-                                          y_val_st, spec)
-        for (estimator, grid), mm in zip(models, device_mm):
-            if mm is not None:
-                results.extend(self._results_from_matrix(
-                    estimator, grid, mm))
-                continue
-            results.extend(self._family_host_results(
-                estimator, grid, X, y, masks, fold_data))
-
-        return self._pick_best(models, results)
+        ctx = self._begin_runtime(models, X, y)
+        try:
+            _, masks, fold_data, spec, X_val_st, y_val_st = \
+                self._build_fold_arrays(X, y)
+            results: List[ValidationResult] = []
+            device_mm = self._device_matrices(models, X, y, masks,
+                                              X_val_st, y_val_st, spec,
+                                              ctx=ctx)
+            for fi, ((estimator, grid), mm) in enumerate(
+                    zip(models, device_mm)):
+                if mm is _QUARANTINED:
+                    continue
+                if mm is not None:
+                    results.extend(self._results_from_matrix(
+                        estimator, grid, mm))
+                    continue
+                results.extend(self._host_results_journaled(
+                    fi, estimator, grid, X, y, masks, fold_data, ctx))
+        finally:
+            ctx.close_journal()
+        return self._pick_best(models, results, ctx=ctx)
 
     def validate_prepared(self,
                           models: Sequence[Tuple[Predictor, Sequence[Dict]]],
@@ -413,76 +626,102 @@ class _ValidatorBase:
         + getSummary): each fold's in-CV DAG segment was refit on that
         fold's train rows, so feature matrices may differ across folds
         (even in width). ``folds`` is [(X_tr, y_tr, X_val, y_val), ...].
-        Grid batching still applies per fold via the family kernels."""
+        Grid batching still applies per fold via the family kernels.
+
+        Fault tolerance: a family whose evaluation raises a classified
+        transient/family error is quarantined (the workflow-CV search
+        degrades to survivors exactly like the array-level path); the
+        per-fold journal is NOT written here — fold matrices differ per
+        refit DAG segment, so there is no stable fingerprint to key a
+        resume on (docs/resilience.md)."""
         spec = self.evaluator.device_metric_spec()
+        models = [(est, list(grid) or [{}]) for est, grid in models]
+        ctx = self._begin_runtime(models, None, None)
         results: List[ValidationResult] = []
         for estimator, grid in models:
-            grid = list(grid) or [{}]
-            # device-resident fast path, one fold at a time (fold
-            # matrices may differ in shape after per-fold DAG refits,
-            # so they cannot stack into one kernel call)
-            mm = None
-            if spec is not None:
-                rows = []
-                for X_tr, y_tr, X_val, y_val in folds:
-                    row = self._try_device_eval(
-                        estimator, grid, X_tr, y_tr,
-                        np.ones((1, len(y_tr))), X_val[None],
-                        np.asarray(y_val)[None], spec)
-                    if row is None:
-                        break
-                    rows.append(row[0])
-                else:
-                    mm = np.stack(rows) if rows else None
-            if mm is not None:
-                results.extend(self._results_from_matrix(
-                    estimator, grid, mm))
+            try:
+                fam = self._prepared_family_results(
+                    estimator, grid, folds, spec)
+            except Exception as e:
+                kind = classify_error(e)
+                if kind == BUG:
+                    raise
+                ctx.quarantine(type(estimator).__name__,
+                               f"{type(e).__name__}: {e}", kind=kind,
+                               error_type=type(e).__name__)
                 continue
-            fitted = None
-            if self._use_batched_kernel(estimator):
+            results.extend(fam)
+        return self._pick_best(models, results, ctx=ctx)
+
+    def _prepared_family_results(self, estimator, grid, folds, spec
+                                 ) -> List[ValidationResult]:
+        """One family's results over pre-materialized folds (the body
+        validate_prepared quarantines as a unit)."""
+        results: List[ValidationResult] = []
+        # device-resident fast path, one fold at a time (fold
+        # matrices may differ in shape after per-fold DAG refits,
+        # so they cannot stack into one kernel call)
+        mm = None
+        if spec is not None:
+            rows = []
+            for X_tr, y_tr, X_val, y_val in folds:
+                row = self._try_device_eval(
+                    estimator, grid, X_tr, y_tr,
+                    np.ones((1, len(y_tr))), X_val[None],
+                    np.asarray(y_val)[None], spec)
+                if row is None:
+                    break
+                rows.append(row[0])
+            else:
+                mm = np.stack(rows) if rows else None
+        if mm is not None:
+            return self._results_from_matrix(estimator, grid, mm)
+        fitted = None
+        if self._use_batched_kernel(estimator):
+            try:
+                fitted = [
+                    estimator.fit_fold_grid_arrays(
+                        X_tr, y_tr, np.ones((1, len(y_tr))), grid,
+                        mesh=self.mesh)[0]
+                    for X_tr, y_tr, _, _ in folds]
+            except NotImplementedError:
+                fitted = None
+            except FamilyPreconditionError as e:
+                _log.warning("batched kernel for %s rejected the "
+                             "data: %s", type(estimator).__name__, e)
+                fitted = None
+        fold_raw = ([_batched_fold_raw(fitted[f], folds[f][2])
+                     for f in range(len(folds))]
+                    if fitted is not None else None)
+        for gi, params in enumerate(grid):
+            candidate = (None if fitted is not None
+                         else estimator.with_params(**params))
+            res = ValidationResult(
+                model_name=type(estimator).__name__,
+                model_uid=estimator.uid, grid_index=gi,
+                params=dict(params))
+            for f, (X_tr, y_tr, X_val, y_val) in enumerate(folds):
                 try:
-                    fitted = [
-                        estimator.fit_fold_grid_arrays(
-                            X_tr, y_tr, np.ones((1, len(y_tr))), grid,
-                            mesh=self.mesh)[0]
-                        for X_tr, y_tr, _, _ in folds]
-                except NotImplementedError:
-                    fitted = None
-                except FamilyPreconditionError as e:
-                    _log.warning("batched kernel for %s rejected the "
-                                 "data: %s", type(estimator).__name__, e)
-                    fitted = None
-            fold_raw = ([_batched_fold_raw(fitted[f], folds[f][2])
-                         for f in range(len(folds))]
-                        if fitted is not None else None)
-            for gi, params in enumerate(grid):
-                candidate = (None if fitted is not None
-                             else estimator.with_params(**params))
-                res = ValidationResult(
-                    model_name=type(estimator).__name__,
-                    model_uid=estimator.uid, grid_index=gi,
-                    params=dict(params))
-                for f, (X_tr, y_tr, X_val, y_val) in enumerate(folds):
-                    try:
-                        model = (fitted[f][gi] if fitted is not None
-                                 else candidate.fit_arrays(X_tr, y_tr))
-                        raw = (fold_raw[f].get(gi)
-                               if fitted is not None else None)
-                        pred = (model.prediction_from_raw(raw)
-                                if raw is not None
-                                else model.predict_arrays(X_val))
-                        metrics = self.evaluator.evaluate_arrays(y_val, pred)
-                        res.metric_values.append(
-                            self.evaluator.metric_from(metrics))
-                    except (ValueError, FloatingPointError) as e:
-                        _log.warning("candidate %s%s failed on a fold: %s",
-                                     res.model_name, params, e)
-                        res.metric_values.append(float("nan"))
-                results.append(res)
-        return self._pick_best(models, results)
+                    model = (fitted[f][gi] if fitted is not None
+                             else candidate.fit_arrays_guarded(X_tr, y_tr))
+                    raw = (fold_raw[f].get(gi)
+                           if fitted is not None else None)
+                    pred = (model.prediction_from_raw(raw)
+                            if raw is not None
+                            else model.predict_arrays(X_val))
+                    metrics = self.evaluator.evaluate_arrays(y_val, pred)
+                    res.metric_values.append(
+                        self.evaluator.metric_from(metrics))
+                except (ValueError, FloatingPointError) as e:
+                    _log.warning("candidate %s%s failed on a fold: %s",
+                                 res.model_name, params, e)
+                    res.metric_values.append(float("nan"))
+            results.append(res)
+        return results
 
     def _pick_best(self, models, results: List[ValidationResult],
-                   rank_pool: Optional[List[ValidationResult]] = None
+                   rank_pool: Optional[List[ValidationResult]] = None,
+                   ctx: Optional[RuntimeContext] = None
                    ) -> BestEstimator:
         """Winner among ``rank_pool`` (default: all results). Racing
         passes only full-fidelity finalists — a pruned candidate's
@@ -492,6 +731,14 @@ class _ValidatorBase:
         pool = results if rank_pool is None else rank_pool
         finite = [r for r in pool if np.isfinite(r.mean_metric)]
         if not finite:
+            if ctx is not None and ctx.quarantined:
+                # nothing survived the quarantine ledger: ONE aggregated
+                # error naming every family and reason, instead of
+                # whichever family died first
+                raise AllFamiliesFailedError(
+                    ctx.quarantined,
+                    detail="no family produced a finite validation "
+                           "metric")
             raise ValueError(
                 "all validation metrics are non-finite; cannot select a "
                 "model (check for degenerate folds — e.g. a fold with a "

@@ -45,6 +45,9 @@ import numpy as np
 from ..features.columns import Dataset, FeatureColumn, PredictionColumn
 from ..features.feature import Feature, topo_layers
 from ..features.generator import FeatureGeneratorStage
+from ..runtime import telemetry as _telemetry
+from ..runtime.faults import maybe_inject
+from ..runtime.retry import RetryPolicy
 from ..stages.base import Transformer
 from ..types import Prediction
 
@@ -161,12 +164,13 @@ class ScoringPlan:
         try:
             # warm-start serving: persisted XLA artifacts skip compiles
             enable_compilation_cache()
-        except Exception:  # pragma: no cover - cache dir not writable
-            pass
+        except (OSError, RuntimeError):  # pragma: no cover - cache dir
+            pass                         # not writable
         import jax
 
         self._raw_features = self.model.raw_features()
         self._result_names = [f.name for f in self.model.result_features]
+        self._retry = RetryPolicy.from_env()
         stages = []
         for layer in topo_layers(self.model.result_features):
             for s in layer:
@@ -178,10 +182,36 @@ class ScoringPlan:
                 stages.append(s)
 
         self._proto_cols = self._probe_zero_rows(stages)
-        self._classify(stages)
-        self._build_device_fn(jax)
+        # graceful degradation loop: a stage kernel that fails to trace
+        # is DEMOTED to its host transform_columns fallback (with the
+        # reason in coverage + a loud warning) and the plan rebuilds —
+        # a bad kernel costs that stage's speedup, never the plan
+        self._demoted: Dict[str, str] = {}
+        for _ in range(len(stages) + 1):
+            self.coverage = PlanCoverage()
+            self._classify(stages)
+            self._build_device_fn(jax)
+            culprit = self._verify_device_fn(jax)
+            if culprit is None:
+                break
+            uid, stage_name, reason = culprit
+            self._demoted[uid] = reason
+            _telemetry.count("plan_fallbacks")
+            _telemetry.event("plan_fallback", stage=stage_name,
+                             reason=reason)
+            _log.warning(
+                "scoring plan: stage %s failed to compile (%s); "
+                "falling back to its host transform_columns path",
+                stage_name, reason)
         self._compiled = True
         return self
+
+    def fallbacks(self) -> int:
+        """How many stages of this plan run through the host
+        ``transform_columns`` fallback instead of the fused device
+        program — including kernels demoted because they failed to
+        compile (``coverage`` carries the reasons)."""
+        return len(self.coverage.fallback)
 
     def _probe_zero_rows(self, stages: List[Transformer]
                          ) -> Dict[str, FeatureColumn]:
@@ -219,7 +249,9 @@ class ScoringPlan:
             out_name = stage.get_output().name
             in_names = tuple(f.name for f in stage.input_features)
             reason = ""
-            if not stage.supports_arrays():
+            if stage.uid in getattr(self, "_demoted", {}):
+                reason = self._demoted[stage.uid]
+            elif not stage.supports_arrays():
                 reason = "no array kernel (transform_arrays)"
             else:
                 for i, name in enumerate(in_names):
@@ -242,7 +274,8 @@ class ScoringPlan:
                         stage.encode_input_column(
                             i, self._proto_cols[name])
                     except Exception as e:
-                        reason = (f"input {name!r} not encodable: {e}")
+                        reason = self._fallback_reason(
+                            f"input {name!r} not encodable", e)
                         break
             if not reason:
                 phase = "device"
@@ -293,6 +326,47 @@ class ScoringPlan:
             s.out_name for s in steps
             if s.phase == "device" and s.out_name in needed]
 
+    @staticmethod
+    def _fallback_reason(what: str, e: Exception) -> str:
+        """One-line fallback reason for coverage records (the TX-R01
+        contract: a swallowed hot-path exception must surface as a
+        recorded degradation, never vanish)."""
+        return f"{what}: {type(e).__name__}: {e}"
+
+    def _verify_device_fn(self, jax):
+        """Abstractly trace the composed device program (zero device
+        code — ``jax.eval_shape``) and return the first stage whose
+        kernel fails as ``(uid, stage_name, reason)``, or None when the
+        program traces clean. The compile() loop demotes the culprit to
+        the host path and rebuilds."""
+        # deterministic test hook: an injected per-stage compile fault
+        # demotes exactly like a real trace failure
+        for stage, out_name, _ in self._device_steps:
+            try:
+                maybe_inject("plan", type(stage).__name__, "compile")
+            except Exception as e:
+                return (stage.uid, f"{type(stage).__name__}({out_name})",
+                        self._fallback_reason("injected compile fault",
+                                              e))
+        if not self._device_steps:
+            return None
+        sds = {}
+        for key, name, enc in self._host_inputs:
+            arr = np.asarray(enc(self._proto_cols[name]))
+            sds[key] = jax.ShapeDtypeStruct(
+                (self.min_bucket,) + arr.shape[1:], arr.dtype)
+        env = dict(sds)
+        for stage, out_name, keys in self._device_steps:
+            try:
+                env[out_name] = jax.eval_shape(
+                    lambda *a, s=stage: s.transform_arrays(list(a)),
+                    *[env[k] for k in keys])
+            except Exception as e:
+                return (stage.uid, f"{type(stage).__name__}({out_name})",
+                        self._fallback_reason("kernel failed abstract "
+                                              "trace", e))
+        return None
+
     def _build_device_fn(self, jax) -> None:
         """Compose the lowered kernels into ONE traced function; jit it
         once — per-bucket shapes then hit jit's own compile cache."""
@@ -304,6 +378,7 @@ class ScoringPlan:
                     and s.stage.encodes_input(i) else n)
                    for i, n in enumerate(s.input_names)))
             for s in self._steps if s.phase == "device"]
+        self._device_steps = device_steps
         in_keys = tuple(k for k, _, _ in self._host_inputs)
         out_names = tuple(self._device_outputs)
 
@@ -360,12 +435,25 @@ class ScoringPlan:
             mask = np.zeros(bucket, dtype=np.float64)
             mask[:rows] = 1.0
             _COMPILE_KEYS.add((self._plan_id, bucket))
-            outs = self._device_fn(inputs, mask)
+            outs = self._dispatch_device(inputs, mask)
             for i, o in enumerate(outs):
                 out_chunks[i].append(np.asarray(o)[:rows])
             if n == 0:
                 break
 
+        return self._finish_score(ds, out_chunks)
+
+    def _dispatch_device(self, inputs, mask):
+        """One fused-program dispatch behind the runtime retry policy:
+        a preemption/RESOURCE_EXHAUSTED-shaped backend error retries
+        with backoff (runtime/retry.py) instead of failing the serving
+        request; persistent errors propagate to the caller."""
+        def attempt():
+            maybe_inject("plan", "device", "dispatch")
+            return self._device_fn(inputs, mask)
+        return self._retry.call(attempt, description="plan-dispatch")
+
+    def _finish_score(self, ds: Dataset, out_chunks) -> Dataset:
         for name, chunks in zip(self._device_outputs, out_chunks):
             arr = (np.concatenate(chunks, axis=0) if chunks
                    else np.zeros(0))

@@ -12,7 +12,33 @@ from __future__ import annotations
 import os
 
 __all__ = ["enable_compilation_cache", "device_trace",
-           "pin_platform_from_env"]
+           "pin_platform_from_env", "shard_map"]
+
+_SHARD_MAP = None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """Version-portable ``shard_map``: jax>=0.5 exposes
+    ``jax.shard_map(..., check_vma=)``, while 0.4.x only has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)``. The
+    AttributeError from probing the wrong one classifies as a BUG under
+    runtime.errors (it IS one at a direct call site), so resolve once
+    here instead of per-kernel."""
+    global _SHARD_MAP
+    if _SHARD_MAP is None:
+        import inspect
+
+        import jax
+        try:
+            fn = jax.shard_map
+        except AttributeError:
+            from jax.experimental.shard_map import shard_map as fn
+        rep_kw = ("check_vma" if "check_vma" in
+                  inspect.signature(fn).parameters else "check_rep")
+        _SHARD_MAP = (fn, rep_kw)
+    fn, rep_kw = _SHARD_MAP
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{rep_kw: check_vma})
 
 
 def device_trace(log_dir: str):

@@ -57,6 +57,11 @@ class AppMetrics:
     start_time: float = field(default_factory=time.time)
     end_time: Optional[float] = None
     stage_metrics: List[StageMetric] = field(default_factory=list)
+    #: fault-runtime events observed during this run (retries,
+    #: quarantines, journal resumes, plan fallbacks — runtime/
+    #: telemetry.py). Empty — and absent from the JSON — on a
+    #: fault-free run.
+    fault_events: List[Dict] = field(default_factory=list)
 
     @property
     def app_duration(self) -> float:
@@ -64,11 +69,14 @@ class AppMetrics:
         return end - self.start_time
 
     def to_json(self) -> dict:
-        return {"appName": self.app_name,
-                "customTagName": self.custom_tag_name,
-                "customTagValue": self.custom_tag_value,
-                "appDurationSeconds": round(self.app_duration, 3),
-                "stageMetrics": [m.to_json() for m in self.stage_metrics]}
+        out = {"appName": self.app_name,
+               "customTagName": self.custom_tag_name,
+               "customTagValue": self.custom_tag_value,
+               "appDurationSeconds": round(self.app_duration, 3),
+               "stageMetrics": [m.to_json() for m in self.stage_metrics]}
+        if self.fault_events:
+            out["faultEvents"] = self.fault_events
+        return out
 
     def profile_pretty(self, top: int = 0) -> str:
         """Human per-stage profile, slowest first — the role of the
@@ -102,6 +110,9 @@ class WorkflowListener:
         self.collect_stage_metrics = collect_stage_metrics
         self.metrics = AppMetrics(app_name=app_name)
         self._end_handlers: List[Callable[[AppMetrics], None]] = []
+        # fault-runtime events after this mark belong to this run
+        from ..runtime import telemetry as _rt
+        self._fault_mark = _rt.events_mark()
 
     def on_stage_completed(self, stage, phase: str, seconds: float,
                            n_rows: int,
@@ -122,6 +133,11 @@ class WorkflowListener:
 
     def on_application_end(self) -> None:
         self.metrics.end_time = time.time()
+        # snapshot the fault-runtime events (retries/quarantines/
+        # journal resumes) that happened during this run next to its
+        # stage profile
+        from ..runtime import telemetry as _rt
+        self.metrics.fault_events = _rt.events_since(self._fault_mark)
         for fn in self._end_handlers:
             try:
                 fn(self.metrics)

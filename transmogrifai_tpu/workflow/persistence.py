@@ -259,9 +259,25 @@ def _collect_features_topo(result_features) -> List[Feature]:
 # model save / load
 # ---------------------------------------------------------------------------
 
+def _fsync_file(fpath: str) -> None:
+    fd = os.open(fpath, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_model(model, path: str) -> None:
     """Write a fitted WorkflowModel to ``path`` (a directory)
-    (reference OpWorkflowModelWriter.toJson:75-120)."""
+    (reference OpWorkflowModelWriter.toJson:75-120).
+
+    ATOMIC: the files are staged into a sibling temp directory
+    (fsync'd) and swapped in with ``os.replace``/``os.rename`` — a
+    crash mid-save (VM preemption, OOM-kill) leaves either the previous
+    intact model or no model at ``path``, never a half-written
+    directory. A leftover ``<path>.tmp-save*`` staging dir is the
+    crash's only trace, and ``load_model`` rejects it with a clear
+    error instead of mis-loading."""
     feats = _collect_features_topo(model.result_features)
     for f in feats:
         if f.origin_stage is not None and isinstance(f.origin_stage,
@@ -291,19 +307,78 @@ def save_model(model, path: str) -> None:
         "blacklistedFeatureNames": list(
             getattr(model, "blacklisted_feature_names", ())),
     }
-    os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, MODEL_JSON), "w") as fh:
+    from ..runtime.faults import maybe_inject
+    tmp = f"{path}.tmp-save{os.getpid()}"
+    if os.path.isdir(tmp):
+        import shutil
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    json_path = os.path.join(tmp, MODEL_JSON)
+    with open(json_path, "w") as fh:
         json.dump(doc, fh, indent=1)
-    np.savez(os.path.join(path, ARRAYS_NPZ),
+        fh.flush()
+        os.fsync(fh.fileno())
+    # deterministic crash site for the atomicity tests: a kill here
+    # leaves a staged dir + an untouched (or previous) target
+    maybe_inject("workflow", "save", "save")
+    np.savez(os.path.join(tmp, ARRAYS_NPZ),
              **{k: v for k, v in arrays.items()})
+    _fsync_file(os.path.join(tmp, ARRAYS_NPZ))
+    if os.path.isdir(path):
+        # swap: rename can't replace a non-empty dir, so move the old
+        # model aside first; it is removed only after the new one is in
+        # place (worst crash outcome: old model at <path>.old-save*)
+        old = f"{path}.old-save{os.getpid()}"
+        if os.path.isdir(old):
+            import shutil
+            shutil.rmtree(old)
+        os.rename(path, old)
+        os.rename(tmp, path)
+        import shutil
+        shutil.rmtree(old)
+    else:
+        os.rename(tmp, path)
+
+
+def _referenced_array_keys(node: Any) -> List[str]:
+    """Every ``{"$array": key}`` reference in a model document — the
+    npz sidecar must supply ALL of them or the dir is partial."""
+    keys: List[str] = []
+    if isinstance(node, dict):
+        if "$array" in node and isinstance(node["$array"], str):
+            keys.append(node["$array"])
+        else:
+            for v in node.values():
+                keys.extend(_referenced_array_keys(v))
+    elif isinstance(node, list):
+        for v in node:
+            keys.extend(_referenced_array_keys(v))
+    return keys
 
 
 def load_model(path: str):
     """Load a fitted WorkflowModel from ``path``
-    (reference OpWorkflowModelReader / OpWorkflow.loadModel)."""
+    (reference OpWorkflowModelReader / OpWorkflow.loadModel).
+
+    Rejects partial/corrupt model directories (a crash mid-save before
+    r4's atomic writer, or a stray staging dir) with a clear error
+    instead of failing deep inside stage deserialization."""
     from .workflow import WorkflowModel
-    with open(os.path.join(path, MODEL_JSON)) as fh:
-        doc = json.load(fh)
+    json_path = os.path.join(path, MODEL_JSON)
+    if not os.path.isdir(path) or not os.path.exists(json_path):
+        raise ValueError(
+            f"{path!r} is not a saved model directory (no {MODEL_JSON})"
+            + (" — it looks like an interrupted save; re-save the "
+               "model" if "tmp-save" in os.path.basename(path)
+               or os.path.isdir(path) else ""))
+    with open(json_path) as fh:
+        try:
+            doc = json.load(fh)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"model at {path} has a corrupt/truncated {MODEL_JSON} "
+                f"({e}) — likely an interrupted save; re-save the "
+                f"model") from e
     fmt = doc.get("formatVersion", 1)
     if fmt > MODEL_FORMAT_VERSION:
         raise ValueError(
@@ -311,9 +386,18 @@ def load_model(path: str):
             f"to {MODEL_FORMAT_VERSION} — load with a newer build")
     npz_path = os.path.join(path, ARRAYS_NPZ)
     arrays: Dict[str, np.ndarray] = {}
+    needed = set(_referenced_array_keys(doc.get("stages", [])))
     if os.path.exists(npz_path):
         with np.load(npz_path, allow_pickle=False) as z:
             arrays = {k: z[k] for k in z.files}
+    missing = sorted(needed - set(arrays))
+    if missing:
+        raise ValueError(
+            f"model at {path} is partial: {MODEL_JSON} references "
+            f"{len(needed)} arrays but "
+            f"{ARRAYS_NPZ if os.path.exists(npz_path) else 'the missing ' + ARRAYS_NPZ} "
+            f"lacks {len(missing)} of them (e.g. {missing[0]!r}) — "
+            f"an interrupted save; re-save the model")
 
     stages: Dict[str, PipelineStage] = {}
     for sd in doc["stages"]:

@@ -360,7 +360,8 @@ class Workflow:
                 for s in layer if not isinstance(s, FeatureGeneratorStage)]
 
     # -- training ----------------------------------------------------------
-    def train(self, validate: str = "warn") -> "WorkflowModel":
+    def train(self, validate: str = "warn",
+              resume_from: Optional[str] = None) -> "WorkflowModel":
         """Fit all estimators layer-by-layer and return the fitted model
         (reference OpWorkflow.train:332 / fitStages:368).
 
@@ -374,6 +375,16 @@ class Workflow:
           violation, duplicate uid, ...)
         - ``"warn"`` (default): log findings and continue
         - ``"off"``: skip the pre-flight entirely
+
+        ``resume_from`` points the workflow's ModelSelector at a search
+        checkpoint directory (docs/resilience.md): completed (family,
+        candidates, rung) evaluations journaled by a previous —
+        possibly killed — ``train()`` with the same search fingerprint
+        replay from disk, and only the missing work is dispatched. The
+        resumed search picks the bitwise-identical winner. The same
+        directory is also written to, so repeatedly retrying
+        ``train(resume_from=d)`` after crashes converges. Equivalent to
+        constructing ``ModelSelector(checkpoint_dir=...)``.
         """
         if validate not in ("strict", "warn", "off"):
             raise ValueError(
@@ -383,6 +394,16 @@ class Workflow:
             raise ValueError("No result features set")
         if self._input_data is None:
             raise ValueError("No input data set")
+        if resume_from is not None:
+            from ..selector.selector import ModelSelector
+            selectors = [s for s in self.stages()
+                         if isinstance(s, ModelSelector)]
+            if not selectors:
+                raise ValueError(
+                    "resume_from requires a ModelSelector in the "
+                    "workflow DAG — there is no search to resume")
+            for s in selectors:
+                s.checkpoint_dir = resume_from
         if validate != "off":
             from ..lint import ERROR, LintError, lint_workflow
             findings = lint_workflow(self)
