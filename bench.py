@@ -362,6 +362,109 @@ def _measure_faults() -> dict:
     }
 
 
+def _measure_serve_faults() -> dict:
+    """TX_BENCH_MODE=serve_faults: serving-guardrail telemetry
+    (ISSUE 5). Four drills on one tiny trained pipeline
+    (docs/serving_guardrails.md): (a) a mixed batch with malformed
+    rows — admission quarantines them with reasons while the valid
+    rows score with ZERO new compiles; (b) persistent injected device
+    faults — the circuit breaker trips to the host columnar fallback,
+    then recovers through half-open after the cooldown; (c) shifted
+    traffic vs the training fingerprints — how many rows until the
+    drift sentinel first reports warn (drift_detect_latency_rows);
+    (d) an injected NaN output — invalidated with a reason."""
+    from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
+                                                   pin_platform_from_env)
+    pin_platform_from_env()
+    enable_compilation_cache()
+    import jax
+    platform = jax.devices()[0].platform
+    import numpy as np
+
+    from transmogrifai_tpu.cli.score import _tiny_pipeline
+    from transmogrifai_tpu.runtime import FaultInjector, telemetry
+    from transmogrifai_tpu.serving import (CircuitBreaker, DriftThresholds,
+                                           ScoringPlan, plan_compiles)
+
+    model, records = _tiny_pipeline(400)
+
+    # (a) admission: malformed rows quarantined, valid rows scored,
+    #     no recompile (the padded-batch mask absorbs the bad rows)
+    telemetry.reset()
+    plan = ScoringPlan(model).compile().with_guardrails()
+    good = [dict(r) for r in records[:64]]
+    bad = [{"x": "not-a-number", "y": 1.0, "cat": "a"},
+           {"x": float("inf"), "y": 2.0, "cat": "b"},
+           {"x": float("nan"), "y": None, "cat": "zzz-unseen"}]
+    batch = good + bad
+    plan.score_guarded(batch)            # warm: pays the bucket compile
+    c0 = plan_compiles()
+    t0 = time.perf_counter()
+    res = plan.score_guarded(batch)
+    admit_s = time.perf_counter() - t0
+    quarantine_compiles = plan_compiles() - c0
+    quarantine_rate = len(res.quarantined_rows) / len(batch)
+
+    # (b) breaker: persistent device faults -> open -> host fallback
+    #     -> half-open probe -> recovery
+    clock = {"t": 0.0}
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_seconds=10.0,
+                             clock=lambda: clock["t"])
+    bplan = (ScoringPlan(model).compile()
+             .with_guardrails(breaker=breaker, sentinel=False))
+    with FaultInjector.plan("plan:device:dispatch:*=oom"):
+        for _ in range(3):
+            bplan.score_guarded(good)    # fails -> retries -> fallback
+    tripped_state = breaker.state
+    clock["t"] = 11.0                    # cooldown elapses
+    recovered = bplan.score_guarded(good)   # half-open probe succeeds
+    counters = telemetry.counters()
+
+    # (c) drift detect latency: batches of shifted traffic until warn
+    dplan = (ScoringPlan(model).compile()
+             .with_guardrails(thresholds=DriftThresholds(
+                 warn=0.25, degrade=0.5, min_rows=50)))
+    rng_shift = np.random.default_rng(11)
+    detect_rows = None
+    chunk = 50
+    for start in range(0, 2000, chunk):
+        shifted = [{"x": float(6.0 + rng_shift.normal()),
+                    "y": float(rng_shift.uniform(0, 10)),
+                    "cat": "a"} for _ in range(chunk)]
+        dplan.score_guarded(shifted)
+        if dplan.drift_report()["status"] != "ok":
+            detect_rows = start + chunk
+            break
+
+    # (d) injected NaN output -> invalidated with a reason
+    with FaultInjector.plan("serving:output:guard:1=nan"):
+        poisoned = plan.score_guarded(good)
+    invalidated = len(poisoned.invalidated_rows)
+
+    return {
+        "metric": "quarantine_rate",
+        "value": round(quarantine_rate, 4),
+        "unit": "fraction",
+        "vs_baseline": round(quarantine_rate, 4),
+        "batch_rows": len(batch),
+        "quarantined_rows": len(res.quarantined_rows),
+        "quarantine_reasons": sorted({r.code for r in res.quarantined}),
+        "quarantine_compiles": quarantine_compiles,
+        "guarded_batch_seconds": round(admit_s, 4),
+        "breaker_trips": counters.get("breaker_trips", 0),
+        "breaker_recoveries": counters.get("breaker_recoveries", 0),
+        "breaker_state_after_faults": tripped_state,
+        "breaker_recovered": bool(not recovered.used_host_fallback
+                                  and breaker.state == "closed"),
+        "host_fallback_batches":
+            counters.get("serving_host_fallback_batches", 0),
+        "drift_detect_latency_rows": detect_rows,
+        "invalidated_rows_on_nan_fault": invalidated,
+        "rows_scored": telemetry.counters().get("serving_rows_scored", 0),
+        "platform": platform,
+    }
+
+
 def _measure() -> dict:
     if os.environ.get("TX_BENCH_MODE") == "score":
         return _measure_score()
@@ -369,6 +472,8 @@ def _measure() -> dict:
         return _measure_racing()
     if os.environ.get("TX_BENCH_MODE") == "faults":
         return _measure_faults()
+    if os.environ.get("TX_BENCH_MODE") == "serve_faults":
+        return _measure_serve_faults()
     from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
                                                    pin_platform_from_env)
     pin_platform_from_env()
@@ -585,6 +690,8 @@ def _headline_metric() -> tuple:
         return "racing_train_eval_seconds", "s"
     if os.environ.get("TX_BENCH_MODE") == "faults":
         return "resume_saved_fraction", "fraction"
+    if os.environ.get("TX_BENCH_MODE") == "serve_faults":
+        return "quarantine_rate", "fraction"
     return "titanic_holdout_aupr", "AuPR"
 
 

@@ -742,3 +742,119 @@ class TestR01ExceptionSwallow:
                     pass
         """, path="transmogrifai_tpu/utils/mylistener.py")
         assert "TX-R01" not in _rules(findings)
+
+
+class TestR02SilentRecordDrop:
+    """TX-R02: serving-path code must not drop records on exception
+    without recording a reason (docs/serving_guardrails.md)."""
+
+    SRV = "transmogrifai_tpu/serving/myguard.py"
+
+    def _lint(self, code, path=None):
+        return lint_source(textwrap.dedent(code), path or self.SRV)
+
+    def test_silent_continue_flagged(self):
+        findings = self._lint("""
+            def score_all(records, fn):
+                out = []
+                for r in records:
+                    try:
+                        out.append(fn(r))
+                    except ValueError:
+                        continue
+                return out
+        """)
+        assert "TX-R02" in _rules(findings)
+        f = [x for x in findings if x.rule_id == "TX-R02"][0]
+        assert f.severity == "error"
+        assert "quarantine" in (f.hint or "")
+
+    def test_silent_pass_in_loop_flagged(self):
+        findings = self._lint("""
+            def score_all(records, fn):
+                out = []
+                for r in records:
+                    try:
+                        out.append(fn(r))
+                    except Exception:
+                        pass
+                return out
+        """)
+        assert "TX-R02" in _rules(findings)
+
+    def test_recorded_drop_is_clean(self):
+        findings = self._lint("""
+            def score_all(records, fn, reasons):
+                out = []
+                for i, r in enumerate(records):
+                    try:
+                        out.append(fn(r))
+                    except ValueError as e:
+                        reasons.append(quarantine_reason(i, e))
+                        continue
+                return out
+        """)
+        assert "TX-R02" not in _rules(findings)
+
+    def test_counted_drop_is_clean(self):
+        findings = self._lint("""
+            def score_all(records, fn, telemetry):
+                out = []
+                for r in records:
+                    try:
+                        out.append(fn(r))
+                    except ValueError:
+                        telemetry.count("rows_dropped")
+                        continue
+                return out
+        """)
+        assert "TX-R02" not in _rules(findings)
+
+    def test_logged_drop_is_clean(self):
+        findings = self._lint("""
+            def score_all(records, fn, log):
+                out = []
+                for r in records:
+                    try:
+                        out.append(fn(r))
+                    except ValueError:
+                        log.warning("dropping record")
+                        continue
+                return out
+        """)
+        assert "TX-R02" not in _rules(findings)
+
+    def test_local_scoring_is_in_scope(self):
+        findings = self._lint("""
+            def extract(records, fn):
+                vals = []
+                for r in records:
+                    try:
+                        vals.append(fn(r))
+                    except Exception:
+                        continue
+                return vals
+        """, path="transmogrifai_tpu/local/scoring.py")
+        assert "TX-R02" in _rules(findings)
+
+    def test_pass_outside_loop_is_silent(self):
+        # a pass-only handler NOT in a loop drops no record
+        findings = self._lint("""
+            def warm_cache():
+                try:
+                    enable_cache()
+                except (OSError, RuntimeError):
+                    pass
+        """)
+        assert "TX-R02" not in _rules(findings)
+
+    def test_outside_serving_paths_is_silent(self):
+        findings = self._lint("""
+            def drain(batches, fn):
+                for b in batches:
+                    try:
+                        fn(b)
+                    except Exception:
+                        continue
+        """, path="transmogrifai_tpu/utils/mydrain.py")
+        assert "TX-R02" not in _rules(findings)

@@ -488,31 +488,42 @@ class TestStreamingStopOnError:
         model.save(mdir)
         return mdir, recs
 
-    def test_stop_on_error_default(self, tmp_path, rng):
-        """Reference semantics: an error in a micro-batch stops the
-        whole stream (OpWorkflowRunner.scala:313-320)."""
-        import pytest as _pytest
-
+    def test_isolate_on_error_default(self, tmp_path, rng):
+        """Serving-robustness semantics: a failing micro-batch is
+        recorded and skipped, the stream continues, and the skip count
+        is surfaced (docs/serving_guardrails.md)."""
+        from transmogrifai_tpu.runtime import telemetry
         from transmogrifai_tpu.workflow.runner import (OpParams,
                                                        WorkflowRunner)
         mdir, recs = self._model_dir(tmp_path, rng)
         bad = [{"x": object()}]          # unscorable record
         batches = [recs[:5], bad, recs[5:10]]
         runner = WorkflowRunner()
-        out = []
-        with _pytest.raises(Exception):
-            for b in runner.streaming_score(
-                    batches, OpParams(model_location=mdir)):
-                out.append(b)
-        assert len(out) == 1             # stopped AT the bad batch
+        mark = telemetry.events_mark()
+        out = list(runner.streaming_score(
+            batches, OpParams(model_location=mdir)))
+        assert [len(b) for b in out] == [5, 5]
+        assert runner.last_stream_stats["skipped_batches"] == 1
+        assert runner.last_stream_stats["batches"] == 3
+        skipped = [e for e in telemetry.events_since(mark)
+                   if e["event"] == "stream_batch_skipped"]
+        assert len(skipped) == 1 and skipped[0]["batch"] == 1
 
-    def test_skip_on_error_opt_in(self, tmp_path, rng):
+    def test_stop_on_error_opt_in(self, tmp_path, rng):
+        """Reference semantics (OpWorkflowRunner.scala:313-320) stay
+        available behind stop_on_error=True."""
+        import pytest as _pytest
+
         from transmogrifai_tpu.workflow.runner import (OpParams,
                                                        WorkflowRunner)
         mdir, recs = self._model_dir(tmp_path, rng)
         bad = [{"x": object()}]
         batches = [recs[:5], bad, recs[5:10]]
         runner = WorkflowRunner()
-        out = list(runner.streaming_score(
-            batches, OpParams(model_location=mdir), stop_on_error=False))
-        assert [len(b) for b in out] == [5, 5]
+        out = []
+        with _pytest.raises(Exception):
+            for b in runner.streaming_score(
+                    batches, OpParams(model_location=mdir),
+                    stop_on_error=True):
+                out.append(b)
+        assert len(out) == 1             # stopped AT the bad batch

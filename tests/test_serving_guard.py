@@ -1,0 +1,584 @@
+"""Serving guardrail tests (transmogrifai_tpu/serving/{guard,sentinel}.py).
+
+The acceptance contracts, in the ISSUE's words:
+
+- with guardrails DISABLED (default), ``WorkflowModel.score()`` and
+  ``ScoringPlan.score()`` outputs are byte-identical to the unguarded
+  path;
+- with guardrails on, a batch containing k malformed rows scores the
+  n-k valid rows with ZERO recompiles (``plan_compiles()`` unchanged)
+  and returns k quarantine records with machine-readable reasons
+  (the admission matrix below walks every malformed-field class);
+- breaker trip -> host-fallback -> half-open recovery is demonstrated
+  under the fault injector with telemetry counters asserted;
+- the drift sentinel fires warn/degrade on synthetic shifted traffic
+  and stays ok on in-distribution traffic.
+"""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.checkers.raw_feature_filter import FeatureDistribution
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.features.columns import Dataset, FeatureColumn, \
+    PredictionColumn
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.runtime import FaultInjector, telemetry
+from transmogrifai_tpu.serving import (AdmissionPolicy, BreakerOpenError,
+                                       CircuitBreaker, DriftSentinel,
+                                       DriftThresholds, OutputGuard,
+                                       ScoringPlan, plan_compiles)
+from transmogrifai_tpu.serving.guard import (REASON_EXTRA_FIELD,
+                                             REASON_MISSING_FIELD,
+                                             REASON_NON_FINITE,
+                                             REASON_OUT_OF_VOCAB,
+                                             REASON_OUTPUT_NON_FINITE,
+                                             REASON_PROBABILITY_RANGE,
+                                             REASON_WRONG_TYPE)
+from transmogrifai_tpu.serving.sentinel import (DRIFT_FINGERPRINTS_FILE,
+                                                load_fingerprints)
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _records(n=160, seed=3):
+    rng = np.random.default_rng(seed)
+    cats = ["a", "b", "c"]
+    recs = []
+    for i in range(n):
+        x = float(rng.normal())
+        z = float(rng.uniform(0, 4))
+        recs.append({"x": x, "z": z,
+                     "cat": cats[int(rng.integers(0, len(cats)))],
+                     "label": float(x + 0.5 * rng.normal() > 0)})
+    return recs
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One fitted model per module: x (nullable Real), z (required
+    RealNN), cat (PickList) -> logistic prediction."""
+    recs = _records()
+    x = FeatureBuilder.of("x", Real).extract(
+        lambda r: r.get("x")).as_predictor()
+    z = FeatureBuilder.of("z", RealNN).extract(
+        lambda r: r.get("z")).as_predictor()
+    cat = FeatureBuilder.of("cat", PickList).extract(
+        lambda r: r.get("cat")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        label, transmogrify([x, z, cat])).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(recs).train(validate="off"))
+    return model, recs, pred.name
+
+
+def _result_arrays(scored, names):
+    out = []
+    for n in names:
+        col = scored[n]
+        out.append(np.asarray(col.data, dtype=np.float64))
+        if isinstance(col, PredictionColumn):
+            out.append(col.probability)
+            out.append(col.raw_prediction)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# disabled-path bitwise parity
+# ---------------------------------------------------------------------------
+
+class TestDisabledParity:
+    def test_plan_default_has_no_guard(self, trained):
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile()
+        assert plan.guard is None and plan.sentinel is None
+
+    def test_guard_module_presence_changes_nothing(self, trained):
+        """A fresh default plan and the model's cached plan produce
+        byte-identical output — the guarded machinery is fully inert
+        unless with_guardrails() is called."""
+        model, recs, pred = trained
+        batch = recs[:41]
+        a = ScoringPlan(model).compile().score(batch)
+        b = model.score(batch, engine="compiled")
+        for x, y in zip(_result_arrays(a, [pred]),
+                        _result_arrays(b, [pred])):
+            assert np.array_equal(x, y, equal_nan=True)
+
+    def test_guarded_clean_batch_is_bitwise_identical(self, trained):
+        """Well-formed traffic through an enabled guard produces the
+        exact bytes of the unguarded plan: admission passes every row,
+        the all-ones validity mask is what the unguarded path builds
+        anyway, and the output guard rewrites nothing."""
+        model, recs, pred = trained
+        batch = recs[:33]
+        plain = ScoringPlan(model).compile().score(batch)
+        guarded = ScoringPlan(model).compile().with_guardrails(
+            sentinel=False).score_guarded(batch)
+        assert guarded.quarantined == [] and guarded.invalidated == []
+        for x, y in zip(_result_arrays(plain, [pred]),
+                        _result_arrays(guarded.scored, [pred])):
+            assert np.array_equal(x, y, equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# schema admission matrix
+# ---------------------------------------------------------------------------
+
+class TestAdmissionMatrix:
+    """Each malformed-field class -> its machine-readable reason."""
+
+    def _guarded(self, model, policy=None):
+        return ScoringPlan(model).compile().with_guardrails(
+            admission=policy, sentinel=False)
+
+    def test_wrong_type_quarantined(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model)
+        res = plan.score_guarded(
+            [recs[0], {**recs[1], "x": "not-a-number"}])
+        assert [(r.row, r.code, r.feature) for r in res.quarantined] \
+            == [(1, REASON_WRONG_TYPE, "x")]
+
+    def test_missing_required_field_quarantined(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model)
+        bad = dict(recs[0])
+        del bad["z"]                      # z is RealNN: required
+        res = plan.score_guarded([bad, recs[1]])
+        assert [(r.row, r.code, r.feature) for r in res.quarantined] \
+            == [(0, REASON_MISSING_FIELD, "z")]
+
+    def test_non_finite_quarantined(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model)
+        res = plan.score_guarded(
+            [{**recs[0], "x": float("inf")},
+             {**recs[1], "z": float("nan")},      # NaN in a RealNN
+             recs[2]])
+        codes = {(r.row, r.code) for r in res.quarantined}
+        assert codes == {(0, REASON_NON_FINITE), (1, REASON_NON_FINITE)}
+
+    def test_nan_in_nullable_is_missing_not_quarantined(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model)
+        res = plan.score_guarded([{**recs[0], "x": float("nan")}])
+        assert res.quarantined == []      # nullable Real: NaN = missing
+
+    def test_out_of_vocab_quarantined_when_opted_in(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model, AdmissionPolicy(
+            reject_out_of_vocab=True))
+        res = plan.score_guarded(
+            [recs[0], {**recs[1], "cat": "zz-never-seen"}])
+        assert [(r.row, r.code, r.feature) for r in res.quarantined] \
+            == [(1, REASON_OUT_OF_VOCAB, "cat")]
+
+    def test_out_of_vocab_absorbed_by_default(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model)
+        res = plan.score_guarded([{**recs[0], "cat": "zz-never-seen"}])
+        assert res.quarantined == []      # OTHER column absorbs it
+
+    def test_extra_field_quarantined_when_opted_in(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model, AdmissionPolicy(
+            reject_extra_fields=True))
+        res = plan.score_guarded([{**recs[0], "rogue_key": 1}])
+        assert [(r.row, r.code, r.feature) for r in res.quarantined] \
+            == [(0, REASON_EXTRA_FIELD, "rogue_key")]
+
+    def test_raising_extract_fn_quarantined(self, trained):
+        model, recs, _ = trained
+        plan = self._guarded(model)
+        # the x extract fn is r.get("x"): a record that is not a dict
+        # makes every extract fn raise -> wrong_type per feature
+        res = plan.score_guarded([recs[0], object()])
+        assert res.quarantined
+        assert {r.code for r in res.quarantined} == {REASON_WRONG_TYPE}
+        assert {r.row for r in res.quarantined} == {1}
+
+    def test_valid_rows_score_with_zero_recompiles(self, trained):
+        """n-k valid rows score normally, k quarantine records come
+        back, and the malformed rows cost ZERO new XLA programs."""
+        model, recs, pred = trained
+        plan = self._guarded(model)
+        clean = recs[:8]
+        plan.score_guarded(clean)                 # warm the bucket
+        c0 = plan_compiles()
+        batch = [clean[0], {**clean[1], "x": "junk"}, clean[2],
+                 {**clean[3], "z": float("inf")}]
+        res = plan.score_guarded(batch)
+        assert plan_compiles() - c0 == 0          # same padded bucket
+        assert len(res.quarantined_rows) == 2
+        assert res.n_valid == 2
+        # valid rows carry real scores...
+        pcol = res.scored[pred]
+        assert np.isfinite(pcol.data[0]) and np.isfinite(pcol.data[2])
+        # ...and they equal the scores of an all-clean batch
+        clean_res = plan.score_guarded([clean[0], clean[1], clean[2],
+                                        clean[3]])
+        assert pcol.data[0] == clean_res.scored[pred].data[0]
+        assert pcol.data[2] == clean_res.scored[pred].data[2]
+        # quarantined rows are NaN, never garbage
+        assert np.isnan(pcol.data[1]) and np.isnan(pcol.data[3])
+        counters = telemetry.counters()
+        assert counters.get("serving_rows_quarantined", 0) >= 2
+        assert counters.get("serving_rows_scored", 0) >= 2
+
+    def test_columnar_dataset_admission(self, trained):
+        """Dataset input: non-finite numerics are caught columnar-side."""
+        model, recs, _ = trained
+        plan = self._guarded(model)
+        ds = Dataset({
+            "x": FeatureColumn.from_values(Real, [0.1, float("inf")]),
+            "z": FeatureColumn.from_values(RealNN, [1.0, 2.0]),
+            "cat": FeatureColumn.from_values(PickList, ["a", "b"]),
+        })
+        res = plan.score_guarded(ds)
+        assert [(r.row, r.code, r.feature) for r in res.quarantined] \
+            == [(1, REASON_NON_FINITE, "x")]
+
+    def test_score_function_guardrails(self, trained):
+        model, recs, _ = trained
+        from transmogrifai_tpu.local import ScoreFunction
+        fn = ScoreFunction(model, guardrails=True)
+        rows = fn.score_batch([recs[0], {**recs[1], "x": "junk"}])
+        assert "_guard" not in rows[0]
+        guard = rows[1]["_guard"]
+        assert guard[0]["code"] == REASON_WRONG_TYPE
+        assert guard[0]["kind"] == "quarantined"
+        assert fn.last_guard_result is not None
+
+
+# ---------------------------------------------------------------------------
+# output guard
+# ---------------------------------------------------------------------------
+
+class TestOutputGuard:
+    def test_nan_prediction_invalidated_under_fault_plan(self, trained):
+        model, recs, pred = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            sentinel=False)
+        with FaultInjector.plan("serving:output:guard:1=nan"):
+            res = plan.score_guarded(recs[:6])
+        assert [(r.row, r.code) for r in res.invalidated] \
+            == [(0, REASON_OUTPUT_NON_FINITE)]
+        assert np.isnan(res.scored[pred].data[0])
+        assert np.isfinite(res.scored[pred].data[1])
+        assert telemetry.counters()["serving_rows_invalidated"] == 1
+
+    def test_probability_range_check(self):
+        guard = OutputGuard()
+        col = PredictionColumn.from_arrays(
+            np.array([1.0, 0.0]),
+            probability=np.array([[0.2, 0.8], [1.7, -0.7]]))
+        ds = Dataset({"p": col})
+        out, reasons = guard.check(ds, ["p"])
+        assert [(r.row, r.code) for r in reasons] \
+            == [(1, REASON_PROBABILITY_RANGE)]
+        assert np.isnan(out["p"].data[1]) and out["p"].data[0] == 1.0
+
+    def test_quarantined_rows_not_double_reported(self, trained):
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            sentinel=False)
+        res = plan.score_guarded([{**recs[0], "x": "junk"}, recs[1]])
+        assert res.invalidated == []      # row 0 is quarantined only
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker + deadline
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        clock = {"t": 0.0}
+        b = CircuitBreaker(failure_threshold=2, cooldown_seconds=10.0,
+                           clock=lambda: clock["t"])
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(BreakerOpenError):
+            b.before_dispatch()
+        clock["t"] = 10.5
+        b.before_dispatch()               # cooldown elapsed -> probe
+        assert b.state == "half_open"
+        b.record_failure()                # probe failed -> reopen
+        assert b.state == "open"
+        clock["t"] = 21.0
+        b.before_dispatch()
+        b.record_success()                # probe succeeded -> closed
+        assert b.state == "closed"
+        assert ("half_open", "open") in b.transitions
+        assert ("half_open", "closed") in b.transitions
+
+    def test_trip_fallback_and_recovery(self, trained, monkeypatch):
+        """The acceptance drill: persistent device faults trip the
+        breaker, batches serve through the host fallback, and after
+        the cooldown a half-open probe recovers — telemetry counters
+        asserted throughout."""
+        monkeypatch.setenv("TX_RETRY_MAX_ATTEMPTS", "1")
+        model, recs, pred = trained
+        clock = {"t": 0.0}
+        breaker = CircuitBreaker(failure_threshold=2,
+                                 cooldown_seconds=30.0,
+                                 clock=lambda: clock["t"])
+        plan = ScoringPlan(model).compile().with_guardrails(
+            breaker=breaker, sentinel=False)
+        batch = recs[:7]
+        expected = ScoringPlan(model).compile().score(batch)[pred].data
+
+        with FaultInjector.plan("plan:device:dispatch:*=oom"):
+            r1 = plan.score_guarded(batch)    # failure 1: fallback
+            r2 = plan.score_guarded(batch)    # failure 2: trips OPEN
+            r3 = plan.score_guarded(batch)    # open: short-circuit
+        assert r1.used_host_fallback and r2.used_host_fallback
+        assert r3.used_host_fallback and r3.breaker_state == "open"
+        # host fallback served REAL scores the whole time
+        for r in (r1, r2, r3):
+            np.testing.assert_allclose(r.scored[pred].data, expected,
+                                       rtol=1e-9)
+        counters = telemetry.counters()
+        assert counters["breaker_trips"] == 1
+        assert counters["serving_device_failures"] == 2
+        assert counters["serving_breaker_short_circuits"] == 1
+        assert counters["serving_host_fallback_batches"] == 3
+
+        clock["t"] = 31.0                     # cooldown elapses
+        r4 = plan.score_guarded(batch)        # half-open probe, clean
+        assert not r4.used_host_fallback
+        assert breaker.state == "closed"
+        assert telemetry.counters()["breaker_recoveries"] == 1
+        assert telemetry.counters()["breaker_half_open"] == 1
+        np.testing.assert_array_equal(r4.scored[pred].data, expected)
+
+    def test_bug_class_errors_propagate(self, trained, monkeypatch):
+        """A genuine code defect must NOT be absorbed into the host
+        fallback — the TX-R01 discipline applies to serving too."""
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            sentinel=False)
+
+        def boom(inputs, mask):
+            raise KeyError("genuine bug")
+        monkeypatch.setattr(plan, "_device_fn", boom)
+        with pytest.raises(KeyError):
+            plan.score_guarded(recs[:4])
+
+    def test_deadline_hung_dispatch_falls_back(self, trained,
+                                               monkeypatch):
+        monkeypatch.setenv("TX_RETRY_MAX_ATTEMPTS", "1")
+        model, recs, pred = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            deadline_seconds=0.15, sentinel=False)
+        with FaultInjector.plan("plan:device:dispatch:1=hang:1.2"):
+            res = plan.score_guarded(recs[:5])
+        assert res.used_host_fallback
+        assert telemetry.counters()["serving_deadline_exceeded"] == 1
+        assert np.isfinite(res.scored[pred].data).all()
+
+
+# ---------------------------------------------------------------------------
+# drift sentinel
+# ---------------------------------------------------------------------------
+
+def _shifted(recs, dx):
+    return [{**r, "x": (r["x"] or 0.0) + dx} for r in recs]
+
+
+class TestDriftSentinel:
+    def test_fingerprints_saved_with_model(self, trained, tmp_path):
+        model, recs, _ = trained
+        mdir = str(tmp_path / "m")
+        model.save(mdir)
+        assert os.path.exists(os.path.join(mdir,
+                                           DRIFT_FINGERPRINTS_FILE))
+        fps = load_fingerprints(mdir)
+        by_name = {fp.name: fp for fp in fps}
+        assert set(by_name) == {"x", "z", "cat"}    # predictors only
+        assert by_name["x"].is_numeric
+        assert by_name["x"].histogram is not None
+        assert not by_name["cat"].is_numeric
+        assert by_name["cat"].counts.sum() > 0
+
+    def test_loaded_model_sentinel_detects_shift(self, trained,
+                                                 tmp_path):
+        model, recs, _ = trained
+        mdir = str(tmp_path / "m")
+        model.save(mdir)
+        from transmogrifai_tpu.workflow import WorkflowModel
+        loaded = WorkflowModel.load(mdir)
+        plan = ScoringPlan(loaded).compile().with_guardrails(
+            thresholds=DriftThresholds(warn=0.2, degrade=0.45,
+                                       min_rows=40))
+        assert plan.sentinel is not None
+        plan.score_guarded(_shifted(recs[:100], 8.0))
+        report = plan.drift_report()
+        assert report["enabled"] and report["status"] == "degrade"
+        worst = report["features"][0]
+        assert worst["feature"] == "x"
+        assert worst["jsDivergence"] >= 0.45
+        assert telemetry.counters().get("drift_degrade", 0) >= 1
+
+    def test_in_distribution_traffic_stays_ok(self, trained):
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            thresholds=DriftThresholds(min_rows=40))
+        plan.score_guarded(recs[:120])
+        report = plan.drift_report()
+        assert report["status"] == "ok"
+        assert report["rowsSeen"] == 120
+
+    def test_categorical_shift_detected(self, trained):
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            thresholds=DriftThresholds(warn=0.2, degrade=0.6,
+                                       min_rows=40))
+        weird = [{**r, "cat": "zz-new-world"} for r in recs[:100]]
+        plan.score_guarded(weird)
+        by_feature = {f["feature"]: f
+                      for f in plan.drift_report()["features"]}
+        assert by_feature["cat"]["status"] in ("warn", "degrade")
+
+    def test_small_samples_never_alarm(self, trained):
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            thresholds=DriftThresholds(min_rows=50))
+        plan.score_guarded(_shifted(recs[:10], 50.0))
+        assert plan.drift_report()["status"] == "ok"
+
+    def test_quarantined_rows_not_observed(self, trained):
+        """Admission-rejected rows must not pollute the drift sketches
+        (a flood of garbage would otherwise look like drift)."""
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile().with_guardrails(
+            thresholds=DriftThresholds(min_rows=1))
+        plan.score_guarded([recs[0], {**recs[1], "x": "junk"}])
+        assert plan.sentinel.rows_seen == 1
+
+    def test_report_without_sentinel(self, trained):
+        model, recs, _ = trained
+        plan = ScoringPlan(model).compile()
+        assert plan.drift_report() == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# js_divergence zero/empty guards (satellite)
+# ---------------------------------------------------------------------------
+
+class TestJsDivergenceGuards:
+    def test_zero_count_histograms(self):
+        a = FeatureDistribution(name="x", distribution=np.zeros(5))
+        b = FeatureDistribution(name="x", distribution=np.ones(5))
+        assert a.js_divergence(b) == 0.0
+        assert b.js_divergence(a) == 0.0
+        assert a.js_divergence(a) == 0.0
+
+    def test_empty_and_mismatched(self):
+        e = FeatureDistribution(name="x")
+        f = FeatureDistribution(name="x", distribution=np.ones(3))
+        assert e.js_divergence(e) == 0.0
+        assert e.js_divergence(f) == 0.0
+        g = FeatureDistribution(name="x", distribution=np.ones(5))
+        assert f.js_divergence(g) == 0.0   # width mismatch
+
+    def test_non_finite_bins_guarded(self):
+        nanny = FeatureDistribution(
+            name="x", distribution=np.array([1.0, np.nan, 2.0]))
+        inf = FeatureDistribution(
+            name="x", distribution=np.array([1.0, np.inf, 2.0]))
+        ok = FeatureDistribution(name="x", distribution=np.ones(3))
+        for d in (nanny, inf):
+            js = d.js_divergence(ok)
+            assert math.isfinite(js) and 0.0 <= js <= 1.0
+
+    def test_result_always_in_unit_interval(self):
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            a = FeatureDistribution(name="x",
+                                    distribution=rng.uniform(0, 5, 16))
+            b = FeatureDistribution(name="x",
+                                    distribution=rng.uniform(0, 5, 16))
+            js = a.js_divergence(b)
+            assert 0.0 <= js <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# streaming + CLI integration
+# ---------------------------------------------------------------------------
+
+class TestStreamingGuardrails:
+    def test_guarded_stream_quarantines_instead_of_skipping(
+            self, trained, tmp_path):
+        from transmogrifai_tpu.workflow.runner import (OpParams,
+                                                       WorkflowRunner)
+        model, recs, _ = trained
+        mdir = str(tmp_path / "m")
+        model.save(mdir)
+        runner = WorkflowRunner()
+        batches = [recs[:5],
+                   [recs[5], {**recs[6], "x": "junk"}],
+                   recs[7:10]]
+        out = list(runner.streaming_score(
+            batches, OpParams(model_location=mdir), guardrails=True))
+        assert [len(b) for b in out] == [5, 2, 3]
+        assert runner.last_stream_stats["skipped_batches"] == 0
+        assert "_guard" in out[1][1] and "_guard" not in out[1][0]
+
+
+class TestCliGuardrails:
+    def _save(self, trained, tmp_path):
+        model, recs, _ = trained
+        mdir = str(tmp_path / "model")
+        model.save(mdir)
+        return mdir, recs
+
+    def _csv(self, tmp_path, recs, dx=0.0):
+        p = tmp_path / "in.csv"
+        p.write_text("x,z,cat\n" + "\n".join(
+            f"{(r['x'] or 0) + dx},{r['z']},{r['cat']}" for r in recs))
+        return str(p)
+
+    def test_guarded_scoring_reports_counts(self, trained, tmp_path,
+                                            capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        mdir, recs = self._save(trained, tmp_path)
+        csv = self._csv(tmp_path, recs[:60])
+        assert cli_main(["score", "--model", mdir,
+                         "--input", csv]) == 0
+        out = capsys.readouterr().out
+        assert "guardrails:" in out and "0 quarantined" in out
+        assert "drift sentinel: status=ok" in out
+
+    def test_drift_degrade_exits_2(self, trained, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        mdir, recs = self._save(trained, tmp_path)
+        csv = self._csv(tmp_path, recs[:100], dx=9.0)
+        rc = cli_main(["score", "--model", mdir, "--input", csv,
+                       "--drift-degrade", "0.3"])
+        assert rc == 2
+        assert "DEGRADE" in capsys.readouterr().out
+
+    def test_no_sentinel_opt_out(self, trained, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+        mdir, recs = self._save(trained, tmp_path)
+        csv = self._csv(tmp_path, recs[:100], dx=9.0)
+        assert cli_main(["score", "--model", mdir, "--input", csv,
+                         "--no-sentinel"]) == 0
+        assert "drift sentinel" not in capsys.readouterr().out

@@ -34,7 +34,28 @@ from ..types import FeatureType, OPNumeric
 from ..utils.histogram import StreamingHistogram
 
 __all__ = ["RawFeatureFilter", "FeatureDistribution",
-           "RawFeatureFilterResults", "ExclusionReason"]
+           "RawFeatureFilterResults", "ExclusionReason",
+           "numeric_histogram_js"]
+
+
+def numeric_histogram_js(ha: Optional[StreamingHistogram],
+                         hb: Optional[StreamingHistogram],
+                         bins: int) -> float:
+    """JS divergence of two numeric StreamingHistograms over shared
+    breakpoints. Shared by the train-time RawFeatureFilter and the
+    serve-time drift sentinel (serving/sentinel.py), so "shift" means
+    the same thing in both places. Empty histograms compare as 0.0."""
+    if ha is None or hb is None or ha.total == 0 or hb.total == 0 \
+            or ha.centroids.size == 0 or hb.centroids.size == 0:
+        return 0.0
+    lo = min(ha.centroids.min(), hb.centroids.min())
+    hi = max(ha.centroids.max(), hb.centroids.max())
+    if hi <= lo:
+        return 0.0
+    breaks = np.linspace(lo, hi, bins + 1)[1:-1]
+    pa = FeatureDistribution(name="a", distribution=ha.density(breaks))
+    pb = FeatureDistribution(name="b", distribution=hb.density(breaks))
+    return pa.js_divergence(pb)
 
 
 @dataclass
@@ -54,12 +75,19 @@ class FeatureDistribution:
 
     def js_divergence(self, other: "FeatureDistribution") -> float:
         """Jensen-Shannon divergence of the two normalized histograms
-        (reference FeatureDistribution.jsDivergence)."""
-        p, q = self.distribution, other.distribution
+        (reference FeatureDistribution.jsDivergence).
+
+        Empty, zero-count and non-finite histograms (a feature that was
+        all-null on one side, a poisoned sketch) return 0.0 — "no
+        evidence of shift" — instead of dividing by a zero/NaN bin sum
+        and poisoning every downstream threshold comparison."""
+        p = np.asarray(self.distribution, dtype=np.float64)
+        q = np.asarray(other.distribution, dtype=np.float64)
         if p.size == 0 or q.size == 0 or p.size != q.size:
             return 0.0
         ps, qs = p.sum(), q.sum()
-        if ps <= 0 or qs <= 0:
+        if not np.isfinite(ps) or not np.isfinite(qs) \
+                or ps <= 0 or qs <= 0:
             return 0.0
         p, q = p / ps, q / qs
         m = 0.5 * (p + q)
@@ -67,7 +95,9 @@ class FeatureDistribution:
             def kl(a, b):
                 r = np.where((a > 0) & (b > 0), a * np.log2(a / b), 0.0)
                 return float(np.sum(r))
-            return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+            js = 0.5 * kl(p, m) + 0.5 * kl(q, m)
+        # interpolation/rounding can leave js a hair outside [0, 1]
+        return min(max(js, 0.0), 1.0) if np.isfinite(js) else 0.0
 
     def to_json(self) -> dict:
         return {"name": self.name, "count": self.count, "nulls": self.nulls,
@@ -189,20 +219,9 @@ class RawFeatureFilter:
                     ) -> float:
         """JS divergence of two numeric histograms over shared quantile
         breakpoints (reference compares StreamingHistogram densities)."""
-        ha: StreamingHistogram = getattr(a, "_histogram", None)
-        hb: StreamingHistogram = getattr(b, "_histogram", None)
-        if ha is None or hb is None or ha.total == 0 or hb.total == 0:
-            return 0.0
-        lo = min(ha.centroids.min(), hb.centroids.min())
-        hi = max(ha.centroids.max(), hb.centroids.max())
-        if hi <= lo:
-            return 0.0
-        breaks = np.linspace(lo, hi, self.bins + 1)[1:-1]
-        pa = FeatureDistribution(name=a.name,
-                                 distribution=ha.density(breaks))
-        pb = FeatureDistribution(name=b.name,
-                                 distribution=hb.density(breaks))
-        return pa.js_divergence(pb)
+        return numeric_histogram_js(getattr(a, "_histogram", None),
+                                    getattr(b, "_histogram", None),
+                                    self.bins)
 
     # -- main entry ---------------------------------------------------------
     def compute_exclusions(

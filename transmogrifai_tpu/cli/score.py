@@ -43,6 +43,18 @@ def add_score_parser(sub) -> None:
                          "throughput and print one JSON metric line")
     sc.add_argument("--rows", type=int, default=2000,
                     help="benchmark batch size (--bench; default 2000)")
+    sc.add_argument("--no-guardrails", action="store_true",
+                    help="disable schema admission / output guards / "
+                         "breaker (guardrails are ON for CLI scoring; "
+                         "docs/serving_guardrails.md)")
+    sc.add_argument("--no-sentinel", action="store_true",
+                    help="disable the online drift sentinel (no drift "
+                         "summary, never exit 2 on drift)")
+    sc.add_argument("--drift-warn", type=float, default=None,
+                    help="drift sentinel warn threshold (JS divergence)")
+    sc.add_argument("--drift-degrade", type=float, default=None,
+                    help="drift sentinel degrade threshold — crossing "
+                         "it makes the command exit 2")
 
 
 def _read_records(path: str) -> List[dict]:
@@ -146,18 +158,83 @@ def run_score(args) -> int:
     from ..workflow import WorkflowModel
     model = WorkflowModel.load(args.model)
     records = _read_records(args.input)
+    guard_report = None
+    drift = None
     t0 = time.perf_counter()
-    scored = model.score(records, engine=args.engine)
+    if args.engine == "compiled" and not (args.no_guardrails
+                                          and args.no_sentinel):
+        # CLI scoring runs guarded by default: malformed rows are
+        # quarantined with reasons instead of crashing the run, and
+        # the drift sentinel compares the batch against training
+        from ..serving import DriftThresholds, ScoringPlan
+        thresholds = None
+        if args.drift_warn is not None or args.drift_degrade is not None:
+            d = DriftThresholds()
+            thresholds = DriftThresholds(
+                warn=args.drift_warn if args.drift_warn is not None
+                else d.warn,
+                degrade=args.drift_degrade
+                if args.drift_degrade is not None else d.degrade)
+        plan = ScoringPlan(model).compile()
+        if args.no_guardrails:
+            # sentinel only: no admission/breaker, just drift watching
+            from ..serving.sentinel import DriftSentinel
+            plan.sentinel = DriftSentinel.for_model(
+                model, thresholds=thresholds)
+        else:
+            plan.with_guardrails(sentinel=not args.no_sentinel,
+                                 thresholds=thresholds)
+        result = plan.score_guarded(records)
+        scored, guard_report = result.scored, result
+        if not args.no_sentinel:
+            drift = plan.drift_report()
+    else:
+        scored = model.score(records, engine=args.engine)
     dt = time.perf_counter() - t0
     if args.output:
         from ..local.scoring import _unbox
         names = [f.name for f in model.result_features]
-        rows = [{n: _unbox(scored[n].boxed(i)) for n in names}
-                for i in range(scored.n_rows)]
+        bad_rows = set()
+        guard_by_row = {}
+        if guard_report is not None:
+            for r in (guard_report.quarantined
+                      + guard_report.invalidated):
+                bad_rows.add(r.row)
+                guard_by_row.setdefault(r.row, []).append(r.to_json())
+        rows = []
+        for i in range(scored.n_rows):
+            if i in bad_rows:
+                # guarded-out rows ship their reasons, not garbage
+                rows.append({**{n: None for n in names},
+                             "_guard": guard_by_row[i]})
+            else:
+                rows.append({n: _unbox(scored[n].boxed(i))
+                             for n in names})
         with open(args.output, "w") as fh:
             json.dump(rows, fh)
     print(f"scored {scored.n_rows} rows in {dt:.3f}s "
           f"({scored.n_rows / max(dt, 1e-9):.0f} rows/s, "
           f"engine={args.engine})"
           + (f" -> {args.output}" if args.output else ""))
+    if guard_report is not None:
+        nq = len(guard_report.quarantined_rows)
+        ni = len(guard_report.invalidated_rows)
+        print(f"guardrails: {scored.n_rows - nq - ni} ok, "
+              f"{nq} quarantined, {ni} invalidated"
+              + (" (host fallback)" if guard_report.used_host_fallback
+                 else ""))
+        for r in (guard_report.quarantined
+                  + guard_report.invalidated)[:10]:
+            print(f"  row {r.row}: {r.code} [{r.feature}] {r.detail}")
+    if drift is not None and drift.get("enabled"):
+        worst = drift["features"][0] if drift["features"] else None
+        print(f"drift sentinel: status={drift['status']} over "
+              f"{drift['rowsSeen']} rows"
+              + (f"; worst feature {worst['feature']} "
+                 f"js={worst['jsDivergence']:.3f}" if worst else ""))
+        if drift["status"] == "degrade":
+            print("drift sentinel: DEGRADE threshold crossed — "
+                  "scored traffic no longer matches training "
+                  "(exit 2; --no-sentinel to ignore)")
+            return 2
     return 0

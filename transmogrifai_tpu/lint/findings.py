@@ -54,6 +54,10 @@ RULES: Dict[str, tuple] = {
                       "serving hot path swallows XlaRuntimeError "
                       "without re-raise, quarantine or a recorded "
                       "fallback"),
+    "TX-R02": (ERROR, "serving-path record drop without a recorded "
+                      "reason: a silent continue / pass-only handler "
+                      "on exception in serving/ or local/scoring.py "
+                      "discards rows invisibly"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

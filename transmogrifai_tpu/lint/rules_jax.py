@@ -32,6 +32,14 @@ call) are caught here in milliseconds:
   vocabulary (quarantine / classify_error / a recorded fallback /
   maybe_inject) — hides XlaRuntimeErrors, silently degrading searches
   to the slow path (docs/resilience.md).
+- TX-R02 silent record drop (``serving/`` files + ``local/scoring.py``
+  only): an except handler that drops the current record — a
+  ``continue`` out of the scoring loop, or a ``pass``-only body inside
+  a loop — without recording a reason (quarantine / telemetry count or
+  event / a ``note_*``/``record*`` call / logging). Rows discarded on
+  exception with no machine-readable trace are the serving twin of a
+  swallowed XlaRuntimeError: traffic silently disappears
+  (docs/serving_guardrails.md).
 - TX-J07 grid value into a compile key: inside a fit kernel (a function
   with a ``grid`` parameter / a ``fold_grid`` name), a value derived
   from the hyperparameter grid passed for a ``static_argnames``
@@ -256,6 +264,47 @@ def _is_resilience_path(path: str) -> bool:
 _RECOVERY_NAME_PARTS = ("quarantine", "classify", "fallback",
                         "maybe_inject")
 
+#: TX-R02 accepts a wider recording vocabulary than TX-R01: dropping a
+#: record is sometimes the right call (malformed row), but the drop
+#: must leave a trace — a quarantine reason, a telemetry counter/event,
+#: a ``note_*``/``record*`` bookkeeping call, or at least a log line
+_DROP_RECORD_NAME_PARTS = _RECOVERY_NAME_PARTS + (
+    "record", "note", "count", "event", "warn", "log", "error")
+
+
+def _is_record_drop_path(path: str) -> bool:
+    """serving/ files + local/scoring.py get the TX-R02 silent-record-
+    drop rule: the code paths rows flow through on their way to or
+    from a model."""
+    import re
+    parts = re.split(r"[/\\]", path)
+    return "serving" in parts or (
+        len(parts) >= 2 and parts[-2] == "local"
+        and parts[-1] == "scoring.py")
+
+
+def _handler_drops_silently(h: ast.ExceptHandler,
+                            in_loop: bool) -> bool:
+    """Does the handler drop the current record with no recorded
+    reason — a ``continue``, or (inside a loop) a ``pass``-only body —
+    and neither re-raise nor call anything from the recording
+    vocabulary?"""
+    has_continue = any(isinstance(sub, ast.Continue)
+                       for sub in ast.walk(h))
+    pass_only = in_loop and all(isinstance(s, ast.Pass) for s in h.body)
+    if not has_continue and not pass_only:
+        return False
+    for sub in ast.walk(h):
+        if isinstance(sub, ast.Raise):
+            return False
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else "")
+            if any(p in name for p in _DROP_RECORD_NAME_PARTS):
+                return False
+    return True
+
 
 def _handler_is_broad(h: ast.ExceptHandler) -> bool:
     """Bare ``except:`` or ``except Exception`` (possibly in a
@@ -302,6 +351,7 @@ class _Visitor(ast.NodeVisitor):
         self.path = path
         self.serving = _is_serving_path(path)
         self.resilience = _is_resilience_path(path)
+        self.record_drop = _is_record_drop_path(path)
         self.al = al
         self.findings: List[LintFinding] = []
         #: stack of enclosing FunctionDefs, innermost last
@@ -519,6 +569,25 @@ class _Visitor(ast.NodeVisitor):
                              "route the family through "
                              "RuntimeContext.quarantine / a recorded "
                              "fallback reason")
+        # TX-R02: a serving-path handler that drops the current record
+        # (continue / pass-only inside a loop) without recording WHY —
+        # rows vanishing from scored traffic with no quarantine reason,
+        # no counter, no log line (docs/serving_guardrails.md)
+        if self.record_drop:
+            for h in node.handlers:
+                if _handler_drops_silently(h, in_loop=self.loop_depth > 0):
+                    self.add(
+                        "TX-R02", h,
+                        "record dropped on exception with no recorded "
+                        "reason in a serving path (silent "
+                        "continue/pass) — scored traffic shrinks "
+                        "invisibly",
+                        ERROR,
+                        hint="quarantine the row with a "
+                             "machine-readable reason (serving/guard"
+                             ".py GuardReason), bump a telemetry "
+                             "counter/event, or at minimum log the "
+                             "drop before skipping")
         self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:

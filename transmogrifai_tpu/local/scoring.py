@@ -54,7 +54,7 @@ class ScoreFunction:
     (reference model.scoreFunction, OpWorkflowModelLocal.scala:88)."""
 
     def __init__(self, model, result_features: Optional[Sequence[Feature]]
-                 = None):
+                 = None, guardrails: Any = False):
         self.model = model
         self.result_features = list(result_features
                                     or model.result_features)
@@ -68,6 +68,13 @@ class ScoreFunction:
         #: count and the per-feature breakdown are exposed here)
         self.extract_errors = 0
         self.extract_error_fields: Dict[str, int] = {}
+        #: serving guardrails (docs/serving_guardrails.md): False = off
+        #: (byte-identical legacy behavior), True = defaults, or a dict
+        #: of ``ScoringPlan.with_guardrails`` kwargs. Guarded batches
+        #: attach a ``"_guard"`` entry to quarantined/invalidated rows
+        #: and stash the full ledger on ``last_guard_result``.
+        self.guardrails = guardrails
+        self.last_guard_result = None
         self._compiled_plan = None
         self._compiled_plan_error = None
 
@@ -126,10 +133,19 @@ class ScoreFunction:
         if self._compiled_plan is None and self._compiled_plan_error is None:
             from ..serving import ScoringPlan
             try:
-                builder = getattr(self.model, "scoring_plan", None)
-                # share the model's cached plan when it has one
-                self._compiled_plan = builder() if callable(builder) \
-                    else ScoringPlan(self.model).compile()
+                if self.guardrails:
+                    # guarded scoring mutates plan state (breaker,
+                    # sentinel sketches): use a DEDICATED plan, never
+                    # the model's shared cached one
+                    kwargs = (self.guardrails
+                              if isinstance(self.guardrails, dict) else {})
+                    self._compiled_plan = ScoringPlan(
+                        self.model).compile().with_guardrails(**kwargs)
+                else:
+                    builder = getattr(self.model, "scoring_plan", None)
+                    # share the model's cached plan when it has one
+                    self._compiled_plan = builder() if callable(builder) \
+                        else ScoringPlan(self.model).compile()
             except Exception as e:
                 self._compiled_plan_error = e
                 _log.warning(
@@ -152,6 +168,8 @@ class ScoreFunction:
         plan = self._scoring_plan()
         if plan is None:
             return [self(r) for r in records]
+        if self.guardrails:
+            return self._score_batch_guarded(plan, records)
         from ..features.columns import Dataset, FeatureColumn
         boxed = [self._extract_raw(r) for r in records]
         ds = Dataset({
@@ -163,6 +181,35 @@ class ScoreFunction:
         return [{f.name: _unbox(col.boxed(i))
                  for f, col in zip(self.result_features, cols)}
                 for i in range(len(records))]
+
+    def _score_batch_guarded(self, plan, records
+                             ) -> List[Dict[str, Any]]:
+        """Guarded batch path: admission + output guards + breaker
+        (docs/serving_guardrails.md). Quarantined/invalidated rows
+        carry a ``"_guard"`` entry with machine-readable reasons
+        instead of silently emitting NaN scores."""
+        result = plan.score_guarded(records)
+        self.last_guard_result = result
+        cols = [result.scored[f.name] for f in self.result_features]
+        by_row: Dict[int, List] = {}
+        for r in result.quarantined:
+            by_row.setdefault(r.row, []).append(
+                {"kind": "quarantined", **r.to_json()})
+        for r in result.invalidated:
+            by_row.setdefault(r.row, []).append(
+                {"kind": "invalidated", **r.to_json()})
+        out = []
+        for i in range(len(records)):
+            if i in by_row:
+                # no garbage scores for guarded-out rows: the reasons
+                # ARE the payload (NaN predictions don't box anyway)
+                row = {f.name: None for f in self.result_features}
+                row["_guard"] = by_row[i]
+            else:
+                row = {f.name: _unbox(col.boxed(i))
+                       for f, col in zip(self.result_features, cols)}
+            out.append(row)
+        return out
 
 
 def score_function_for(model) -> ScoreFunction:

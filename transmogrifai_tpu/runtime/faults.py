@@ -16,12 +16,17 @@ with the grammar ``scope:name:site:n=fault``:
 
 - ``scope``  — ``family`` (name = model family class), ``rung``
   (name = rung index), ``workflow`` (save/load path), ``plan``
-  (serving ScoringPlan; name = stage class).
+  (serving ScoringPlan; name = stage class, or ``device`` for the
+  fused-program dispatch), ``serving`` (the guardrail layer,
+  docs/serving_guardrails.md).
 - ``name``   — exact match or ``*``.
 - ``site``   — where the probe sits: ``dispatch`` (per-family device
-  eval, once per retry attempt), ``fit`` (host-path candidate fit),
-  ``metric`` (after a family's metric matrix lands), ``boundary``
-  (between racing rungs), ``save``, ``compile``.
+  eval or the serving plan's fused-program dispatch, once per retry
+  attempt), ``fit`` (host-path candidate fit), ``metric`` (after a
+  family's metric matrix lands), ``boundary`` (between racing rungs),
+  ``save``, ``compile``, ``guard`` (``serving:output:guard`` — a
+  ``nan`` fault poisons one scored row so the output guard's
+  invalidate path is provable).
 - ``n``      — fire at the Nth matching probe (1-based), or ``*`` for
   every one.
 - ``fault``  — ``oom`` (RESOURCE_EXHAUSTED-shaped — transient, then

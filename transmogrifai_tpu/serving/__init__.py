@@ -1,7 +1,22 @@
 """Compiled serving: fuse a fitted workflow DAG into batched, jitted,
-shape-bucketed XLA scoring programs (docs/serving.md)."""
+shape-bucketed XLA scoring programs (docs/serving.md), with optional
+serving guardrails — schema admission, per-row quarantine, output
+guards, a scoring circuit breaker and an online drift sentinel
+(docs/serving_guardrails.md)."""
+from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
+                    GuardedScoreResult, GuardReason, OutputGuard,
+                    SchemaGuard, ServingGuard)
 from .plan import (PlanCompileError, PlanCoverage, ScoringPlan,
                    bucket_for, plan_compiles)
+from .sentinel import (DriftSentinel, DriftThresholds,
+                       FeatureFingerprint, compute_fingerprints,
+                       load_fingerprints, save_fingerprints)
 
 __all__ = ["ScoringPlan", "PlanCoverage", "PlanCompileError",
-           "plan_compiles", "bucket_for"]
+           "plan_compiles", "bucket_for",
+           "AdmissionPolicy", "SchemaGuard", "OutputGuard",
+           "CircuitBreaker", "BreakerOpenError", "ServingGuard",
+           "GuardReason", "GuardedScoreResult",
+           "DriftSentinel", "DriftThresholds", "FeatureFingerprint",
+           "compute_fingerprints", "save_fingerprints",
+           "load_fingerprints"]

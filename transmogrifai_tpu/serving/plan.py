@@ -50,6 +50,9 @@ from ..runtime.faults import maybe_inject
 from ..runtime.retry import RetryPolicy
 from ..stages.base import Transformer
 from ..types import Prediction
+from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
+                    GuardedScoreResult, GuardReason, OutputGuard,
+                    ServingGuard, _invalidate_rows)
 
 _log = logging.getLogger(__name__)
 
@@ -151,6 +154,15 @@ class ScoringPlan:
         self._plan_id = next(_PLAN_IDS)
         self._compiled = False
         self.coverage = PlanCoverage()
+        #: serving guardrails (guard.py) — None means DISABLED: the
+        #: default score path is the exact pre-guard code, byte-
+        #: identical output (asserted in tests/test_serving_guard.py)
+        self.guard: Optional[ServingGuard] = None
+        #: online drift sentinel (sentinel.py) — None means disabled
+        self.sentinel = None
+        #: GuardedScoreResult of the most recent guarded batch
+        self.last_guard_result: Optional[GuardedScoreResult] = None
+        self._deadline_pool = None
 
     # -- compilation -------------------------------------------------------
     def compile(self) -> "ScoringPlan":
@@ -400,20 +412,185 @@ class ScoringPlan:
         # recompiles cannot happen here, each bucket shape is cached
         self._device_fn = jax.jit(run, donate_argnums=donate)  # tx-lint: disable=TX-J02,TX-J06
 
+    # -- guardrails --------------------------------------------------------
+    def with_guardrails(self, admission: Optional[AdmissionPolicy] = None,
+                        output_guard: Optional[OutputGuard] = None,
+                        breaker: Optional[CircuitBreaker] = None,
+                        deadline_seconds: Optional[float] = None,
+                        sentinel: Any = True,
+                        thresholds=None) -> "ScoringPlan":
+        """Enable the serving guardrails (docs/serving_guardrails.md):
+        schema admission + output guards + circuit breaker + per-batch
+        deadline, and (``sentinel=True``, the default here) the online
+        drift sentinel. Guardrails are OFF unless this is called — the
+        default ``score()`` path is byte-identical to the unguarded
+        plan. ``sentinel`` may also be a prebuilt
+        :class:`~.sentinel.DriftSentinel`."""
+        self.guard = ServingGuard(self.model, admission=admission,
+                                  output_guard=output_guard,
+                                  breaker=breaker,
+                                  deadline_seconds=deadline_seconds)
+        from .sentinel import DriftSentinel
+        if isinstance(sentinel, DriftSentinel):
+            self.sentinel = sentinel
+        elif sentinel:
+            self.sentinel = DriftSentinel.for_model(
+                self.model, thresholds=thresholds)
+            if self.sentinel is None:
+                _log.warning(
+                    "drift sentinel unavailable: the model carries no "
+                    "training fingerprints (re-save it with this build "
+                    "or train in-process); serving without drift "
+                    "monitoring")
+        return self
+
+    def drift_report(self) -> dict:
+        """Per-feature JS divergence of scored traffic vs training
+        (sentinel.py). ``{"enabled": False}`` when no sentinel is
+        attached."""
+        if self.sentinel is None:
+            return {"enabled": False}
+        report = self.sentinel.drift_report()
+        report["enabled"] = True
+        return report
+
     # -- execution ---------------------------------------------------------
     def score(self, data: Any) -> Dataset:
         """Score a Dataset / record iterable / DataReader through the
         plan; returns the raw + result feature columns (the
-        ``Workflow.score`` contract). Compiles lazily on first use."""
+        ``Workflow.score`` contract). Compiles lazily on first use.
+
+        With guardrails enabled (:meth:`with_guardrails`) this routes
+        through :meth:`score_guarded`, stashing the quarantine/
+        invalidation ledger on ``last_guard_result``."""
+        if self.guard is not None or self.sentinel is not None:
+            return self.score_guarded(data).scored
         self.compile()
         from ..workflow.workflow import _generate_raw_data
         ds = _generate_raw_data(self._raw_features, data,
                                 require_responses=False)
         return self.score_raw_dataset(ds)
 
-    def score_raw_dataset(self, ds: Dataset) -> Dataset:
+    def score_guarded(self, data: Any) -> GuardedScoreResult:
+        """Guarded batch scoring: admission -> masked device scoring
+        (or host fallback behind the breaker) -> output guards ->
+        sentinel observation. The returned Dataset keeps the FULL row
+        count; quarantined/invalidated rows carry NaN outputs and one
+        machine-readable reason each."""
+        self.compile()
+        from ..readers.data_readers import DataReader
+        from ..workflow.workflow import _generate_raw_data
+        if self.guard is not None \
+                and not isinstance(data, (Dataset, DataReader)):
+            # record admission materializes the raw Dataset itself:
+            # malformed fields become boxable placeholders instead of
+            # crashing strict extraction, and the row is masked out
+            ds, reasons = self.guard.schema.admit_records(list(data))
+            return self._score_guarded_raw(ds, pre_reasons=reasons,
+                                           columnar_admission=False)
+        ds = _generate_raw_data(self._raw_features, data,
+                                require_responses=False)
+        return self._score_guarded_raw(ds)
+
+    def _score_guarded_raw(self, ds: Dataset,
+                           pre_reasons: Optional[List[GuardReason]] = None,
+                           columnar_admission: bool = True
+                           ) -> GuardedScoreResult:
+        """Core guarded path over a materialized raw Dataset."""
+        n = ds.n_rows
+        quarantined: List[GuardReason] = list(pre_reasons or [])
+        if self.guard is not None and columnar_admission:
+            ds, more = self.guard.schema.admit_dataset(ds)
+            quarantined.extend(more)
+        qmask = np.zeros(n, dtype=bool)
+        for r in quarantined:
+            if 0 <= r.row < n:
+                qmask[r.row] = True
+        valid = (~qmask).astype(np.float64)
+
+        breaker = self.guard.breaker if self.guard is not None else None
+        used_fallback = False
+        try:
+            if breaker is not None:
+                breaker.before_dispatch()
+            scored = self.score_raw_dataset(ds, valid_mask=valid)
+            if breaker is not None:
+                breaker.record_success()
+        except BreakerOpenError as e:
+            used_fallback = True
+            _telemetry.count("serving_breaker_short_circuits")
+            _log.warning("scoring breaker open; host fallback: %s", e)
+            scored = self._score_host_fallback(ds)
+        except Exception as e:
+            # device dispatch failed after retries: trip the breaker
+            # and serve this batch through the host columnar fallback
+            # (classified + recorded — the TX-R01/TX-R02 contract)
+            from ..runtime.errors import BUG, classify_error
+            if breaker is None or classify_error(e) == BUG:
+                raise
+            breaker.record_failure()
+            used_fallback = True
+            _telemetry.count("serving_device_failures")
+            _telemetry.event("serving_fallback",
+                             error=f"{type(e).__name__}: {e}",
+                             breaker=breaker.state)
+            _log.warning(
+                "device scoring failed (%s: %s); host fallback "
+                "(breaker %s)", type(e).__name__, e, breaker.state)
+            scored = self._score_host_fallback(ds)
+
+        # deterministic test hook: poison one output row so the output
+        # guard's invalidate path is provable under TX_FAULT_PLAN
+        if maybe_inject("serving", "output", "guard") == "nan":
+            scored = _poison_first_valid_row(scored, self._result_names,
+                                             qmask)
+
+        invalidated: List[GuardReason] = []
+        if self.guard is not None:
+            scored, invalidated = self.guard.output.check(
+                scored, self._result_names, skip_rows=qmask)
+        if qmask.any():
+            # quarantined rows were masked out of the device batch;
+            # their zeroed outputs are garbage by construction — NaN
+            # them so nothing downstream mistakes them for scores
+            scored = _invalidate_rows(scored, self._result_names, qmask)
+
+        if self.sentinel is not None:
+            obs = ds.take(np.flatnonzero(~qmask)) if qmask.any() else ds
+            self.sentinel.observe_dataset(obs)
+
+        n_bad = int(qmask.sum())
+        _telemetry.count("serving_rows_scored", n - n_bad)
+        if n_bad:
+            _telemetry.count("serving_rows_quarantined", n_bad)
+        if invalidated:
+            _telemetry.count("serving_rows_invalidated",
+                             len({r.row for r in invalidated}))
+        result = GuardedScoreResult(
+            scored=scored, quarantined=quarantined,
+            invalidated=invalidated, used_host_fallback=used_fallback,
+            breaker_state=(breaker.state if breaker is not None
+                           else CircuitBreaker.CLOSED))
+        self.last_guard_result = result
+        return result
+
+    def _score_host_fallback(self, ds: Dataset) -> Dataset:
+        """The existing host columnar path (per-stage numpy kernels,
+        layer by layer) as a whole-batch fallback when the device is
+        unavailable — same outputs as ``engine="columnar"``."""
+        from ..workflow.workflow import _fit_and_transform_layers
+        _telemetry.count("serving_host_fallback_batches")
+        layers = topo_layers(self.model.result_features)
+        scored, _ = _fit_and_transform_layers(layers, ds, fit=False)
+        return self._select_outputs(scored)
+
+    def score_raw_dataset(self, ds: Dataset,
+                          valid_mask: Optional[np.ndarray] = None
+                          ) -> Dataset:
         """Score an already-materialized raw Dataset (all raw feature
-        columns present; absent responses NaN-filled by the caller)."""
+        columns present; absent responses NaN-filled by the caller).
+        ``valid_mask`` (guarded path) zeroes quarantined rows inside
+        the padded device batch — same shapes, zero recompiles."""
         self.compile()
         n = ds.n_rows
         # phase "pre": numpy fallbacks feeding the device graph
@@ -433,7 +610,10 @@ class ScoringPlan:
             inputs = tuple(_pad_rows(arr[start:stop], bucket)
                            for _, arr in encoded)
             mask = np.zeros(bucket, dtype=np.float64)
-            mask[:rows] = 1.0
+            if valid_mask is None:
+                mask[:rows] = 1.0
+            else:
+                mask[:rows] = valid_mask[start:stop]
             _COMPILE_KEYS.add((self._plan_id, bucket))
             outs = self._dispatch_device(inputs, mask)
             for i, o in enumerate(outs):
@@ -447,11 +627,38 @@ class ScoringPlan:
         """One fused-program dispatch behind the runtime retry policy:
         a preemption/RESOURCE_EXHAUSTED-shaped backend error retries
         with backoff (runtime/retry.py) instead of failing the serving
-        request; persistent errors propagate to the caller."""
+        request; persistent errors propagate to the caller. With a
+        guardrail deadline configured, the whole dispatch (retries
+        included) runs under a per-batch wall-clock budget — a hung
+        backend is abandoned (the thread is orphaned, exactly like the
+        selector's family deadline) and surfaces as DEADLINE_EXCEEDED
+        for the breaker/fallback layer."""
         def attempt():
             maybe_inject("plan", "device", "dispatch")
             return self._device_fn(inputs, mask)
-        return self._retry.call(attempt, description="plan-dispatch")
+
+        deadline = (self.guard.deadline_seconds
+                    if self.guard is not None else None)
+        if deadline is None:
+            return self._retry.call(attempt, description="plan-dispatch")
+        import concurrent.futures as _cf
+        if self._deadline_pool is None:
+            self._deadline_pool = _cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tx-serve-dispatch")
+        future = self._deadline_pool.submit(
+            self._retry.call, attempt, description="plan-dispatch")
+        try:
+            return future.result(timeout=deadline)
+        except _cf.TimeoutError:
+            future.cancel()
+            # the pool thread may be wedged inside the backend; a new
+            # pool is created for the next batch rather than queueing
+            # behind it
+            self._deadline_pool = None
+            _telemetry.count("serving_deadline_exceeded")
+            raise TimeoutError(
+                f"DEADLINE_EXCEEDED: device scoring batch exceeded "
+                f"the {deadline}s per-batch deadline") from None
 
     def _finish_score(self, ds: Dataset, out_chunks) -> Dataset:
         for name, chunks in zip(self._device_outputs, out_chunks):
@@ -463,7 +670,9 @@ class ScoringPlan:
         for step in self._steps:
             if step.phase == "post":
                 ds = step.stage.transform_dataset(ds)
+        return self._select_outputs(ds)
 
+    def _select_outputs(self, ds: Dataset) -> Dataset:
         keep = [f.name for f in self._raw_features if f.name in ds] \
             + [nm for nm in self._result_names]
         seen, names = set(), []
@@ -509,6 +718,34 @@ class ScoringPlan:
             b *= 2
         out.append(self.max_bucket)
         return out
+
+
+def _poison_first_valid_row(scored: Dataset, result_names, qmask
+                            ) -> Dataset:
+    """TX_FAULT_PLAN ``serving:output:guard:N=nan`` hook: corrupt the
+    first non-quarantined row's outputs with NaN, so the output guard's
+    invalidate-with-reason path is provable end to end."""
+    valid = np.flatnonzero(~qmask)
+    if valid.size == 0:
+        return scored
+    row = int(valid[0])
+    for name in result_names:
+        if name not in scored:
+            continue
+        col = scored[name]
+        if isinstance(col, PredictionColumn):
+            data = col.data.copy()
+            data[row] = np.nan
+            scored = scored.with_column(name, PredictionColumn(
+                ftype=col.ftype, data=data, metadata=col.metadata,
+                probability=col.probability,
+                raw_prediction=col.raw_prediction))
+        elif col.kind == "numeric":
+            data = np.asarray(col.data, dtype=np.float64).copy()
+            data[row] = np.inf
+            scored = scored.with_column(name, FeatureColumn(
+                ftype=col.ftype, data=data, metadata=col.metadata))
+    return scored
 
 
 def _pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:

@@ -1,0 +1,378 @@
+"""Online drift sentinel: train/serve distribution-shift detection.
+
+The paper's ``RawFeatureFilter`` prunes features whose train/score
+distributions diverge — but only at *train* time. Once a model is
+saved, nothing watches the traffic it scores. This module closes that
+gap:
+
+- at ``save_model`` time the training-data per-feature distributions
+  (``FeatureDistribution`` + the numeric ``StreamingHistogram``
+  sketches, checkers/raw_feature_filter.py) are serialized into the
+  model directory as ``drift-fingerprints.json``;
+- at serve time a :class:`DriftSentinel` maintains streaming
+  per-feature sketches over the scored traffic (same binning, same
+  hashing) and reports Jensen-Shannon divergence against the training
+  fingerprints via ``plan.drift_report()`` — reusing the exact
+  ``FeatureDistribution.js_divergence`` machinery the train-time
+  filter uses, so "shift" means the same thing in both places.
+
+Thresholds: per-feature JS >= ``warn_threshold`` marks the feature
+(and the report) ``warn``; >= ``degrade_threshold`` marks it
+``degrade`` (the CLI exits 2 on degrade). Both are knobs; reports on
+fewer than ``min_rows`` observed rows stay ``ok`` — tiny samples make
+noisy histograms, not drift evidence.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..checkers.raw_feature_filter import (FeatureDistribution,
+                                           numeric_histogram_js)
+from ..features.columns import ColumnKind, Dataset
+from ..ops.vector_utils import stable_hash as _stable_hash
+from ..runtime import telemetry as _telemetry
+from ..types import OPNumeric
+from ..utils.histogram import StreamingHistogram
+
+__all__ = ["DriftSentinel", "FeatureFingerprint", "DriftThresholds",
+           "compute_fingerprints", "save_fingerprints",
+           "load_fingerprints", "DRIFT_FINGERPRINTS_FILE",
+           "STATUS_OK", "STATUS_WARN", "STATUS_DEGRADE"]
+
+DRIFT_FINGERPRINTS_FILE = "drift-fingerprints.json"
+FINGERPRINT_FORMAT_VERSION = 1
+
+STATUS_OK = "ok"
+STATUS_WARN = "warn"
+STATUS_DEGRADE = "degrade"
+_STATUS_ORDER = {STATUS_OK: 0, STATUS_WARN: 1, STATUS_DEGRADE: 2}
+
+
+@dataclass(frozen=True)
+class DriftThresholds:
+    """JS-divergence thresholds (the train-time filter's default
+    exclusion threshold is 0.90; serving warns far earlier because a
+    serving drift report is advisory, not destructive)."""
+    warn: float = 0.25
+    degrade: float = 0.50
+    #: a report over fewer observed rows than this stays "ok"
+    min_rows: int = 50
+
+    def status_for(self, js: float, rows: int) -> str:
+        if rows < self.min_rows:
+            return STATUS_OK
+        if js >= self.degrade:
+            return STATUS_DEGRADE
+        if js >= self.warn:
+            return STATUS_WARN
+        return STATUS_OK
+
+
+@dataclass
+class FeatureFingerprint:
+    """One raw feature's training-time distribution, serialized into
+    the model dir. Numeric features carry the full streaming-histogram
+    sketch (centroids + counts); categorical/text features the hashed
+    ``bins``-bucket counts (FeatureDistribution.scala:58 semantics)."""
+    name: str
+    is_numeric: bool
+    count: int = 0
+    nulls: int = 0
+    bins: int = 100
+    #: hashed bucket counts (categorical) — empty for numeric
+    counts: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.float64))
+    #: streaming histogram (numeric) — None for categorical
+    histogram: Optional[StreamingHistogram] = None
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name, "isNumeric": self.is_numeric,
+            "count": self.count, "nulls": self.nulls, "bins": self.bins,
+            "counts": self.counts.tolist(),
+            "histogram": (self.histogram.to_json()
+                          if self.histogram is not None else None),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FeatureFingerprint":
+        return cls(
+            name=d["name"], is_numeric=d["isNumeric"],
+            count=d.get("count", 0), nulls=d.get("nulls", 0),
+            bins=d.get("bins", 100),
+            counts=np.asarray(d.get("counts", []), dtype=np.float64),
+            histogram=(StreamingHistogram.from_json(d["histogram"])
+                       if d.get("histogram") else None))
+
+
+class _Sketch:
+    """Streaming serve-side counterpart of one fingerprint."""
+
+    def __init__(self, fp: FeatureFingerprint):
+        self.fp = fp
+        self.rows = 0
+        self.nulls = 0
+        if fp.is_numeric:
+            self.histogram = StreamingHistogram(
+                fp.histogram.max_bins if fp.histogram is not None
+                else fp.bins)
+            self.counts = np.zeros(0, dtype=np.float64)
+        else:
+            self.histogram = None
+            self.counts = np.zeros(fp.bins, dtype=np.float64)
+
+    def observe_column(self, col) -> None:
+        self.rows += col.n_rows
+        if self.fp.is_numeric:
+            vals = np.asarray(col.data, dtype=np.float64)
+            finite = vals[np.isfinite(vals)]
+            self.nulls += int(col.n_rows - finite.size)
+            if finite.size:
+                self.histogram.update(finite)
+        else:
+            missing = col.is_missing()
+            self.nulls += int(missing.sum())
+            bins = self.fp.bins
+            for v, miss in zip(col.data, missing):
+                if miss:
+                    continue
+                if isinstance(v, (set, frozenset, list, tuple)):
+                    for e in v:
+                        self.counts[_stable_hash(str(e), bins)] += 1
+                elif isinstance(v, dict):
+                    for k in v:
+                        self.counts[_stable_hash(str(k), bins)] += 1
+                else:
+                    self.counts[_stable_hash(str(v), bins)] += 1
+
+    def js_vs_train(self) -> float:
+        if self.fp.is_numeric:
+            return numeric_histogram_js(self.fp.histogram, self.histogram,
+                                        self.fp.bins)
+        if self.counts.size != self.fp.counts.size:
+            return 0.0
+        a = FeatureDistribution(name=self.fp.name,
+                                distribution=self.fp.counts)
+        b = FeatureDistribution(name=self.fp.name,
+                                distribution=self.counts)
+        return a.js_divergence(b)
+
+    @property
+    def fill_rate(self) -> float:
+        return 1.0 - self.nulls / self.rows if self.rows else 0.0
+
+
+# ---------------------------------------------------------------------------
+# fingerprint computation + persistence
+# ---------------------------------------------------------------------------
+
+def compute_fingerprints(raw_features: Sequence, ds: Dataset,
+                         bins: int = 100) -> List[FeatureFingerprint]:
+    """Training-time fingerprints for every raw predictor present in
+    ``ds`` (the same distributions RawFeatureFilter computes, kept in
+    their streaming form so serve-time comparison shares breakpoints)."""
+    out: List[FeatureFingerprint] = []
+    for f in raw_features:
+        if f.is_response or f.name not in ds:
+            continue
+        col = ds[f.name]
+        if col.kind == ColumnKind.VECTOR:
+            continue
+        numeric = issubclass(f.ftype, OPNumeric)
+        fp = FeatureFingerprint(name=f.name, is_numeric=numeric,
+                                count=col.n_rows, bins=bins)
+        if numeric:
+            vals = np.asarray(col.data, dtype=np.float64)
+            finite = vals[np.isfinite(vals)]
+            fp.nulls = int(col.n_rows - finite.size)
+            fp.histogram = StreamingHistogram(bins).update(finite)
+        else:
+            missing = col.is_missing()
+            fp.nulls = int(missing.sum())
+            counts = np.zeros(bins, dtype=np.float64)
+            for v, miss in zip(col.data, missing):
+                if miss:
+                    continue
+                if isinstance(v, (set, frozenset, list, tuple)):
+                    for e in v:
+                        counts[_stable_hash(str(e), bins)] += 1
+                elif isinstance(v, dict):
+                    for k in v:
+                        counts[_stable_hash(str(k), bins)] += 1
+                else:
+                    counts[_stable_hash(str(v), bins)] += 1
+            fp.counts = counts
+        out.append(fp)
+    return out
+
+
+def save_fingerprints(fingerprints: Sequence[FeatureFingerprint],
+                      model_dir: str) -> str:
+    path = os.path.join(model_dir, DRIFT_FINGERPRINTS_FILE)
+    with open(path, "w") as fh:
+        json.dump({"formatVersion": FINGERPRINT_FORMAT_VERSION,
+                   "features": [fp.to_json() for fp in fingerprints]},
+                  fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return path
+
+
+def load_fingerprints(model_dir: str
+                      ) -> Optional[List[FeatureFingerprint]]:
+    path = os.path.join(model_dir, DRIFT_FINGERPRINTS_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("formatVersion", 1) > FINGERPRINT_FORMAT_VERSION:
+        raise ValueError(
+            f"{path} uses fingerprint format "
+            f"{doc['formatVersion']}; this build reads up to "
+            f"{FINGERPRINT_FORMAT_VERSION}")
+    return [FeatureFingerprint.from_json(d)
+            for d in doc.get("features", [])]
+
+
+# ---------------------------------------------------------------------------
+# the sentinel
+# ---------------------------------------------------------------------------
+
+class DriftSentinel:
+    """Streaming train/serve drift monitor for one model.
+
+    >>> sentinel = DriftSentinel.for_model(model)
+    >>> sentinel.observe_dataset(raw_batch)      # per scored batch
+    >>> sentinel.drift_report()["status"]        # "ok"|"warn"|"degrade"
+    """
+
+    def __init__(self, fingerprints: Sequence[FeatureFingerprint],
+                 thresholds: Optional[DriftThresholds] = None):
+        self.thresholds = thresholds or DriftThresholds()
+        self.fingerprints = list(fingerprints)
+        self._sketches = {fp.name: _Sketch(fp)
+                          for fp in self.fingerprints}
+        self.rows_seen = 0
+        #: features already warned about (one telemetry event per
+        #: feature per status escalation, not per batch)
+        self._reported: Dict[str, str] = {}
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def for_model(cls, model,
+                  thresholds: Optional[DriftThresholds] = None,
+                  bins: int = 100) -> Optional["DriftSentinel"]:
+        """Sentinel from the best available training fingerprint
+        source: the model dir's ``drift-fingerprints.json`` (saved
+        models), the in-memory ``train_dataset`` (freshly trained), or
+        the RawFeatureFilter's train distributions. None when no source
+        exists (the caller serves unguarded, loudly)."""
+        model_dir = getattr(model, "model_dir", None)
+        if model_dir:
+            try:
+                fps = load_fingerprints(model_dir)
+            except (OSError, ValueError, KeyError):
+                fps = None
+            if fps:
+                return cls(fps, thresholds)
+        train_ds = getattr(model, "train_dataset", None)
+        if train_ds is not None:
+            return cls(compute_fingerprints(model.raw_features(),
+                                            train_ds, bins=bins),
+                       thresholds)
+        rff = getattr(model, "raw_feature_filter_results", None)
+        if rff is not None and rff.train_distributions:
+            fps = []
+            for d in rff.train_distributions:
+                fps.append(FeatureFingerprint(
+                    name=d.name, is_numeric=d.is_numeric,
+                    count=d.count, nulls=d.nulls,
+                    bins=max(d.distribution.size, 2),
+                    counts=(np.zeros(0) if d.is_numeric
+                            else d.distribution),
+                    histogram=getattr(d, "_histogram", None)))
+            return cls(fps, thresholds)
+        return None
+
+    # -- observation -------------------------------------------------------
+    def observe_dataset(self, ds: Dataset) -> None:
+        """Fold one scored batch's RAW feature columns into the
+        serve-side sketches (admission-sanitized values, i.e. what the
+        model actually scored)."""
+        self.rows_seen += ds.n_rows
+        for name, sketch in self._sketches.items():
+            if name in ds:
+                sketch.observe_column(ds[name])
+        self._emit_escalations()
+
+    def observe_records(self, records: Sequence[Dict[str, Any]]) -> None:
+        """Record-dict convenience path (streaming_score)."""
+        from ..features.columns import FeatureColumn
+        from ..types import FeatureTypeError
+        cols = {}
+        for fp in self.fingerprints:
+            vals = [r.get(fp.name) if isinstance(r, dict) else None
+                    for r in records]
+            try:
+                cols[fp.name] = FeatureColumn.from_values(
+                    _ftype_for(fp), vals)
+            except (FeatureTypeError, TypeError, ValueError):
+                # unconvertible raw values: this feature sits out the
+                # batch — recorded, not silent
+                _telemetry.count("sentinel_skipped_feature_batches")
+                continue
+        if cols:
+            self.observe_dataset(Dataset(cols))
+
+    def _emit_escalations(self) -> None:
+        for name, sketch in self._sketches.items():
+            js = sketch.js_vs_train()
+            status = self.thresholds.status_for(js, sketch.rows)
+            prev = self._reported.get(name, STATUS_OK)
+            if _STATUS_ORDER[status] > _STATUS_ORDER[prev]:
+                self._reported[name] = status
+                _telemetry.count(f"drift_{status}")
+                _telemetry.event("drift", feature=name,
+                                 status=status, js=round(js, 4),
+                                 rows=sketch.rows)
+
+    # -- reporting ---------------------------------------------------------
+    def drift_report(self) -> dict:
+        """Per-feature JS divergence vs training + overall status."""
+        features = []
+        worst = STATUS_OK
+        for fp in self.fingerprints:
+            sketch = self._sketches[fp.name]
+            js = sketch.js_vs_train()
+            status = self.thresholds.status_for(js, sketch.rows)
+            if _STATUS_ORDER[status] > _STATUS_ORDER[worst]:
+                worst = status
+            features.append({
+                "feature": fp.name,
+                "isNumeric": fp.is_numeric,
+                "jsDivergence": round(js, 6),
+                "status": status,
+                "rowsObserved": sketch.rows,
+                "serveFillRate": round(sketch.fill_rate, 4),
+                "trainFillRate": round(
+                    1.0 - fp.nulls / fp.count if fp.count else 0.0, 4),
+            })
+        features.sort(key=lambda d: -d["jsDivergence"])
+        return {
+            "status": worst,
+            "rowsSeen": self.rows_seen,
+            "warnThreshold": self.thresholds.warn,
+            "degradeThreshold": self.thresholds.degrade,
+            "minRows": self.thresholds.min_rows,
+            "features": features,
+        }
+
+
+def _ftype_for(fp: FeatureFingerprint):
+    from ..types import Real, Text
+    return Real if fp.is_numeric else Text

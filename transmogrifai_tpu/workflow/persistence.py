@@ -318,6 +318,7 @@ def save_model(model, path: str) -> None:
         json.dump(doc, fh, indent=1)
         fh.flush()
         os.fsync(fh.fileno())
+    _save_drift_fingerprints(model, tmp)
     # deterministic crash site for the atomicity tests: a kill here
     # leaves a staged dir + an untouched (or previous) target
     maybe_inject("workflow", "save", "save")
@@ -338,6 +339,31 @@ def save_model(model, path: str) -> None:
         shutil.rmtree(old)
     else:
         os.rename(tmp, path)
+    # the drift sentinel (serving/sentinel.py) resolves fingerprints
+    # through the model dir
+    model.model_dir = path
+
+
+def _save_drift_fingerprints(model, staging_dir: str) -> None:
+    """Serialize the training-time per-feature distributions into the
+    model dir (``drift-fingerprints.json``) so the serve-time drift
+    sentinel (serving/sentinel.py) can compare scored traffic against
+    training without the training data. Best-effort: a model without a
+    train dataset (e.g. one loaded from an older save) simply carries
+    no fingerprints, and the sentinel reports itself unavailable."""
+    train_ds = getattr(model, "train_dataset", None)
+    if train_ds is None:
+        return
+    from ..serving.sentinel import compute_fingerprints, save_fingerprints
+    try:
+        fps = compute_fingerprints(model.raw_features(), train_ds)
+        if fps:
+            save_fingerprints(fps, staging_dir)
+    except Exception as e:   # never let fingerprinting break a save
+        import logging
+        logging.getLogger(__name__).warning(
+            "drift fingerprints not saved (%s: %s); the saved model "
+            "will serve without the drift sentinel", type(e).__name__, e)
 
 
 def _referenced_array_keys(node: Any) -> List[str]:
@@ -422,6 +448,10 @@ def load_model(path: str):
         from ..checkers.raw_feature_filter import RawFeatureFilterResults
         rff = RawFeatureFilterResults.from_json(
             doc["rawFeatureFilterResults"])
-    return WorkflowModel(
+    model = WorkflowModel(
         result_features=result, raw_feature_filter_results=rff,
         blacklisted_feature_names=doc.get("blacklistedFeatureNames", ()))
+    # remember where this model lives: the drift sentinel loads its
+    # training fingerprints (drift-fingerprints.json) from here
+    model.model_dir = path
+    return model

@@ -106,13 +106,19 @@ class RunResult:
     summary: Optional[str] = None
     n_rows: Optional[int] = None
     seconds: float = 0.0
+    #: streaming_score: micro-batches recorded + skipped after a
+    #: scoring failure (None for non-streaming run types)
+    skipped_batches: Optional[int] = None
 
     def to_json(self) -> dict:
-        return {"runType": self.run_type,
-                "modelLocation": self.model_location,
-                "writeLocation": self.write_location,
-                "metrics": self.metrics, "nRows": self.n_rows,
-                "seconds": self.seconds}
+        out = {"runType": self.run_type,
+               "modelLocation": self.model_location,
+               "writeLocation": self.write_location,
+               "metrics": self.metrics, "nRows": self.n_rows,
+               "seconds": self.seconds}
+        if self.skipped_batches is not None:
+            out["skippedBatches"] = self.skipped_batches
+        return out
 
 
 def _apply_stage_params(workflow, params: OpParams) -> None:
@@ -254,38 +260,62 @@ class WorkflowRunner:
         finally:
             if sink is not None:
                 sink.close()
+        stats = getattr(self, "last_stream_stats", {}) or {}
         return RunResult(run_type=RunType.STREAMING_SCORE,
                          model_location=params.model_location,
-                         write_location=out_path, n_rows=n)
+                         write_location=out_path, n_rows=n,
+                         skipped_batches=stats.get("skipped_batches", 0))
 
     def streaming_score(self, batches: Iterable[Iterable[dict]],
                         params: Optional[OpParams] = None,
-                        stop_on_error: bool = True
+                        stop_on_error: bool = False,
+                        guardrails: Any = False
                         ) -> Iterator[List[dict]]:
         """Micro-batch scoring over a stream of record batches
         (reference streamingScore:232 over DStream micro-batches). Uses
         the row-level local scoring path so per-batch latency stays flat.
 
-        ``stop_on_error=True`` (default) stops the stream and re-raises
-        on the first failing batch — the reference's listener stops the
-        streaming context on error (OpWorkflowRunner.scala:313-320).
-        With False, failing batches are logged and skipped."""
+        Per-batch failures are ISOLATED by default: a failing batch is
+        recorded (telemetry event ``stream_batch_skipped`` + counter
+        ``stream_batches_skipped``) and skipped, and the stream
+        continues — one poisoned micro-batch must not kill a long-lived
+        stream. The running tally lands on ``self.last_stream_stats``
+        (``run(STREAMING_SCORE)`` surfaces it as
+        ``RunResult.skipped_batches``). ``stop_on_error=True`` restores
+        the reference's stop-the-stream semantics
+        (OpWorkflowRunner.scala:313-320). ``KillPoint``/interrupts are
+        BaseExceptions and always propagate.
+
+        ``guardrails`` enables the serving guardrails for every batch
+        (docs/serving_guardrails.md): True for defaults or a dict of
+        ``ScoringPlan.with_guardrails`` kwargs — quarantined rows then
+        carry ``"_guard"`` reasons instead of poisoning the batch."""
+        from ..runtime import telemetry as _telemetry
         params = params or OpParams()
         model = self._load_model(params)
         from ..local.scoring import ScoreFunction
-        fn = ScoreFunction(model)
+        fn = ScoreFunction(model, guardrails=guardrails)
+        self.last_stream_stats = {"batches": 0, "skipped_batches": 0,
+                                  "rows": 0}
         for i, batch in enumerate(batches):
+            self.last_stream_stats["batches"] += 1
             try:
                 scored = fn.score_batch(list(batch))
-            except Exception:
+            except Exception as e:
                 if stop_on_error:
                     _log.error("streaming batch %d failed; stopping the "
                                "stream (reference stop-on-error, "
                                "OpWorkflowRunner.scala:313-320)", i)
                     raise
-                _log.warning("streaming batch %d failed; skipping",
-                             i, exc_info=True)
+                # recorded + skipped, never silent (the TX-R02 contract)
+                self.last_stream_stats["skipped_batches"] += 1
+                _telemetry.count("stream_batches_skipped")
+                _telemetry.event("stream_batch_skipped", batch=i,
+                                 error=f"{type(e).__name__}: {e}")
+                _log.warning("streaming batch %d failed; recorded and "
+                             "skipped", i, exc_info=True)
                 continue
+            self.last_stream_stats["rows"] += len(scored)
             # the yield sits OUTSIDE the try: an exception thrown INTO
             # the suspended generator must propagate as the consumer's
             # error, not be misattributed to batch scoring

@@ -562,6 +562,10 @@ class WorkflowModel:
         #: ModelInsights.scala:72 — r3 kept them on the Workflow only)
         self.raw_feature_filter_results = raw_feature_filter_results
         self.blacklisted_feature_names = list(blacklisted_feature_names)
+        #: directory this model was saved to / loaded from (None for a
+        #: purely in-memory model); the serve-time drift sentinel
+        #: resolves drift-fingerprints.json through it
+        self.model_dir: Optional[str] = None
 
     def raw_features(self) -> List[Feature]:
         return _unique_raw_features(self.result_features)
