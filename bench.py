@@ -31,28 +31,45 @@ INNER_TIMEOUT_S = int(os.environ.get("TX_BENCH_TPU_TIMEOUT", "900"))
 PROBE_TIMEOUT_S = int(os.environ.get("TX_BENCH_PROBE_TIMEOUT", "60"))
 
 
-def _probe_cache_path() -> str:
-    """Probe-verdict cache file, keyed by the jax version and the
-    JAX_PLATFORMS pin — the two inputs that change what the probe would
-    see. BENCH_r05 burned 3x60s re-probing an ambient backend that
-    hangs every time; the verdict (healthy OR dead) is stable per
-    environment, so it is cached under /tmp and reused."""
+def _probe_key() -> str:
+    """Verdict key: the jax version and the JAX_PLATFORMS pin — the two
+    inputs that change what the probe would see."""
     try:
         from importlib.metadata import version
         jax_v = version("jax")
     except Exception:  # pragma: no cover - defensive
         jax_v = "unknown"
     key = f"{jax_v}-{os.environ.get('JAX_PLATFORMS', 'ambient')}"
-    key = "".join(c if c.isalnum() or c in ".-" else "_" for c in key)
-    return os.path.join("/tmp", f"tx_bench_probe_{key}.json")
+    return "".join(c if c.isalnum() or c in ".-" else "_" for c in key)
+
+
+#: repo-level bench state: persists ACROSS bench rounds (the /tmp cache
+#: of r3 never survived a round — each driver round is a fresh
+#: container, so BENCH_r02-r05 each burned 3 x 60 s re-probing the same
+#: dead tunnel; the repo directory is the only thing that persists)
+_STATE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_STATE.json")
+
+
+def _probe_cache_path() -> str:
+    """Same-machine fast path (secondary to the repo-level state)."""
+    return os.path.join("/tmp", f"tx_bench_probe_{_probe_key()}.json")
 
 
 def _load_probe_verdict():
-    """Cached (healthy, note) or None. TX_BENCH_PROBE_REFRESH=1 ignores
-    the cache; TX_BENCH_PLATFORM overrides probing entirely (handled by
-    the caller)."""
+    """Cached (healthy, note) or None, checking the repo-level bench
+    state first (survives across rounds) and the /tmp cache second
+    (same-machine reruns). TX_BENCH_PROBE_REFRESH=1 ignores both;
+    TX_BENCH_PLATFORM overrides probing entirely (handled by the
+    caller)."""
     if os.environ.get("TX_BENCH_PROBE_REFRESH") == "1":
         return None
+    try:
+        with open(_STATE_PATH) as fh:
+            d = json.load(fh)["probe"][_probe_key()]
+        return bool(d["healthy"]), str(d.get("note", ""))
+    except Exception:
+        pass
     try:
         with open(_probe_cache_path()) as fh:
             d = json.load(fh)
@@ -62,11 +79,25 @@ def _load_probe_verdict():
 
 
 def _store_probe_verdict(healthy: bool, note: str) -> None:
+    verdict = {"healthy": healthy, "note": note, "time": time.time()}
     try:
         with open(_probe_cache_path(), "w") as fh:
-            json.dump({"healthy": healthy, "note": note,
-                       "time": time.time()}, fh)
+            json.dump(verdict, fh)
     except OSError:  # pragma: no cover - read-only /tmp
+        pass
+    # repo-level state: merge (other keys belong to other environments)
+    try:
+        state = {}
+        if os.path.exists(_STATE_PATH):
+            with open(_STATE_PATH) as fh:
+                state = json.load(fh)
+        state.setdefault("probe", {})[_probe_key()] = verdict
+        tmp = _STATE_PATH + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(state, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, _STATE_PATH)
+    except (OSError, ValueError):  # pragma: no cover - read-only repo
         pass
 
 
@@ -465,7 +496,145 @@ def _measure_serve_faults() -> dict:
     }
 
 
+def _measure_sharded_search() -> dict:
+    """TX_BENCH_MODE=sharded_search: the selector's device-mesh scaling
+    curve (ISSUE 6). Provisions a virtual CPU device pool (
+    ``--xla_force_host_platform_device_count`` semantics via
+    ``jax_num_cpu_devices``; real chips on TPU would use the ambient
+    devices), then sweeps the SAME exact-CV search over 1 -> N-device
+    candidate-axis meshes, measuring warm ``models_x_folds_per_sec``
+    per mesh size and asserting the winner + every metric vector stay
+    bitwise identical across device counts (the invariance the sharded
+    search guarantees — docs/distributed.md). A racing run at 1 vs N
+    devices checks prune-decision invariance the same way.
+
+    The sweep pool defaults to the linear families (the candidate-axis
+    pjit/shard_map kernels where sharding is the pure effect;
+    ``TX_BENCH_SHARD_POOL=full`` sweeps the whole default binary pool).
+    On a single-core host the curve is honest and flat — the virtual
+    devices share one core; ``host_cpu_count`` is emitted so the curve
+    is interpretable."""
+    max_dev = int(os.environ.get("TX_BENCH_SHARD_DEVICES", "8"))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max_dev}"
+        ).strip()
+    import jax
+    try:
+        import jax.extend.backend as jax_backend
+        jax_backend.clear_backends()
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", max_dev)
+    except AttributeError:  # pragma: no cover - older jax: XLA_FLAGS only
+        pass
+    from transmogrifai_tpu.utils.jax_setup import enable_compilation_cache
+    enable_compilation_cache()
+    import numpy as np
+
+    from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+    from transmogrifai_tpu.models import LinearSVC, LogisticRegression
+    from transmogrifai_tpu.parallel.cv import models_mesh
+    from transmogrifai_tpu.selector import (CrossValidation,
+                                            RacingCrossValidation)
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    sizes = sorted({s for s in (1, 2, 4, 8, max_dev, n_dev)
+                    if 1 <= s <= n_dev})
+
+    rng = np.random.default_rng(7)
+    rows = int(os.environ.get("TX_BENCH_SHARD_ROWS", "800"))
+    X = rng.normal(size=(rows, 12))
+    y = ((X[:, 0] * 2 - X[:, 1] + rng.logistic(size=rows) * 0.5) > 0
+         ).astype(float)
+
+    if os.environ.get("TX_BENCH_SHARD_POOL") == "full":
+        from transmogrifai_tpu.models.registry import default_binary_models
+
+        def pool():
+            return default_binary_models()
+    else:
+        def pool():
+            return [
+                (LogisticRegression(max_iter=50),
+                 [{"reg_param": r, "elastic_net_param": e}
+                  for r in (1e-4, 1e-3, 1e-2, 1e-1, 1.0)
+                  for e in (0.0, 0.1, 0.5, 1.0)]),
+                (LinearSVC(max_iter=50),
+                 [{"reg_param": r} for r in (1e-3, 1e-2, 1e-1, 1.0)])]
+
+    ev = BinaryClassificationEvaluator()
+    curve, signatures = [], {}
+    for k in sizes:
+        mesh = None if k == 1 else models_mesh(devices=devices[:k])
+        cv = CrossValidation(ev, num_folds=3, seed=7, stratify=True,
+                             mesh=mesh)
+        cv.validate(pool(), X, y)            # warm: pays the compiles
+        warm_s, best = float("inf"), None
+        for _ in range(int(os.environ.get("TX_BENCH_SHARD_REPEATS",
+                                          "2"))):
+            t0 = time.perf_counter()
+            best = cv.validate(pool(), X, y)
+            warm_s = min(warm_s, time.perf_counter() - t0)
+        mxf = sum(len(r.metric_values) for r in best.results)
+        signatures[k] = (best.name, json.dumps(best.params, sort_keys=True),
+                         best.metric,
+                         [r.metric_values for r in best.results])
+        curve.append({"devices": k,
+                      "models_x_folds": mxf,
+                      "warm_seconds": round(warm_s, 4),
+                      "models_x_folds_per_sec": round(mxf / max(
+                          warm_s, 1e-9), 3)})
+    base = curve[0]["models_x_folds_per_sec"]
+    for row in curve:
+        row["speedup_vs_1"] = round(
+            row["models_x_folds_per_sec"] / max(base, 1e-9), 3)
+    winner_invariant = len({s[:3] for s in signatures.values()}) == 1
+    metrics_identical = len({json.dumps(s[3])
+                             for s in signatures.values()}) == 1
+
+    # racing prune-decision invariance: 1 device vs the full mesh
+    def race(mesh):
+        r = RacingCrossValidation(ev, num_folds=3, seed=7, stratify=True,
+                                  eta=3, mesh=mesh)
+        best = r.validate(pool(), X, y)
+        return (best.name, json.dumps(best.params, sort_keys=True),
+                best.metric,
+                [(res.metric_values, res.rung, res.pruned_at)
+                 for res in best.results])
+    r1 = race(None)
+    rN = race(models_mesh(devices=devices[:sizes[-1]])
+              if sizes[-1] > 1 else None)
+    top = curve[-1]
+    return {
+        "metric": "sharded_models_x_folds_per_sec",
+        "value": top["models_x_folds_per_sec"],
+        "unit": "models_x_folds/s",
+        # headline ratio: throughput at the widest mesh vs 1 device —
+        # near-linear on a multi-core/multi-chip host, ~1x when the
+        # virtual devices share one core (see host_cpu_count)
+        "vs_baseline": top["speedup_vs_1"],
+        "speedup_at_max_devices": top["speedup_vs_1"],
+        "scaling_curve": curve,
+        "devices_swept": sizes,
+        "winner_invariant": bool(winner_invariant),
+        "metrics_bitwise_identical": bool(metrics_identical),
+        "racing_invariant": bool(r1 == rN),
+        "racing_winner": r1[0],
+        "host_cpu_count": os.cpu_count(),
+        "rows": rows,
+        "platform": "cpu",
+    }
+
+
 def _measure() -> dict:
+    if os.environ.get("TX_BENCH_MODE") == "sharded_search":
+        return _measure_sharded_search()
     if os.environ.get("TX_BENCH_MODE") == "score":
         return _measure_score()
     if os.environ.get("TX_BENCH_MODE") == "racing":
@@ -644,6 +813,18 @@ def _probe_ambient() -> tuple[bool, str, list]:
 
 
 def main() -> None:
+    if os.environ.get("TX_BENCH_MODE") == "sharded_search":
+        # the sweep is DEFINED on a forced-CPU virtual device pool
+        # (1 -> N devices on one host): no ambient probe, no child
+        # watchdog — the CPU backend cannot hang
+        try:
+            out = _measure()
+        except Exception as e:
+            metric, unit = _headline_metric()
+            out = {"metric": metric, "value": 0.0, "unit": unit,
+                   "vs_baseline": 0.0, "error_msg": repr(e)}
+        print(json.dumps(out))
+        return
     # attempt 1: ambient backend (TPU when the tunnel is up) in a child
     # the watchdog can kill — covers init AND mid-run hangs. A cheap
     # retried probe gates the long attempt so a dead tunnel fails fast
@@ -684,6 +865,8 @@ def main() -> None:
 
 
 def _headline_metric() -> tuple:
+    if os.environ.get("TX_BENCH_MODE") == "sharded_search":
+        return "sharded_models_x_folds_per_sec", "models_x_folds/s"
     if os.environ.get("TX_BENCH_MODE") == "score":
         return "score_rows_per_s", "rows/s"
     if os.environ.get("TX_BENCH_MODE") == "racing":

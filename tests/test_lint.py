@@ -858,3 +858,97 @@ class TestR02SilentRecordDrop:
                         continue
         """, path="transmogrifai_tpu/utils/mydrain.py")
         assert "TX-R02" not in _rules(findings)
+
+
+class TestJ08ShardClosure:
+    """TX-J08: a shard_map/pjit body closing over an array-like value
+    gets implicit full replication — arrays must enter through
+    in_specs (docs/lint.md, docs/distributed.md)."""
+
+    def _lint(self, code):
+        return lint_source(textwrap.dedent(code),
+                           "transmogrifai_tpu/parallel/mykernel.py")
+
+    def test_closed_over_arrays_flagged(self):
+        findings = self._lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from transmogrifai_tpu.utils.jax_setup import shard_map
+
+            def builder(mesh, X, y):
+                def body(w_loc):
+                    return (w_loc * y) @ X
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=(P("models"),),
+                    out_specs=P("models")))
+        """)
+        flagged = [f for f in findings if f.rule_id == "TX-J08"]
+        assert len(flagged) == 2
+        assert flagged[0].severity == "warning"
+        assert "in_specs" in (flagged[0].hint or "")
+
+    def test_lambda_body_flagged(self):
+        findings = self._lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from transmogrifai_tpu.utils.jax_setup import shard_map
+
+            def builder(mesh, masks):
+                return jax.jit(shard_map(
+                    lambda w: w * masks, mesh=mesh,
+                    in_specs=(P("models"),), out_specs=P("models")))
+        """)
+        assert "TX-J08" in _rules(findings)
+
+    def test_arrays_through_in_specs_clean(self):
+        findings = self._lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from transmogrifai_tpu.utils.jax_setup import shard_map
+
+            def builder(cfg, spec, mesh):
+                data_ax = "data" if "data" in mesh.axis_names else None
+
+                def body(w_loc, X_loc, y_loc):
+                    return fit(cfg, w_loc, X_loc, y_loc,
+                               axis_name=data_ax)
+                return jax.jit(shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P("models"), P(data_ax), P(data_ax)),
+                    out_specs=P("models")))
+        """)
+        assert "TX-J08" not in _rules(findings)
+
+    def test_config_closures_clean(self):
+        """Kernel config (cfg/spec/statics/axis names/module CONSTANTS)
+        closes over shard bodies legitimately throughout the repo."""
+        findings = self._lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from transmogrifai_tpu.utils.jax_setup import shard_map
+
+            MAX_ITER = 100
+
+            def builder(statics, spec, mesh):
+                def body(w_loc):
+                    return kernel(statics, spec, w_loc, MAX_ITER)
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=(P("models"),),
+                    out_specs=P("models")))
+        """)
+        assert "TX-J08" not in _rules(findings)
+
+    def test_single_capital_x_is_data_not_constant(self):
+        findings = self._lint("""
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from transmogrifai_tpu.utils.jax_setup import shard_map
+
+            def builder(mesh, X):
+                def body(w_loc):
+                    return w_loc @ X
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=(P("models"),),
+                    out_specs=P("models")))
+        """)
+        assert "TX-J08" in _rules(findings)

@@ -39,6 +39,11 @@ def run_journal(args) -> int:
     fp = info.get("fingerprint") or "?"
     print(f"search journal: {info['path']}")
     print(f"  schema v{info.get('version')}  fingerprint {fp[:16]}…")
+    topo = info.get("recordedTopology")
+    if topo:
+        print(f"  recorded on {topo.get('devices')} device(s), mesh "
+              f"{topo.get('mesh')} — resumes on ANY topology to the "
+              f"bitwise-identical winner (docs/distributed.md)")
     print(f"  {len(info['entries'])} completed family evaluation(s) "
           f"across rungs {', '.join(info['rungs']) or '-'}")
     for e in sorted(info["entries"],

@@ -49,6 +49,9 @@ RULES: Dict[str, tuple] = {
                         "jit argument or a memoized kernel-builder key "
                         "inside a fit kernel (G x F programs instead "
                         "of 1)"),
+    "TX-J08": (WARNING, "shard_map/pjit body closes over an array-like "
+                        "value instead of taking it through in_specs — "
+                        "implicitly replicated in full to every device"),
     # -- resilience rules (selector/serving hot paths only) ----------------
     "TX-R01": (ERROR, "except Exception / bare except in a selector or "
                       "serving hot path swallows XlaRuntimeError "

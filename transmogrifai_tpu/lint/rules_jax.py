@@ -51,6 +51,16 @@ call) are caught here in milliseconds:
   tracking deliberately stops at them and at non-trivial calls so the
   repo's grouped-statics idiom (trees/mlp static shape groups) stays
   legal.
+- TX-J08 implicit replication under ``shard_map``/``pjit``: the body
+  function closes over an array-like value from the enclosing scope
+  instead of receiving it through ``in_specs``. A closed-over operand
+  gets no PartitionSpec, so XLA replicates it IN FULL to every device —
+  the fold matrix paid once per chip, silently (the sharded search's
+  HBM budget assumes one copy across the ``data`` axis). Arrays must
+  enter the body as arguments with explicit specs (``P()`` when
+  replication is the intent — then it is visible and reviewable).
+  Config scalars (``cfg``/``spec``/``statics``/axis names...) may close
+  over freely; the rule keys on array-ish names only.
 
 Scope discipline keeps the rules precise: J01/J04/J05 only fire INSIDE
 functions statically known to be jitted (decorated with ``jax.jit`` or
@@ -92,6 +102,25 @@ _AGGREGATE_CALLS = {"len", "any", "all", "bool", "max", "min", "sum",
 #: (``for p in list(grid)``, ``for gi, p in enumerate(grid)``)
 _PASSTHROUGH_CALLS = {"list", "tuple", "dict", "enumerate", "zip",
                       "reversed", "sorted", "iter"}
+
+#: TX-J08: free variables of a shard_map/pjit body that LOOK like data
+#: arrays (the values whose implicit replication costs HBM per chip).
+#: Deliberately name-based: config scalars (cfg/spec/statics/axis
+#: names) close over shard bodies legitimately throughout the repo.
+import re as _re
+
+_ARRAYISH_FREE = _re.compile(
+    r"(?i)^(x|y|w|b|xs|ys|xv|yv|wmat|masks?|grid|labels?|features?|"
+    r"rows|cols|data|batch|inputs?|outputs?|onehot|weights?|biases)"
+    r"(_[a-z0-9_]+)?$"
+    r"|^.*_(mat|matrix|arrays?|st|val|train)$")
+
+#: names that never carry a data array into a shard body (kernel
+#: configuration, mesh/axis plumbing, callables)
+_SHARD_CONFIG_NAMES = {"mesh", "spec", "cfg", "statics", "axis",
+                       "axis_name", "data_ax", "model_ax", "kind",
+                       "self", "cls", "fn", "core", "body", "one",
+                       "batched"}
 
 
 # ---------------------------------------------------------------------------
@@ -615,9 +644,95 @@ class _Visitor(ast.NodeVisitor):
                 hint="use jnp.where / lax.cond / lax.while_loop, or "
                      "declare the parameter static via static_argnames")
 
+    # -- TX-J08: shard_map/pjit closure analysis ---------------------------
+    @staticmethod
+    def _is_shard_call(fn: ast.AST) -> bool:
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else "")
+        return name in ("shard_map", "pjit")
+
+    def _resolve_local_funcdef(self, name: str):
+        """The FunctionDef a shard_map call's first argument names,
+        searched through the enclosing function bodies (the repo's
+        kernel-builder idiom defines the shard body locally)."""
+        for fn in reversed(self.fn_stack):
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub.name == name:
+                    return sub
+        return None
+
+    @staticmethod
+    def _free_names(body: ast.AST) -> Set[str]:
+        """Names a function body loads but never binds — its closure.
+        Bound: its own (and nested) params, assignment/for/
+        comprehension targets, nested def names."""
+        bound: Set[str] = set()
+        loads: Set[str] = set()
+        for sub in ast.walk(body):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                if not isinstance(sub, ast.Lambda):
+                    bound.add(sub.name)
+                a = sub.args
+                bound.update(p.arg for p in
+                             a.posonlyargs + a.args + a.kwonlyargs)
+                if a.vararg:
+                    bound.add(a.vararg.arg)
+                if a.kwarg:
+                    bound.add(a.kwarg.arg)
+            elif isinstance(sub, ast.Name):
+                if isinstance(sub.ctx, ast.Store):
+                    bound.add(sub.id)
+                elif isinstance(sub.ctx, ast.Load):
+                    loads.add(sub.id)
+        return loads - bound
+
+    def _check_shard_closure(self, node: ast.Call) -> None:
+        """TX-J08: a shard_map/pjit body closing over an array-like
+        value — no PartitionSpec, so XLA replicates it in full to
+        every device. Arrays must enter through in_specs (P() when
+        replication is intended — explicit and reviewable)."""
+        if not self._is_shard_call(node.func) or not node.args:
+            return
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            body = self._resolve_local_funcdef(target.id)
+        elif isinstance(target, ast.Lambda):
+            body = target
+        else:
+            body = None
+        if body is None:
+            return
+        where = (f" (in {self.fn_stack[-1].name!r})"
+                 if self.fn_stack else "")
+        for free in sorted(self._free_names(body)):
+            # module CONSTANTS are config, not data — but a single
+            # capital letter (X, the feature matrix) is data
+            if free in _SHARD_CONFIG_NAMES \
+                    or (len(free) > 1 and free.isupper()) \
+                    or free in self.al.jax | self.al.jnp | self.al.numpy:
+                continue
+            if not _ARRAYISH_FREE.match(free):
+                continue
+            self.add(
+                "TX-J08", node,
+                f"shard_map/pjit body closes over array-like "
+                f"{free!r} from the enclosing scope{where} — the "
+                f"operand has no PartitionSpec, so XLA replicates it "
+                f"IN FULL to every device (a fold matrix paid once "
+                f"per chip)",
+                WARNING,
+                hint="pass it as a body argument with an explicit "
+                     "entry in in_specs — P('data') to shard rows, "
+                     "P() when replication is genuinely intended")
+
     # -- calls -------------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:
         al = self.al
+        # TX-J08: shard_map/pjit closing over unsharded arrays --------------
+        self._check_shard_closure(node)
         # TX-J02 (TX-J06 inside serving/): jax.jit applied at call time ----
         if al.is_jax_jit(node.func):
             per_call_rule = "TX-J06" if self.serving else "TX-J02"

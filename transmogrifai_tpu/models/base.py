@@ -29,7 +29,7 @@ from ..types import OPVector, Prediction, RealNN
 
 __all__ = ["Predictor", "PredictionModel", "ClassifierModel",
            "RegressionModel", "check_is_response_values",
-           "FamilyPreconditionError", "subset_grid"]
+           "FamilyPreconditionError", "subset_grid", "pad_cand_idx"]
 
 
 class FamilyPreconditionError(ValueError):
@@ -72,6 +72,34 @@ def subset_grid(grid, cand_idx):
     if cand_idx is None:
         return grid
     return [grid[int(i)] for i in np.asarray(cand_idx).ravel()]
+
+
+def pad_cand_idx(cand_idx, shards: int):
+    """Pad a racing rung's candidate-index vector to a multiple of the
+    mesh's ``models`` shard count: the last index is repeated (a
+    duplicate evaluation whose metric column is discarded), and the
+    caller slices the returned matrix back to ``n_valid`` columns.
+
+    Two properties the sharded search leans on:
+
+    - **shape stability** — every rung's candidate axis lands on the
+      ``multiple-of-shards`` lattice, so alive counts that differ only
+      by the pruning trajectory reuse the same compiled rung program
+      (the serving plan's shape-bucket idiom applied to ``cand_idx``),
+    - **decision invariance** — padding happens BEFORE dispatch and is
+      sliced off before any metric is journaled or ranked, so the
+      pruning decision (and the journal) see the identical candidate
+      set on every device count.
+
+    Returns ``(padded index list, n_valid)``; the validity mask is
+    implicit — exactly the first ``n_valid`` columns are real.
+    """
+    idx = [int(i) for i in np.asarray(cand_idx).ravel()]
+    if not idx:
+        raise ValueError("cand_idx must not be empty")
+    shards = max(1, int(shards))
+    pad = (-len(idx)) % shards
+    return idx + [idx[-1]] * pad, len(idx)
 
 
 def check_fold_classes(y, masks) -> None:

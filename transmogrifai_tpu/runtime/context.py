@@ -56,8 +56,13 @@ class RuntimeContext:
         self._lock = threading.Lock()
 
     # -- journal -----------------------------------------------------------
-    def open_journal(self, checkpoint_dir: str, fingerprint: str) -> None:
-        self.journal = SearchJournal(checkpoint_dir).open(fingerprint)
+    def open_journal(self, checkpoint_dir: str, fingerprint: str,
+                     topology: Optional[dict] = None) -> None:
+        """``topology`` (the validator's resolved mesh shape) is header
+        metadata only — a journal resumes across device counts to the
+        bitwise-identical winner (runtime/journal.py open())."""
+        self.journal = SearchJournal(checkpoint_dir).open(
+            fingerprint, topology=topology)
         if self.journal.replayed:
             telemetry.event("journal_resume",
                             checkpoint_dir=checkpoint_dir,

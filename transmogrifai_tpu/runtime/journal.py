@@ -89,9 +89,22 @@ class SearchJournal:
         self.fingerprint: Optional[str] = None
         #: entries replayed from disk at open() (resume telemetry)
         self.replayed = 0
+        #: mesh topology of the run that WROTE the header (metadata,
+        #: deliberately outside the fingerprint — see open())
+        self.recorded_topology: Optional[dict] = None
 
     # -- lifecycle ---------------------------------------------------------
-    def open(self, fingerprint: str) -> "SearchJournal":
+    def open(self, fingerprint: str,
+             topology: Optional[dict] = None) -> "SearchJournal":
+        """``topology`` describes the mesh this run searches on, e.g.
+        ``{"devices": 8, "mesh": {"models": 8, "data": 1}}``. It is
+        recorded in the header as METADATA and deliberately excluded
+        from the fingerprint: metric matrices are device-count-invariant
+        (candidate-axis sharding never changes a candidate's
+        arithmetic), so a journal written on a 2-chip mesh legally
+        resumes on an 8-chip one — the resumed search replays the same
+        metrics and picks the bitwise-identical winner
+        (tests/test_sharded_search.py asserts exactly this)."""
         os.makedirs(self.directory, exist_ok=True)
         self.fingerprint = fingerprint
         existing, header = self._read_existing()
@@ -106,13 +119,26 @@ class SearchJournal:
             os.replace(self.path, stale)
             existing = []
             header = None
+        if header is not None:
+            self.recorded_topology = header.get("topology")
+            if topology is not None and self.recorded_topology is not None \
+                    and self.recorded_topology != topology:
+                _log.info(
+                    "journal %s was recorded on topology %s; resuming on "
+                    "%s — metric matrices are device-count-invariant, so "
+                    "the resumed search replays them unchanged",
+                    self.path, self.recorded_topology, topology)
         self._entries = {
             _entry_key(e["family"], e["rung"]): e for e in existing}
         self.replayed = len(self._entries)
         self._fh = open(self.path, "a", encoding="utf-8")
         if header is None:
-            self._write_line({"kind": "header", "v": JOURNAL_VERSION,
-                              "fingerprint": fingerprint})
+            head = {"kind": "header", "v": JOURNAL_VERSION,
+                    "fingerprint": fingerprint}
+            if topology is not None:
+                head["topology"] = topology
+            self.recorded_topology = topology
+            self._write_line(head)
         return self
 
     def _read_existing(self):
@@ -209,6 +235,10 @@ def read_journal(directory: str) -> dict:
         "path": path,
         "fingerprint": (header or {}).get("fingerprint"),
         "version": (header or {}).get("v"),
+        # mesh topology of the writing run (metadata only: a resume on
+        # a different device count replays the same metrics —
+        # docs/distributed.md)
+        "recordedTopology": (header or {}).get("topology"),
         "entries": entries,
         "families": sorted({e["family"] for e in entries}),
         "rungs": sorted({e["rung"] for e in entries}),

@@ -103,9 +103,12 @@ class _SelectorFactory:
                               validation: str = "exact",
                               eta: int = 3,
                               min_fidelity: Optional[float] = None,
-                              mesh=None) -> ModelSelector:
+                              mesh="auto") -> ModelSelector:
         """(reference withCrossValidation:159; ``mesh`` shards the
-        fold x grid candidate axis over chips, parallel/cv.py).
+        fold x grid candidate axis over chips — the default ``"auto"``
+        resolves a mesh over every visible device at search time,
+        ``None`` forces the local path; parallel/cv.resolve_search_mesh
+        and docs/distributed.md).
 
         ``validation="racing"`` switches the search to multi-fidelity
         successive halving (docs/selection.md): the candidate pool is
@@ -132,7 +135,7 @@ class _SelectorFactory:
                                     model_types_to_use: Optional[Sequence]
                                     = None,
                                     stratify: bool = False,
-                                    mesh=None) -> ModelSelector:
+                                    mesh="auto") -> ModelSelector:
         ev = evaluator or cls.default_evaluator()
         return ModelSelector(
             models=cls._pool(models, model_types_to_use),

@@ -41,7 +41,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..models.base import Predictor
+from ..models.base import Predictor, pad_cand_idx
 from ..runtime import telemetry as _telemetry
 from ..runtime.errors import BUG, classify_error
 from ..runtime.faults import maybe_inject
@@ -108,7 +108,7 @@ class RacingCrossValidation(CrossValidation):
 
     def __init__(self, evaluator, num_folds: int = 3, eta: int = 3,
                  min_fidelity: Optional[float] = None, seed: int = 42,
-                 stratify: bool = False, mesh=None):
+                 stratify: bool = False, mesh="auto"):
         super().__init__(evaluator, num_folds=num_folds, seed=seed,
                          stratify=stratify, mesh=mesh)
         if eta < 2:
@@ -151,6 +151,53 @@ class RacingCrossValidation(CrossValidation):
             b *= self.eta
         budgets.append(1.0)
         return budgets
+
+    def _eval_rung_cands(self, est, grid, X_r, y_r, rung_masks, Xv_r,
+                         yv_r, spec, alive: Sequence[int], shards: int):
+        """One family's rung evaluation with the candidate axis padded
+        to a multiple of the mesh's ``models`` shard count
+        (models/base.pad_cand_idx): rung program SHAPES stay on the
+        shard lattice — alive counts that differ only by pruning
+        trajectory reuse one compiled program — and the padded columns
+        (duplicates of the last alive candidate) are sliced off HERE,
+        before anything is journaled, ranked or reported, so the prune
+        decision sees the identical candidate set on every device
+        count."""
+        padded, n_valid = pad_cand_idx(alive, shards)
+        mm = self._try_device_eval(
+            est, grid, X_r, y_r, rung_masks, Xv_r, yv_r, spec,
+            cand_idx=np.asarray(padded, dtype=np.int64))
+        if mm is None:
+            return None
+        return np.asarray(mm, dtype=np.float64)[:, :n_valid]
+
+    def _prune_rung(self, contenders: List[_Racer], rung: int) -> int:
+        """The rung-boundary prune as ONE COLLECTIVE decision.
+
+        Every family kernel returns its metric shard through
+        ``parallel/mesh.to_host`` — on a multi-process mesh that is a
+        ``process_allgather``, so every host holds the identical global
+        (folds, candidates) table when it reaches this point. The
+        global top-``1/eta`` is then computed once from that gathered
+        table with a fully deterministic ordering (metric descending by
+        the evaluator's sign; non-finite last; (family, grid) index as
+        the tie-break) — no RNG, no wall-clock, no device-count
+        dependence — so every host, and a resume on ANY mesh topology,
+        prunes the exact same candidates (tests/test_sharded_search.py
+        asserts rung decisions bitwise across 1/2/8 devices).
+
+        Returns the promoted (kept) count."""
+        sign = 1.0 if self.evaluator.is_larger_better else -1.0
+        scored = sorted(
+            contenders,
+            key=lambda rc: (-(sign * rc.mean())
+                            if np.isfinite(rc.mean())
+                            else np.inf, rc.fam, rc.gi))
+        keep = max(1, int(np.ceil(len(scored) / self.eta)))
+        for rc in scored[keep:]:
+            rc.alive = False
+            rc.pruned_at = rung
+        return keep
 
     def _fidelity(self, budget: float, n_folds: int) -> Tuple[int, float]:
         """(folds, train-row fraction) realizing a budget fraction.
@@ -226,6 +273,8 @@ class RacingCrossValidation(CrossValidation):
     def _validate_raced(self, models, X, y, masks, fold_data, spec,
                         X_val_st, y_val_st, budgets, n_total, ctx, t0
                         ) -> BestEstimator:
+        from ..parallel.cv import mesh_model_shards
+        shards = mesh_model_shards(self.mesh)
         F = masks.shape[0]
         racers: Dict[Tuple[int, int], _Racer] = {
             (fi, gi): _Racer(fi, gi)
@@ -279,14 +328,20 @@ class RacingCrossValidation(CrossValidation):
             tasks = []
             for fi, alive in fam_idx:
                 est, grid = models[fi]
+                # program signature uses the PADDED candidate count:
+                # that is the traced shape (the shard lattice), and the
+                # reason repeated searches with different pruning
+                # trajectories request zero new programs
                 _note_rung_programs(type(est).__name__, folds_r,
-                                    rung_masks.shape[1], len(alive), spec)
+                                    rung_masks.shape[1],
+                                    len(pad_cand_idx(alive, shards)[0]),
+                                    spec)
                 tasks.append((
                     type(est).__name__, self._family_key(fi, est),
                     tuple(alive),
-                    lambda e=est, g=grid, a=alive: self._try_device_eval(
+                    lambda e=est, g=grid, a=alive: self._eval_rung_cands(
                         e, g, X_r, y_r, rung_masks, Xv_r, yv_r, spec,
-                        cand_idx=np.asarray(a, dtype=np.int64))))
+                        a, shards)))
             mats = self._dispatch_device_evals(
                 tasks, X_r, rung_masks, Xv_r, yv_r, spec, ctx=ctx,
                 rung=r, rung_label=f"rung{r}")
@@ -323,19 +378,10 @@ class RacingCrossValidation(CrossValidation):
             contenders = [rc for rc in racers.values() if rc.alive]
             promoted = len(contenders)
             if not final and contenders:
-                sign = 1.0 if self.evaluator.is_larger_better else -1.0
-                # stable, deterministic ranking; non-finite means sort
-                # last (they are the first pruned)
-                scored = sorted(
-                    contenders,
-                    key=lambda rc: (-(sign * rc.mean())
-                                    if np.isfinite(rc.mean())
-                                    else np.inf, rc.fam, rc.gi))
-                keep = max(1, int(np.ceil(len(scored) / self.eta)))
-                for rc in scored[keep:]:
-                    rc.alive = False
-                    rc.pruned_at = r
-                promoted = keep
+                # collective rung-boundary decision over the gathered
+                # global metric table — identical on every host and
+                # every device count (_prune_rung)
+                promoted = self._prune_rung(contenders, r)
             rung_rows.append({
                 "rung": r, "budgetFraction": round(b, 6),
                 "folds": folds_r, "rowFraction": round(row_frac, 6),

@@ -7,9 +7,15 @@ reference's per-fold / per-family ``Future`` task parallelism maps to:
 
 - one jitted XLA fit per (family, grid point, fold); hyperparameters are
   traced scalars so a whole grid reuses one compiled program per family,
-- optional mesh execution: when a ``("folds", "data")`` mesh is supplied,
-  families exposing a mesh kernel (see parallel/cv.py) train all
-  fold x grid candidates in a single SPMD program.
+- mesh execution BY DEFAULT: the validator resolves a
+  ``("models", "data")`` mesh over the visible devices at search time
+  (``parallel/cv.resolve_search_mesh``; ``TX_SEARCH_MESH`` policies it,
+  a single visible device keeps the local path) and families exposing a
+  mesh kernel (see parallel/cv.py) train all fold x grid candidates in
+  one SPMD program, candidate axis sharded over chips. Candidate-axis
+  sharding keeps every candidate's arithmetic identical to the local
+  program, so the winner is BITWISE invariant across device counts
+  (docs/distributed.md; tests/test_sharded_search.py).
 """
 from __future__ import annotations
 
@@ -161,14 +167,18 @@ def _batched_fold_raw(fitted_fold_models, X_val):
 
 class _ValidatorBase:
     def __init__(self, evaluator: Evaluator, seed: int = 42,
-                 stratify: bool = False, mesh=None):
+                 stratify: bool = False, mesh="auto"):
         self.evaluator = evaluator
         self.seed = seed
         self.stratify = stratify
-        #: optional ("models", "data") jax.sharding.Mesh — candidates of
-        #: kernel-capable families then train as ONE SPMD program across
-        #: chips (see parallel/cv.py); without it they still batch into
-        #: one vmapped program on the local device.
+        #: ("models", "data") jax.sharding.Mesh, a policy string, or
+        #: None. The default ``"auto"`` resolves LAZILY at search time
+        #: (parallel/cv.resolve_search_mesh — constructing a selector
+        #: must never initialize a backend): with >1 visible device the
+        #: fold x grid candidate axis of every kernel-capable family
+        #: shards over chips as ONE SPMD program (parallel/cv.py);
+        #: ``None`` forces the local single-device path; results are
+        #: bitwise identical either way (docs/distributed.md).
         self.mesh = mesh
         #: fault-tolerance knobs (runtime/; docs/resilience.md) — set
         #: directly or via ModelSelector(checkpoint_dir=..., ...):
@@ -184,6 +194,48 @@ class _ValidatorBase:
         #: RuntimeContext of the most recent validate() call — the
         #: selector reads the quarantine ledger from here
         self.last_runtime: Optional[RuntimeContext] = None
+
+    # -- mesh resolution ---------------------------------------------------
+    def _resolve_mesh(self):
+        """Resolve a mesh policy ("auto"/int/None/Mesh) into a concrete
+        mesh ONCE, at search time. Idempotent; the resolved mesh is
+        stored back so every dispatch of this search (and the next)
+        shares one mesh object — the lru_cache'd family kernels key on
+        it."""
+        from ..parallel.cv import resolve_search_mesh
+        if isinstance(self.mesh, (str, int)):
+            self.mesh = resolve_search_mesh(self.mesh)
+        return self.mesh
+
+    def mesh_topology(self) -> dict:
+        """Topology descriptor of the resolved search mesh — journal
+        header metadata (a resume on a different device count replays
+        the same metrics; runtime/journal.py)."""
+        mesh = self._resolve_mesh()
+        if mesh is None:
+            return {"devices": 1, "mesh": None}
+        return {"devices": int(mesh.size),
+                "mesh": {str(k): int(v) for k, v in mesh.shape.items()},
+                "platform": mesh.devices.flat[0].platform}
+
+    def _dispatch_workers(self, n_tasks: int) -> int:
+        """Concurrent family-dispatch thread budget. Without a mesh:
+        one per family up to the core count (threads overlap host
+        orchestration + transfers with on-chip compute). With the
+        search mesh active every family's kernel is itself an SPMD
+        program over the WHOLE mesh — extra host threads would queue
+        full-mesh programs against the same chips the sharded rungs
+        already occupy (oversubscription buys queueing, not overlap) —
+        so the budget is 1 + the device slots the mesh leaves free. A
+        family deadline still forces >= 2 workers: deadline abandonment
+        only works from the threaded path."""
+        workers = min(n_tasks, os.cpu_count() or 1)
+        mesh = self._resolve_mesh()
+        if mesh is not None:
+            import jax
+            free = max(0, len(jax.devices()) - int(mesh.size))
+            workers = min(workers, 1 + free)
+        return workers
 
     # -- fault-tolerant runtime --------------------------------------------
     @staticmethod
@@ -204,8 +256,13 @@ class _ValidatorBase:
             from ..runtime.journal import search_fingerprint
             params = dict(self.get_params(),
                           validationType=type(self).__name__)
+            # mesh topology rides along as header METADATA — it is NOT
+            # part of the fingerprint, so a search preempted on one
+            # device count resumes on another to the bitwise-identical
+            # winner (docs/distributed.md)
             ctx.open_journal(self.checkpoint_dir,
-                             search_fingerprint(models, params, X, y))
+                             search_fingerprint(models, params, X, y),
+                             topology=self.mesh_topology())
         self.last_runtime = ctx
         return ctx
 
@@ -273,7 +330,7 @@ class _ValidatorBase:
         try:
             return estimator.eval_fold_grid_arrays(
                 X, y, masks, grid, X_val_st, y_val_st, spec,
-                mesh=self.mesh, **kwargs)
+                mesh=self._resolve_mesh(), **kwargs)
         except NotImplementedError:
             return None         # grid/labels not traceable -> host path
         except FamilyPreconditionError as e:
@@ -303,7 +360,10 @@ class _ValidatorBase:
         arrays every validation strategy (exact and racing) shares.
         fold_data is materialized ONCE per search; stable array identity
         also lets the tree family's host-side binning memoize per
-        fold."""
+        fold. This is also where the search mesh resolves: from here on
+        every family kernel places the flattened fold x grid candidate
+        axis on the mesh's ``models`` axis (parallel/cv.py et al.)."""
+        self._resolve_mesh()
         splits = self._splits(y)
         masks = np.zeros((len(splits), len(y)))
         for f, (train_idx, _) in enumerate(splits):
@@ -440,13 +500,19 @@ class _ValidatorBase:
         dispatch_bytes = _async_dispatch_bytes(X, masks, X_val_st,
                                                y_val_st)
         deadline = ctx.family_deadline if ctx is not None else None
-        if (len(tasks) > 1 and spec is not None
+        # mesh-slot cap: with the sharded search active, each family's
+        # kernel already spans the whole mesh — see _dispatch_workers.
+        # A deadline forces the threaded path regardless: abandonment
+        # of a hung family only works from a worker thread.
+        workers = self._dispatch_workers(len(tasks))
+        if deadline is not None:
+            workers = min(len(tasks), max(2, workers))
+        if (len(tasks) > 1 and workers > 1 and spec is not None
                 and dispatch_bytes <= async_cap
                 and os.environ.get("TX_ASYNC_FAMILIES", "1") != "0"):
             from concurrent.futures import ThreadPoolExecutor
             from concurrent.futures import TimeoutError as _FutTimeout
             from concurrent.futures import wait as _fut_wait
-            workers = min(len(tasks), os.cpu_count() or 1)
             ex = ThreadPoolExecutor(max_workers=workers,
                                     thread_name_prefix="tx-family")
             futures = [ex.submit(run_task, *t) for t in tasks]
@@ -508,7 +574,7 @@ class _ValidatorBase:
         if self._use_batched_kernel(estimator):
             try:
                 fitted = estimator.fit_fold_grid_arrays(
-                    X, y, masks, grid, mesh=self.mesh)
+                    X, y, masks, grid, mesh=self._resolve_mesh())
             except NotImplementedError:
                 fitted = None   # grid not traceable -> sequential
             except FamilyPreconditionError as e:
@@ -635,6 +701,7 @@ class _ValidatorBase:
         refit DAG segment, so there is no stable fingerprint to key a
         resume on (docs/resilience.md)."""
         spec = self.evaluator.device_metric_spec()
+        self._resolve_mesh()
         models = [(est, list(grid) or [{}]) for est, grid in models]
         ctx = self._begin_runtime(models, None, None)
         results: List[ValidationResult] = []
@@ -682,7 +749,7 @@ class _ValidatorBase:
                 fitted = [
                     estimator.fit_fold_grid_arrays(
                         X_tr, y_tr, np.ones((1, len(y_tr))), grid,
-                        mesh=self.mesh)[0]
+                        mesh=self._resolve_mesh())[0]
                     for X_tr, y_tr, _, _ in folds]
             except NotImplementedError:
                 fitted = None
@@ -757,7 +824,7 @@ class CrossValidation(_ValidatorBase):
     validation_type = "CrossValidation"
 
     def __init__(self, evaluator: Evaluator, num_folds: int = 3,
-                 seed: int = 42, stratify: bool = False, mesh=None):
+                 seed: int = 42, stratify: bool = False, mesh="auto"):
         super().__init__(evaluator, seed, stratify, mesh=mesh)
         if num_folds < 2:
             raise ValueError("num_folds must be >= 2")
@@ -780,7 +847,7 @@ class TrainValidationSplit(_ValidatorBase):
     validation_type = "TrainValidationSplit"
 
     def __init__(self, evaluator: Evaluator, train_ratio: float = 0.75,
-                 seed: int = 42, stratify: bool = False, mesh=None):
+                 seed: int = 42, stratify: bool = False, mesh="auto"):
         super().__init__(evaluator, seed, stratify, mesh=mesh)
         if not 0.0 < train_ratio < 1.0:
             raise ValueError("train_ratio must be in (0, 1)")
