@@ -496,6 +496,167 @@ def _measure_serve_faults() -> dict:
     }
 
 
+def _wide_prepare_records(rows: int, seed: int = 0):
+    """Wide synthetic dataset for the prepare bench: high-cardinality
+    categoricals + maps + a numeric block (>= 100 raw columns), the
+    shape where host transform_columns loops dominate train()."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    n_cat, card = 50, 150
+    n_real, n_int, n_bin = 25, 10, 5
+    n_nmap, n_pmap, n_set = 8, 6, 4
+    weights = 1.0 / np.arange(1, card + 1)
+    weights /= weights.sum()
+    cats = [rng.choice(card, size=rows, p=weights) for _ in range(n_cat)]
+    reals = [rng.normal(size=rows) for _ in range(n_real)]
+    records = []
+    for i in range(rows):
+        r = {f"c{j}": f"v{cats[j][i]}" for j in range(n_cat)}
+        r.update({f"r{j}": float(reals[j][i]) for j in range(n_real)})
+        r.update({f"i{j}": int(rng.integers(0, 40))
+                  for j in range(n_int)})
+        r.update({f"b{j}": bool(rng.random() > 0.5)
+                  for j in range(n_bin)})
+        # high-cardinality maps: a wide fitted key union (the per-key
+        # columns), each row holding only a few entries
+        r.update({f"nm{j}": {f"k{int(k)}": float(rng.normal())
+                             for k in rng.integers(0, 30,
+                                                   rng.integers(1, 4))}
+                  for j in range(n_nmap)})
+        r.update({f"pm{j}": {f"k{int(k)}": f"p{int(rng.integers(0, 30))}"
+                             for k in rng.integers(0, 20,
+                                                   rng.integers(1, 3))}
+                  for j in range(n_pmap)})
+        r.update({f"s{j}": {f"t{int(t)}"
+                            for t in rng.integers(0, 25,
+                                                  rng.integers(1, 4))}
+                  for j in range(n_set)})
+        r["label"] = float(reals[0][i]
+                           + (cats[0][i] % 7 == 0) * 1.5
+                           + rng.logistic() * 0.5 > 0.3)
+        records.append(r)
+    schema = (
+        [(f"c{j}", "PickList") for j in range(n_cat)]
+        + [(f"r{j}", "Real") for j in range(n_real)]
+        + [(f"i{j}", "Integral") for j in range(n_int)]
+        + [(f"b{j}", "Binary") for j in range(n_bin)]
+        + [(f"nm{j}", "NumericMap") for j in range(n_nmap)]
+        + [(f"pm{j}", "PickListMap") for j in range(n_pmap)]
+        + [(f"s{j}", "MultiPickList") for j in range(n_set)])
+    return records, schema
+
+
+def _measure_prepare() -> dict:
+    """TX_BENCH_MODE=prepare: compiled train-time feature engineering
+    (ISSUE 7). Trains the SAME wide workflow under TX_PREPARE=host (the
+    per-stage transform_columns walk) and TX_PREPARE=plan (the fused
+    device PreparePlan), both warm, and reports the prepare-transform
+    seconds each paid — the fits are identical work on both paths and
+    are excluded, so the ratio isolates exactly what the plan changed.
+    Emits prepare_rows_per_s, the host-vs-device stage split, the
+    placement ledger and prepare_compiles across repeat trains
+    (acceptance: >= 5x on this grid, compiles flat)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from transmogrifai_tpu.utils.jax_setup import enable_compilation_cache
+    enable_compilation_cache()
+    import numpy as np
+
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.models import LogisticRegression
+    from transmogrifai_tpu.ops import transmogrify
+    from transmogrifai_tpu.plans import placement_report, prepare_compiles
+    from transmogrifai_tpu.utils.listener import WorkflowListener
+    from transmogrifai_tpu.workflow import Workflow
+
+    rows = int(os.environ.get("TX_BENCH_PREPARE_ROWS", "3000"))
+    records, schema = _wide_prepare_records(rows)
+
+    def build():
+        feats = [FeatureBuilder.of(name, getattr(T, tname)).extract(
+            lambda r, k=name: r.get(k)).as_predictor()
+            for name, tname in schema]
+        label = FeatureBuilder.of("label", T.RealNN).extract(
+            lambda r: r.get("label")).as_response()
+        vec = transmogrify(feats)
+        checked = vec.sanity_check(label, min_variance=-0.1)
+        pred = LogisticRegression(reg_param=0.05, max_iter=30).set_input(
+            label, checked).get_output()
+        return pred, checked
+
+    def train(mode):
+        """Cold + warm train of ONE workflow (the retraining-loop
+        scenario the segment cache serves); returns the WARM numbers —
+        both paths pay identical fits, and the transform-phase stage
+        seconds isolate the prepare walk."""
+        os.environ["TX_PREPARE"] = mode
+        pred, checked = build()
+        wf = (Workflow().set_result_features(pred)
+              .set_input_records(records))
+        wf.train(validate="off")            # cold: pays the compiles
+        listener = WorkflowListener()
+        wf.with_listener(listener)
+        c0 = prepare_compiles()
+        t0 = time.perf_counter()
+        model = wf.train(validate="off")    # warm repeat
+        wall = time.perf_counter() - t0
+        transform_s = sum(m.seconds for m in listener.metrics.stage_metrics
+                          if m.phase == "transform")
+        return (model, wf, checked, transform_s, wall,
+                prepare_compiles() - c0)
+
+    try:
+        m_host, _, checked_h, host_s, host_wall, _ = train("host")
+        # the warm repeat train must add zero programs
+        m_plan, wf, checked_p, _, plan_wall, repeat_compiles = \
+            train("plan")
+        plan_desc = wf.last_prepare_plan.describe()
+        plan_s = (plan_desc["device_transform_seconds"]
+                  + plan_desc["host_transform_seconds"])
+    finally:
+        os.environ.pop("TX_PREPARE", None)
+
+    # parity spot check on the matrix the selector would consume
+    a = np.asarray(m_plan.train_dataset[checked_p.name].data)
+    b = np.asarray(m_host.train_dataset[checked_h.name].data)
+    parity_dev = float(np.max(np.abs(a - b))) if a.shape == b.shape \
+        else float("inf")
+    value = rows / max(plan_s, 1e-9)
+    cov = plan_desc["coverage"]
+    return {
+        "metric": "prepare_rows_per_s",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        # headline ratio: warm host transform_columns walk vs the warm
+        # fused plan, same workflow, same rows, fits excluded
+        "vs_baseline": round(host_s / max(plan_s, 1e-9), 2),
+        "speedup_vs_host_loop": round(host_s / max(plan_s, 1e-9), 2),
+        "host_prepare_seconds": round(host_s, 4),
+        "plan_prepare_seconds": round(plan_s, 4),
+        "plan_device_seconds": plan_desc["device_transform_seconds"],
+        "plan_host_fallback_seconds":
+            plan_desc["host_transform_seconds"],
+        "host_train_wall_seconds": round(host_wall, 2),
+        "plan_train_wall_seconds": round(plan_wall, 2),
+        "rows": rows,
+        "raw_columns": len(schema),
+        "matrix_width": int(a.shape[1]),
+        "device_stages": len(cov["lowered"]),
+        "fallback_stages": len(cov["fallback"]),
+        "lowered_fraction": cov["lowered_fraction"],
+        "fallbacks": cov["fallback"],
+        "fit_placements": plan_desc["fit_placements"],
+        "placement_report": placement_report(),
+        "prepare_compiles": repeat_compiles,
+        "prepare_parity_max_dev": parity_dev,
+        "platform": "cpu",
+    }
+
+
 def _measure_sharded_search() -> dict:
     """TX_BENCH_MODE=sharded_search: the selector's device-mesh scaling
     curve (ISSUE 6). Provisions a virtual CPU device pool (
@@ -635,6 +796,8 @@ def _measure_sharded_search() -> dict:
 def _measure() -> dict:
     if os.environ.get("TX_BENCH_MODE") == "sharded_search":
         return _measure_sharded_search()
+    if os.environ.get("TX_BENCH_MODE") == "prepare":
+        return _measure_prepare()
     if os.environ.get("TX_BENCH_MODE") == "score":
         return _measure_score()
     if os.environ.get("TX_BENCH_MODE") == "racing":
@@ -813,9 +976,10 @@ def _probe_ambient() -> tuple[bool, str, list]:
 
 
 def main() -> None:
-    if os.environ.get("TX_BENCH_MODE") == "sharded_search":
-        # the sweep is DEFINED on a forced-CPU virtual device pool
-        # (1 -> N devices on one host): no ambient probe, no child
+    if os.environ.get("TX_BENCH_MODE") in ("sharded_search", "prepare"):
+        # these modes are DEFINED on the forced-CPU backend (the
+        # sharded sweep on a virtual device pool, the prepare
+        # comparison on the x64 CPU path): no ambient probe, no child
         # watchdog — the CPU backend cannot hang
         try:
             out = _measure()
@@ -867,6 +1031,8 @@ def main() -> None:
 def _headline_metric() -> tuple:
     if os.environ.get("TX_BENCH_MODE") == "sharded_search":
         return "sharded_models_x_folds_per_sec", "models_x_folds/s"
+    if os.environ.get("TX_BENCH_MODE") == "prepare":
+        return "prepare_rows_per_s", "rows/s"
     if os.environ.get("TX_BENCH_MODE") == "score":
         return "score_rows_per_s", "rows/s"
     if os.environ.get("TX_BENCH_MODE") == "racing":

@@ -313,6 +313,49 @@ class TestJaxAstRules:
         # and transform_value loops OUTSIDE serving/ are not its business
         assert lint_source(code, "x/local/loop.py") == []
 
+    def test_j09_train_path_transform_columns_walk(self):
+        code = textwrap.dedent("""
+            def fit_layer(model, ds, names):
+                return model.transform_columns([ds[n] for n in names])
+        """)
+        findings = lint_source(
+            code, "transmogrifai_tpu/workflow/workflow.py")
+        assert [f.rule_id for f in findings] == ["TX-J09"]
+        assert findings[0].severity == "warning"
+        assert "prepare" in (findings[0].hint or "")
+        # transform_dataset is the same host walk
+        findings = lint_source(textwrap.dedent("""
+            def fit_layer(stage, ds):
+                return stage.transform_dataset(ds)
+        """), "x/workflow/runner.py")
+        assert [f.rule_id for f in findings] == ["TX-J09"]
+        # the SAME source outside workflow/ is not its business (the
+        # prepare plan's own recorded host fallbacks live in plans/)
+        assert lint_source(code,
+                           "transmogrifai_tpu/plans/prepare.py") == []
+
+    def test_j09_train_path_transform_value_loop(self):
+        code = textwrap.dedent("""
+            def prepare(stage, rows):
+                return [stage.transform_value(r) for r in rows]
+        """)
+        findings = lint_source(code, "x/workflow/exec.py")
+        assert [f.rule_id for f in findings] == ["TX-J09"]
+        assert findings[0].severity == "error"
+
+    def test_j09_escape_hatch_suppression(self, tmp_path):
+        # the blessed TX_PREPARE=host walk carries an inline disable —
+        # visible, reviewable, and honored by the engine
+        d = tmp_path / "workflow"
+        d.mkdir()
+        p = d / "mod.py"
+        p.write_text(
+            "def f(model, cols):\n"
+            "    return model.transform_columns(cols)"
+            "  # tx-lint: disable=TX-J09\n")
+        findings, _ = lint_paths([str(p)])
+        assert findings == []
+
     def test_j07_grid_value_into_static_argname(self):
         findings = _src("""
             import functools

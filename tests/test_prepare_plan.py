@@ -1,0 +1,480 @@
+"""Compiled train-time prepare tests (plans/prepare.py, ISSUE 7).
+
+Parity suite: ``Workflow.train()`` with the fused device prepare path
+(TX_PREPARE=plan, the default) must reproduce the host
+``transform_columns`` reference for every transmogrify family at 1e-6
+— bitwise for the integer/one-hot families — across row counts that
+straddle bucket boundaries, with the sharded-search mesh active, plus
+repeat-train zero-recompile, placement-policy, device-fit and
+stage-profile-fidelity tests.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LinearSVC, LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.plans import (PlacementPolicy, PreparePlan,
+                                     prepare_compiles)
+from transmogrifai_tpu.testkit import (RandomBinary, RandomData,
+                                       RandomIntegral, RandomList,
+                                       RandomMap, RandomReal, RandomSet,
+                                       RandomText)
+from transmogrifai_tpu.types import (Binary, Date, DateList, DateMap,
+                                     Geolocation, Integral, MultiPickList,
+                                     MultiPickListMap, NumericMap, PickList,
+                                     PickListMap, Real, RealNN)
+from transmogrifai_tpu.workflow import Workflow
+
+#: families whose kernels are pure gather/compare/concat — the fused
+#: program must match the host path BITWISE, not just to tolerance
+_BITWISE_FAMILIES = ("flag", "k", "pick", "tags", "words", "sets")
+
+
+def _family_generators(seed0: int):
+    return {
+        "real": (Real, RandomReal.normal(0, 2, seed=seed0 + 1)
+                 .with_probability_of_empty(0.2)),
+        "k": (Integral, RandomIntegral.integers(0, 50, seed=seed0 + 2)
+              .with_probability_of_empty(0.15)),
+        "flag": (Binary, RandomBinary(0.4, seed=seed0 + 3)
+                 .with_probability_of_empty(0.1)),
+        "when": (Date, RandomIntegral.dates(seed=seed0 + 4)
+                 .with_probability_of_empty(0.2)),
+        "pick": (PickList, RandomText.picklists(
+            ["a", "b", "c", "d"], seed=seed0 + 5)
+            .with_probability_of_empty(0.15)),
+        "tags": (MultiPickList, RandomSet(
+            ["x", "y", "z", "w"], seed=seed0 + 6)
+            .with_probability_of_empty(0.2)),
+        "nums": (NumericMap, RandomMap(
+            RandomReal.uniform(0, 5, seed=seed0 + 8), NumericMap,
+            min_size=1, max_size=3, seed=seed0 + 9)
+            .with_probability_of_empty(0.2)),
+        "words": (PickListMap, RandomMap(
+            RandomText.picklists(["p", "q", "r"], seed=seed0 + 10),
+            PickListMap, min_size=1, max_size=3, seed=seed0 + 11)
+            .with_probability_of_empty(0.2)),
+        "sets": (MultiPickListMap, RandomMap(
+            RandomSet(["m", "n", "o"], seed=seed0 + 12),
+            MultiPickListMap, min_size=1, max_size=2, seed=seed0 + 13)
+            .with_probability_of_empty(0.2)),
+        "whens": (DateMap, RandomMap(
+            RandomIntegral.dates(seed=seed0 + 14), DateMap,
+            min_size=1, max_size=2, seed=seed0 + 15)
+            .with_probability_of_empty(0.2)),
+        "dates": (DateList, RandomList(
+            RandomIntegral.dates(seed=seed0 + 16), min_size=1,
+            max_size=3, ftype=DateList, seed=seed0 + 17)
+            .with_probability_of_empty(0.3)),
+    }
+
+
+def _records(n: int, seed0: int):
+    gens = _family_generators(seed0)
+    data = RandomData(seed=seed0)
+    for name, (_, gen) in gens.items():
+        data.with_column(name, gen)
+    records = data.records(n)
+    rng = np.random.default_rng(seed0)
+    for i, r in enumerate(records):
+        # geolocation triples (the testkit has no geo generator)
+        r["where"] = (None if rng.random() < 0.2 else
+                      (float(rng.uniform(-60, 60)),
+                       float(rng.uniform(-150, 150)), 1.0))
+        r["label"] = float((r["real"] or 0)
+                           + (1.0 if r["pick"] == "a" else 0.0)
+                           + 0.5 * rng.normal() > 0.5)
+    return records
+
+
+def _features():
+    feats = []
+    for name, (ftype, _) in _family_generators(100).items():
+        feats.append(FeatureBuilder.of(name, ftype).extract(
+            lambda r, k=name: r.get(k)).as_predictor())
+    feats.append(FeatureBuilder.of("where", Geolocation).extract(
+        lambda r: r.get("where")).as_predictor())
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    return feats, label
+
+
+def _train(records, mode: str, listener=None, placement_mode=None,
+           model_stage=None):
+    """One train under TX_PREPARE=mode; returns (workflow, model,
+    feature handles)."""
+    feats, label = _features()
+    vec = transmogrify(feats)
+    checked = vec.sanity_check(label, min_variance=-0.1)
+    stage = model_stage or LogisticRegression(reg_param=0.05, max_iter=50)
+    pred = stage.set_input(label, checked).get_output()
+    wf = Workflow().set_result_features(pred).set_input_records(records)
+    if listener is not None:
+        wf.with_listener(listener)
+    prev = os.environ.get("TX_PREPARE")
+    prev_fit = os.environ.get("TX_PREPARE_FIT")
+    os.environ["TX_PREPARE"] = mode
+    if placement_mode is not None:
+        os.environ["TX_PREPARE_FIT"] = placement_mode
+    try:
+        model = wf.train(validate="off")
+    finally:
+        if prev is None:
+            os.environ.pop("TX_PREPARE", None)
+        else:
+            os.environ["TX_PREPARE"] = prev
+        if placement_mode is not None:
+            if prev_fit is None:
+                os.environ.pop("TX_PREPARE_FIT", None)
+            else:
+                os.environ["TX_PREPARE_FIT"] = prev_fit
+    return wf, model, (vec, checked, pred)
+
+
+class TestFamilyParity:
+    """Fused device prepare == host transform_columns reference, every
+    family, across row counts that straddle the bucket ladder."""
+
+    @pytest.mark.parametrize("n", [1, 7, 8, 9, 1000])
+    def test_prepared_matrix_parity_across_row_counts(self, n):
+        records = _records(n, seed0=900 + n)
+        _, m_plan, (vec, checked, pred) = _train(records, "plan")
+        _, m_host, (vec2, checked2, pred2) = _train(records, "host")
+        for name, name2 in ((vec.name, vec2.name),
+                            (checked.name, checked2.name)):
+            a = np.asarray(m_plan.train_dataset[name].data)
+            b = np.asarray(m_host.train_dataset[name2].data)
+            assert a.shape == b.shape
+            np.testing.assert_allclose(a, b, atol=1e-6)
+        # prediction column: the model trained on the device matrix
+        # must score the training rows identically
+        pa = np.asarray(m_plan.train_dataset[pred.name].data)
+        pb = np.asarray(m_host.train_dataset[pred2.name].data)
+        np.testing.assert_allclose(pa, pb, atol=1e-6)
+
+    def test_integer_onehot_families_bitwise(self):
+        records = _records(200, seed0=321)
+        _, m_plan, (vec, _, _) = _train(records, "plan")
+        _, m_host, (vec2, _, _) = _train(records, "host")
+        col_a = m_plan.train_dataset[vec.name]
+        col_b = m_host.train_dataset[vec2.name]
+        meta = col_a.metadata
+        a = np.asarray(col_a.data)
+        b = np.asarray(col_b.data)
+        # vector metadata identical (same column provenance), then the
+        # pure gather/compare families' blocks compare BITWISE
+        assert meta.column_names() == col_b.metadata.column_names()
+        picked = [j for j, mc in enumerate(meta.columns)
+                  if mc.parent_feature_name in _BITWISE_FAMILIES]
+        assert picked, "no indicator columns found"
+        assert np.array_equal(a[:, picked], b[:, picked])
+
+    def test_coverage_lowers_every_kernel_family(self):
+        records = _records(150, seed0=555)
+        wf, _, _ = _train(records, "plan")
+        plan = wf.last_prepare_plan
+        assert plan is not None
+        lowered = " ".join(plan.coverage.lowered)
+        for cls in ("RealVectorizerModel", "OneHotVectorizerModel",
+                    "MultiPickListVectorizerModel",
+                    "DateToUnitCircleVectorizer",
+                    "RealMapVectorizerModel",
+                    "TextMapPivotVectorizerModel",
+                    "DateMapToUnitCircleVectorizerModel",
+                    "GeolocationVectorizerModel", "VectorsCombiner",
+                    "SanityCheckerModel"):
+            assert cls in lowered, cls
+        # date lists keep their numpy fallback, with the reason
+        fallback = " ".join(n for n, _ in plan.coverage.fallback)
+        assert "DateListVectorizer" in fallback
+        assert all(reason for _, reason in plan.coverage.fallback)
+
+
+class TestMeshActiveParity:
+    """With the sharded-search mesh active (the 8-virtual-device test
+    pool), a full ModelSelector train under the compiled prepare path
+    picks the same winner with the same metric vectors as the host
+    path — the device-resident matrix feeds the sharded search with no
+    behavioural drift."""
+
+    def _selector(self):
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.selector import (CrossValidation,
+                                                ModelSelector)
+        return ModelSelector(
+            models=[(LogisticRegression(max_iter=40),
+                     [{"reg_param": 1e-3}, {"reg_param": 1e-1}]),
+                    (LinearSVC(max_iter=40), [{"reg_param": 1e-2}])],
+            validator=CrossValidation(BinaryClassificationEvaluator(),
+                                      num_folds=3, seed=7))
+
+    def test_selector_winner_and_metrics_identical(self):
+        import jax
+        assert len(jax.devices()) > 1   # the conftest virtual pool
+        records = _records(240, seed0=777)
+        _, m_plan, _ = _train(records, "plan",
+                              model_stage=self._selector())
+        _, m_host, _ = _train(records, "host",
+                              model_stage=self._selector())
+
+        def summary(model):
+            from transmogrifai_tpu.selector import SelectedModel
+            for s in model.stages():
+                if isinstance(s, SelectedModel):
+                    return s.summary
+            raise AssertionError("no SelectedModel")
+
+        sa, sb = summary(m_plan), summary(m_host)
+        assert sa.best_model_name == sb.best_model_name
+        assert sa.best_model_params == sb.best_model_params
+        assert sa.best_validation_metric == sb.best_validation_metric
+        for ra, rb in zip(sa.validation_results, sb.validation_results):
+            assert ra.params == rb.params
+            assert ra.metric_values == rb.metric_values
+        # model insights byte-identical up to stage uids (the two
+        # workflows are separately built, so uids differ by counter)
+        import json
+        import re
+
+        def norm(model):
+            s = json.dumps(model.model_insights().to_json(),
+                           sort_keys=True, default=str)
+            return re.sub(r"[0-9a-f]{12}", "UID", s)
+
+        assert norm(m_plan) == norm(m_host)
+
+    def test_matrix_reaches_search_device_resident(self):
+        import jax
+        records = _records(160, seed0=888)
+        selector = self._selector()
+        seen = {}
+        orig = type(selector).fit_arrays
+
+        def spy(self_, X, y):
+            seen["X"] = X
+            return orig(self_, X, y)
+
+        import unittest.mock as mock
+        with mock.patch.object(type(selector), "fit_arrays", spy):
+            _train(records, "plan", model_stage=selector)
+        assert isinstance(seen["X"], jax.Array)
+
+
+class TestRepeatTrainCompiles:
+    def test_repeat_train_zero_new_prepare_compiles(self):
+        # the retraining-loop scenario: the SAME workflow re-trains on
+        # identical data — fitted state fingerprints match, so every
+        # segment program replays from the cache with zero new compiles
+        records = _records(120, seed0=444)
+        feats, label = _features()
+        vec = transmogrify(feats)
+        checked = vec.sanity_check(label, min_variance=-0.1)
+        pred = LogisticRegression(reg_param=0.05, max_iter=50).set_input(
+            label, checked).get_output()
+        wf = (Workflow().set_result_features(pred)
+              .set_input_records(records))
+        os.environ["TX_PREPARE"] = "plan"
+        try:
+            wf.train(validate="off")       # warm: pays the compiles
+            before = prepare_compiles()
+            wf.train(validate="off")       # retrain, identical data
+        finally:
+            os.environ.pop("TX_PREPARE", None)
+        assert prepare_compiles() == before
+        assert wf.last_prepare_plan.segments_run >= 1
+
+    def test_different_data_same_shape_reuses_nothing_stale(self):
+        # different records -> different fitted state -> the plan must
+        # NOT reuse the cached programs' baked-in constants
+        _, m1, (vec1, _, _) = _train(_records(96, seed0=11), "plan")
+        _, m2, (vec2, _, _) = _train(_records(96, seed0=22), "plan")
+        a = np.asarray(m1.train_dataset[vec1.name].data)
+        b = np.asarray(m2.train_dataset[vec2.name].data)
+        assert a.shape[0] == b.shape[0]
+        assert not np.array_equal(a, b)
+
+
+class TestFitPlacement:
+    @staticmethod
+    def _checker(model):
+        from transmogrifai_tpu.checkers import SanityCheckerModel
+        for s in model.stages():
+            if isinstance(s, SanityCheckerModel):
+                return s
+        raise AssertionError("no SanityCheckerModel")
+
+    def test_sanity_checker_device_fit_identical_to_host_fit(self):
+        # same prepared matrix (plan mode both times), fit placed on
+        # device vs pulled to host: the fitted state must be IDENTICAL
+        # — the stats kernels are the same XLA programs either way and
+        # the contingency tables are exact integer counts
+        records = _records(300, seed0=202)
+        _, m_dev, (_, checked_d, _) = _train(records, "plan",
+                                             placement_mode="device")
+        _, m_hfit, (_, checked_h, _) = _train(records, "plan",
+                                              placement_mode="host")
+        ca, cb = self._checker(m_dev), self._checker(m_hfit)
+        assert ca.kept_indices == cb.kept_indices
+        ja = [c.to_json() for c in ca.summary.column_stats]
+        jb = [c.to_json() for c in cb.summary.column_stats]
+        # identical, not just close (NaN-aware: nan != nan in dicts)
+        import json
+        assert json.dumps(ja, sort_keys=True) \
+            == json.dumps(jb, sort_keys=True)
+        np.testing.assert_array_equal(
+            np.asarray(m_dev.train_dataset[checked_d.name].data),
+            np.asarray(m_hfit.train_dataset[checked_h.name].data))
+
+    def test_sanity_checker_decisions_match_host_prepare(self):
+        # across prepare modes the matrices may differ in the last ulp
+        # (XLA vs numpy trig for date columns), but the pruning
+        # DECISIONS must agree
+        records = _records(300, seed0=202)
+        _, m_dev, _ = _train(records, "plan", placement_mode="device")
+        _, m_host, _ = _train(records, "host")
+        ca, cb = self._checker(m_dev), self._checker(m_host)
+        assert ca.kept_indices == cb.kept_indices
+        assert ([c.is_dropped for c in ca.summary.column_stats]
+                == [c.is_dropped for c in cb.summary.column_stats])
+
+    def test_placement_records_and_env_override(self):
+        from transmogrifai_tpu.plans import placement_report
+        records = _records(80, seed0=303)
+        wf_d, _, _ = _train(records, "plan", placement_mode="device")
+        placements = dict(
+            (name.split("(")[0], where)
+            for name, where, _ in wf_d.last_prepare_plan.fit_placements)
+        assert placements["SanityChecker"] == "device"
+        wf_h, _, _ = _train(records, "plan", placement_mode="host")
+        placements = dict(
+            (name.split("(")[0], where)
+            for name, where, _ in wf_h.last_prepare_plan.fit_placements)
+        assert placements["SanityChecker"] == "host"
+        rows = {(r["stage"], r["placement"]) for r in placement_report()}
+        assert ("SanityChecker", "device") in rows
+        assert ("SanityChecker", "host") in rows
+
+    def test_auto_placement_is_recorded_cost_driven(self):
+        from transmogrifai_tpu.plans.placement import (_record,
+                                                       reset_placement)
+        pol = PlacementPolicy(mode="auto")
+        from transmogrifai_tpu.checkers import SanityChecker
+        stage = SanityChecker()
+        reset_placement()
+        try:
+            where, why = pol.decide_fit(stage, 100)
+            assert where == "device" and "no record" in why
+            # device steady-state much worse than host -> host wins
+            _record("SanityChecker", "device", 2.0, 0.0, 100)
+            _record("SanityChecker", "host", 0.1, 0.0, 100)
+            where, why = pol.decide_fit(stage, 100)
+            assert where == "host" and "recorded" in why
+            # compile-heavy device record: steady state is what counts
+            reset_placement()
+            _record("SanityChecker", "device", 2.0, 1.99, 100)
+            _record("SanityChecker", "host", 0.1, 0.0, 100)
+            where, _ = pol.decide_fit(stage, 100)
+            assert where == "device"
+        finally:
+            reset_placement()
+
+    def test_subclass_fit_columns_override_opts_out(self):
+        from transmogrifai_tpu.checkers import SanityChecker
+
+        class Counting(SanityChecker):
+            calls = 0
+
+            def fit_columns(self, cols):
+                Counting.calls += 1
+                return super().fit_columns(cols)
+
+        assert SanityChecker().supports_device_fit()
+        assert not Counting().supports_device_fit()
+
+
+class TestTelemetryFidelity:
+    """Satellite: stages fused into one device program still attribute
+    per-stage compile/execute seconds (plan-section labels)."""
+
+    def test_listener_keeps_per_stage_rows(self):
+        from transmogrifai_tpu.utils.listener import WorkflowListener
+        records = _records(150, seed0=606)
+        listener = WorkflowListener()
+        wf, _, _ = _train(records, "plan", listener=listener)
+        plan = wf.last_prepare_plan
+        assert plan is not None and plan.coverage.lowered
+        by_stage = {}
+        for m in listener.metrics.stage_metrics:
+            by_stage.setdefault(m.stage_name, []).append(m)
+        # every lowered stage has a transform row with the split
+        for label in plan.coverage.lowered:
+            cls = label.split("(")[0]
+            rows = [m for ms in by_stage.values() for m in ms
+                    if m.stage_name.startswith(cls)
+                    and m.phase == "transform"]
+            assert rows, f"no transform row for {cls}"
+            assert all(m.seconds >= m.compile_seconds >= 0.0
+                       for m in rows)
+        # and the section accumulator carries the plan labels
+        from transmogrifai_tpu.utils import compile_time
+        sections = compile_time.seconds_by_section("prepare:")
+        assert any(k.startswith("prepare:seg") for k in sections)
+        assert any(k.startswith("prepare:stage:") for k in sections)
+
+    def test_stage_profile_top_renders_prepare_stages(self):
+        from transmogrifai_tpu.utils.listener import WorkflowListener
+        records = _records(80, seed0=707)
+        listener = WorkflowListener()
+        _train(records, "plan", listener=listener)
+        pretty = listener.metrics.profile_pretty(top=10)
+        assert "combineVector" in pretty or "sanityChecker" in pretty
+
+
+class TestGracefulDegradation:
+    def test_injected_compile_fault_demotes_stage_with_parity(self):
+        from transmogrifai_tpu.runtime import FaultInjector
+        records = _records(120, seed0=808)
+        _, m_host, (vec_h, checked_h, _) = _train(records, "host")
+        with FaultInjector.plan("prepare:VectorsCombiner:compile:1=bug"):
+            wf, m_deg, (vec_d, checked_d, _) = _train(records, "plan")
+        plan = wf.last_prepare_plan
+        names = [n for n, _ in plan.coverage.fallback]
+        reasons = [r for _, r in plan.coverage.fallback]
+        assert any("VectorsCombiner" in n for n in names)
+        assert any("injected compile fault" in r for r in reasons)
+        np.testing.assert_allclose(
+            np.asarray(m_deg.train_dataset[checked_d.name].data),
+            np.asarray(m_host.train_dataset[checked_h.name].data),
+            atol=1e-6)
+
+    def test_prepare_mode_validation(self):
+        records = _records(10, seed0=909)
+        os.environ["TX_PREPARE"] = "warp"
+        try:
+            with pytest.raises(ValueError, match="TX_PREPARE"):
+                _train(records, "warp")
+        finally:
+            os.environ.pop("TX_PREPARE", None)
+
+
+class TestStandaloneScalers:
+    def test_scaler_device_fit_close_to_host(self):
+        from transmogrifai_tpu.ops.dsl import (FillMissingWithMean,
+                                               StandardScaler)
+        from transmogrifai_tpu.features.columns import FeatureColumn
+        rng = np.random.default_rng(5)
+        vals = rng.normal(size=500)
+        vals[rng.random(500) < 0.2] = np.nan
+        col = FeatureColumn(ftype=Real, data=vals)
+        for est in (FillMissingWithMean(), StandardScaler()):
+            assert est.supports_device_fit()
+            host = est.fit_columns([col])
+            dev = est.fit_device([vals], [col])
+            for attr in ("fill_value", "mean", "std"):
+                if hasattr(host, attr):
+                    assert abs(getattr(host, attr)
+                               - getattr(dev, attr)) < 1e-9

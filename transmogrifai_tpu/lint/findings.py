@@ -52,6 +52,12 @@ RULES: Dict[str, tuple] = {
     "TX-J08": (WARNING, "shard_map/pjit body closes over an array-like "
                         "value instead of taking it through in_specs — "
                         "implicitly replicated in full to every device"),
+    "TX-J09": (WARNING, "host feature materialization in the train hot "
+                        "path: a transform_columns/transform_dataset "
+                        "walk (or per-row transform_value loop) in "
+                        "workflow/ code that the compiled PreparePlan "
+                        "replaces; only the TX_PREPARE=host escape "
+                        "hatch may stay, inline-suppressed"),
     # -- resilience rules (selector/serving hot paths only) ----------------
     "TX-R01": (ERROR, "except Exception / bare except in a selector or "
                       "serving hot path swallows XlaRuntimeError "

@@ -51,6 +51,14 @@ call) are caught here in milliseconds:
   tracking deliberately stops at them and at non-trivial calls so the
   repo's grouped-statics idiom (trees/mlp static shape groups) stays
   legal.
+- TX-J09 train hot path (``workflow/`` files only): host feature
+  materialization reachable from ``Workflow.train()`` — a direct
+  ``.transform_columns(...)`` call (the per-stage host walk the
+  compiled PreparePlan replaces; stages with ``transform_arrays``
+  kernels should execute fused on device, plans/prepare.py) or a
+  Python per-row loop over ``transform_value``. The TX_PREPARE=host
+  escape hatch is the ONLY blessed host walk and carries an inline
+  suppression so the exemption is visible and reviewable.
 - TX-J08 implicit replication under ``shard_map``/``pjit``: the body
   function closes over an array-like value from the enclosing scope
   instead of receiving it through ``in_specs``. A closed-over operand
@@ -276,6 +284,15 @@ def _is_serving_path(path: str) -> bool:
     return "serving" in re.split(r"[/\\]", path)
 
 
+def _is_train_path(path: str) -> bool:
+    """workflow/ package files get the TX-J09 train-hot-path rule: the
+    code ``Workflow.train()`` executes between raw data and the fitted
+    model, where host transform_columns walks bypass the compiled
+    prepare path (plans/prepare.py)."""
+    import re
+    return "workflow" in re.split(r"[/\\]", path)
+
+
 def _is_resilience_path(path: str) -> bool:
     """selector/ and serving/ files get the TX-R01 exception-swallow
     rule: these are the hot paths where a swallowed XlaRuntimeError
@@ -379,6 +396,7 @@ class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, al: _Aliases):
         self.path = path
         self.serving = _is_serving_path(path)
+        self.train_path = _is_train_path(path)
         self.resilience = _is_resilience_path(path)
         self.record_drop = _is_record_drop_path(path)
         self.al = al
@@ -547,6 +565,19 @@ class _Visitor(ast.NodeVisitor):
                 ERROR,
                 hint="route the batch through ScoringPlan (or at least "
                      "transform_columns); transform_value is the "
+                     "single-record edge only")
+        # TX-J09: the train-time twin — a per-row transform_value loop
+        # in the workflow executor is the hot loop the compiled
+        # PreparePlan replaces
+        if self.train_path and _calls_transform_value(node):
+            self.add(
+                "TX-J09", node,
+                "Python loop over transform_value in the train hot "
+                "path — per-row feature materialization instead of "
+                "the compiled prepare program",
+                ERROR,
+                hint="route prepare through PreparePlan "
+                     "(plans/prepare.py); transform_value is the "
                      "single-record edge only")
 
     def visit_ListComp(self, node: ast.ListComp) -> None:
@@ -733,6 +764,20 @@ class _Visitor(ast.NodeVisitor):
         al = self.al
         # TX-J08: shard_map/pjit closing over unsharded arrays --------------
         self._check_shard_closure(node)
+        # TX-J09: host materialization in the train hot path ----------------
+        if self.train_path and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("transform_columns",
+                                       "transform_dataset"):
+            self.add(
+                "TX-J09", node,
+                f"host {node.func.attr} walk in the train hot path — "
+                f"stages with transform_arrays kernels should execute "
+                f"fused on device via the compiled prepare plan",
+                WARNING,
+                hint="route prepare through PreparePlan "
+                     "(plans/prepare.py); the TX_PREPARE=host escape "
+                     "hatch is the only blessed host walk and must "
+                     "carry an inline suppression")
         # TX-J02 (TX-J06 inside serving/): jax.jit applied at call time ----
         if al.is_jax_jit(node.func):
             per_call_rule = "TX-J06" if self.serving else "TX-J02"

@@ -107,10 +107,12 @@ class OneHotVectorizerModel(SequenceModel):
         index = {c: j for j, c in enumerate(cats)}
         other = len(cats)
         null = other + 1 if self.track_nulls else -1
-        out = np.empty(col.n_rows, dtype=np.int32)
-        for r, v in enumerate(col.data):
-            out[r] = null if v is None else index.get(v, other)
-        return out
+        get = index.get
+        # one C-allocated pass (np.fromiter) — this encoder is the
+        # train-prepare hot loop for wide categorical data
+        return np.fromiter(
+            (null if v is None else get(v, other) for v in col.data),
+            dtype=np.int32, count=col.n_rows)
 
     def transform_arrays(self, arrays):
         import jax

@@ -157,6 +157,20 @@ class FillMissingWithMean(UnaryEstimator):
         fill = float(np.mean(vals[ok])) if ok.any() else self.default_value
         return FillMissingWithMeanModel(fill_value=fill)
 
+    def fit_device(self, arrays, protos) -> FillMissingWithMeanModel:
+        """Compiled-prepare fit statistic on device (plans/prepare.py):
+        a masked mean over the device-resident column — only the one
+        fitted scalar crosses to the host. Summation runs in XLA, so
+        the fill value may differ from the host fit in the last ulp
+        (numpy pairwise vs XLA reduction order; docs/prepare.md)."""
+        import jax.numpy as jnp
+        vals = jnp.asarray(arrays[0]).reshape(-1)
+        ok = ~jnp.isnan(vals)
+        cnt = jnp.sum(ok)
+        mean = jnp.sum(jnp.where(ok, vals, 0.0)) / jnp.maximum(cnt, 1)
+        return FillMissingWithMeanModel(
+            fill_value=float(mean) if int(cnt) else self.default_value)
+
 
 class StandardScalerModel(UnaryModel):
     input_types = (OPNumeric,)
@@ -194,3 +208,17 @@ class StandardScaler(UnaryEstimator):
         mean = float(np.mean(vals[ok])) if ok.any() else 0.0
         std = float(np.std(vals[ok])) if ok.any() else 1.0
         return StandardScalerModel(mean=mean, std=std)
+
+    def fit_device(self, arrays, protos) -> StandardScalerModel:
+        """Masked mean/std on device (see FillMissingWithMean.fit_device
+        for the one-ulp caveat vs the host reduction order)."""
+        import jax.numpy as jnp
+        vals = jnp.asarray(arrays[0]).reshape(-1)
+        ok = ~jnp.isnan(vals)
+        cnt = jnp.maximum(jnp.sum(ok), 1)
+        mean = jnp.sum(jnp.where(ok, vals, 0.0)) / cnt
+        var = jnp.sum(jnp.where(ok, (vals - mean) ** 2, 0.0)) / cnt
+        if not int(jnp.sum(ok)):
+            return StandardScalerModel(mean=0.0, std=1.0)
+        return StandardScalerModel(mean=float(mean),
+                                   std=float(jnp.sqrt(var)))

@@ -21,6 +21,21 @@ from .vector_utils import NULL_INDICATOR, VectorColumnMetadata, vector_output
 __all__ = ["GeolocationVectorizer", "GeolocationVectorizerModel"]
 
 
+def _geo_block(col: FeatureColumn, fill: List[float],
+               track_nulls: bool) -> List[np.ndarray]:
+    """[lat/lon/acc block, null indicator?] for one geolocation column
+    — shared by the columnar path and the compiled plans' boundary
+    encoder so parity is structural."""
+    n = col.n_rows
+    block = np.tile(np.asarray(fill), (n, 1))
+    isnull = np.ones(n)
+    for i, v in enumerate(col.data):
+        if v is not None and len(v):
+            block[i, :] = [v[0], v[1], v[2] if len(v) > 2 else 0.0]
+            isnull[i] = 0.0
+    return [block, isnull] if track_nulls else [block]
+
+
 class GeolocationVectorizerModel(SequenceModel):
     input_types = (Geolocation,)
     output_type = OPVector
@@ -35,26 +50,34 @@ class GeolocationVectorizerModel(SequenceModel):
         blocks, metas = [], []
         for f, col, fill in zip(self.input_features, cols,
                                 self.fill_values):
-            n = col.n_rows
-            block = np.tile(np.asarray(fill), (n, 1))
-            isnull = np.ones(n)
-            for i, v in enumerate(col.data):
-                if v is not None and len(v):
-                    block[i, :] = [v[0], v[1], v[2] if len(v) > 2 else 0.0]
-                    isnull[i] = 0.0
-            blocks.append(block)
+            blocks.extend(_geo_block(col, fill, self.track_nulls))
             for p in ("lat", "lon", "acc"):
                 metas.append(VectorColumnMetadata(
                     parent_feature_name=f.name,
                     parent_feature_type=f.ftype.__name__,
                     descriptor_value=p))
             if self.track_nulls:
-                blocks.append(isnull)
                 metas.append(VectorColumnMetadata(
                     parent_feature_name=f.name,
                     parent_feature_type=f.ftype.__name__,
                     indicator_value=NULL_INDICATOR))
         return vector_output(self.get_output().name, blocks, metas)
+
+    # -- compiled-plan lowering: the (lat, lon, acc) extraction from
+    # object triples is inherently a host walk, so the encoder emits
+    # the dense block (EXACTLY _geo_block) and the kernel is the concat
+    # that fuses it into the downstream program.
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        parts = _geo_block(col, self.fill_values[i], self.track_nulls)
+        return np.concatenate(
+            [p if p.ndim == 2 else p[:, None] for p in parts], axis=1)
+
+    def transform_arrays(self, arrays):
+        import jax.numpy as jnp
+        return jnp.concatenate(arrays, axis=1)
 
 
 class GeolocationVectorizer(SequenceEstimator):

@@ -83,7 +83,8 @@ class RealMapVectorizerModel(SequenceModel):
                         parent_feature_name=f.name,
                         parent_feature_type=f.ftype.__name__, grouping=k,
                         indicator_value=NULL_INDICATOR))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)
 
     # -- compiled-serving lowering: the per-key dict walk runs on host
     # (one (n, n_keys) NaN-missing matrix per input); impute + null
@@ -112,12 +113,19 @@ class RealMapVectorizerModel(SequenceModel):
 
 def _map_values_matrix(col: FeatureColumn, keys: Sequence[str]
                        ) -> np.ndarray:
-    """(n, len(keys)) float matrix of map values, NaN = key absent."""
+    """(n, len(keys)) float matrix of map values, NaN = key absent.
+    Walks each row's ENTRIES rather than the key union — real maps are
+    sparse (a few entries against a wide fitted key set), so this is
+    O(rows x entries), the encoder's train-prepare hot-loop bound."""
     out = np.full((col.n_rows, len(keys)), np.nan)
-    for j, k in enumerate(keys):
-        for r, m in enumerate(col.data):
-            if m and k in m and m[k] is not None:
-                out[r, j] = float(m[k])
+    pos = {k: j for j, k in enumerate(keys)}
+    get = pos.get
+    for r, m in enumerate(col.data):
+        if m:
+            for k, v in m.items():
+                j = get(k)
+                if j is not None and v is not None:
+                    out[r, j] = float(v)
     return out
 
 
@@ -217,7 +225,8 @@ class TextMapPivotVectorizerModel(SequenceModel):
                         parent_feature_name=f.name,
                         parent_feature_type=f.ftype.__name__,
                         grouping=k, indicator_value=NULL_INDICATOR))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)
 
     # -- compiled-serving lowering: per-key level->index lookup on host
     # ((n, n_keys) int32), per-key one-hot expansion on device. Index
@@ -227,16 +236,24 @@ class TextMapPivotVectorizerModel(SequenceModel):
 
     def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
         keys, cats = self.keys[i], self.categories[i]
-        out = np.empty((col.n_rows, len(keys)), dtype=np.int32)
-        for j, k in enumerate(keys):
-            levels = cats.get(k, [])
-            index = {c: q for q, c in enumerate(levels)}
-            other = len(levels)
-            null = other + 1 if self.track_nulls else -1
-            for r, m in enumerate(col.data):
-                v = m.get(k) if m else None
-                out[r, j] = null if v is None \
-                    else index.get(str(v), other)
+        kpos = {k: j for j, k in enumerate(keys)}
+        indexes = [{c: q for q, c in enumerate(cats.get(k, []))}
+                   for k in keys]
+        others = [len(cats.get(k, [])) for k in keys]
+        # every slot starts at its key's NULL index; one sparse pass
+        # over each row's ENTRIES fills the present keys (see
+        # _map_values_matrix for the hot-loop rationale)
+        null_row = np.asarray(
+            [o + 1 if self.track_nulls else -1 for o in others],
+            dtype=np.int32)
+        out = np.tile(null_row, (col.n_rows, 1))
+        kget = kpos.get
+        for r, m in enumerate(col.data):
+            if m:
+                for k, v in m.items():
+                    j = kget(k)
+                    if j is not None and v is not None:
+                        out[r, j] = indexes[j].get(str(v), others[j])
         return out
 
     def transform_arrays(self, arrays):
@@ -355,7 +372,8 @@ class _MultiPickListMapModel(TextMapPivotVectorizerModel):
                         parent_feature_name=f.name,
                         parent_feature_type=f.ftype.__name__,
                         grouping=k, indicator_value=NULL_INDICATOR))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)
 
     # -- compiled-serving lowering: like MultiPickListVectorizer, the
     # per-key multi-hot is inherently a host dict walk, so the encoder
@@ -428,7 +446,41 @@ class GeolocationMapVectorizerModel(SequenceModel):
                         parent_feature_name=f.name,
                         parent_feature_type=f.ftype.__name__,
                         grouping=k, indicator_value=NULL_INDICATOR))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)
+
+    # -- compiled-plan lowering: per-key triple extraction is a host
+    # dict walk, so the encoder emits the dense per-key block and the
+    # kernel is the concat that fuses it into the downstream program.
+    def encodes_input(self, i: int) -> bool:
+        return True
+
+    def encode_input_column(self, i: int, col: FeatureColumn) -> np.ndarray:
+        n = col.n_rows
+        keys, fills = self.keys[i], self.fill_values[i]
+        width = len(keys) * (4 if self.track_nulls else 3)
+        out = np.zeros((n, width), dtype=np.float64)
+        pos = 0
+        for k in keys:
+            fill = fills.get(k, [0.0, 0.0, 0.0])
+            block = np.tile(np.asarray(fill), (n, 1))
+            isnull = np.ones(n)
+            for r, m in enumerate(col.data):
+                v = m.get(k) if m else None
+                if v:
+                    block[r, :] = [v[0], v[1],
+                                   v[2] if len(v) > 2 else 0.0]
+                    isnull[r] = 0.0
+            out[:, pos:pos + 3] = block
+            pos += 3
+            if self.track_nulls:
+                out[:, pos] = isnull
+                pos += 1
+        return out
+
+    def transform_arrays(self, arrays):
+        import jax.numpy as jnp
+        return jnp.concatenate(arrays, axis=1)
 
 
 class GeolocationMapVectorizer(SequenceEstimator):
@@ -528,7 +580,8 @@ class SmartTextMapVectorizerModel(SequenceModel):
                             parent_feature_name=f.name,
                             parent_feature_type=f.ftype.__name__,
                             grouping=k, indicator_value=NULL_INDICATOR))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)
 
 
 class SmartTextMapVectorizer(SequenceEstimator):
@@ -613,7 +666,8 @@ class DateMapToUnitCircleVectorizerModel(SequenceModel):
                         parent_feature_name=f.name,
                         parent_feature_type=f.ftype.__name__, grouping=k,
                         descriptor_value=f"{trig}_{self.time_period}"))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)
 
     # -- compiled-serving lowering: host encodes (n, n_keys) phases
     # (int64 epoch math stays on host), device projects sin/cos per key
@@ -745,7 +799,8 @@ class _TextMapLenModel(SequenceModel):
                     parent_feature_name=f.name,
                     parent_feature_type=f.ftype.__name__, grouping=k,
                     descriptor_value="textLen"))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)
 
 
 class TextMapNullEstimator(SequenceEstimator):
@@ -785,4 +840,5 @@ class _TextMapNullModel(SequenceModel):
                     parent_feature_name=f.name,
                     parent_feature_type=f.ftype.__name__, grouping=k,
                     indicator_value=NULL_INDICATOR))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks, metas,
+                             n_rows=cols[0].n_rows if cols else 0)

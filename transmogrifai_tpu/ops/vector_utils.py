@@ -15,13 +15,18 @@ __all__ = ["vector_output", "stable_hash", "NULL_INDICATOR",
 
 
 def vector_output(name: str, blocks: Sequence[np.ndarray],
-                  columns: Sequence[VectorColumnMetadata]) -> FeatureColumn:
-    """Assemble per-feature column blocks into one OPVector column."""
+                  columns: Sequence[VectorColumnMetadata],
+                  n_rows: int = 0) -> FeatureColumn:
+    """Assemble per-feature column blocks into one OPVector column.
+    ``n_rows`` sizes the zero-width matrix when ``blocks`` is empty —
+    a map vectorizer fitted with ZERO keys (all-empty training maps)
+    must still emit one (n, 0) row per input row, not a (0, 0) column
+    that breaks the dataset's row-count invariant."""
     if blocks:
         mat = np.concatenate([np.atleast_2d(b.T).T if b.ndim == 1
                               else b for b in blocks], axis=1)
     else:
-        mat = np.zeros((0, 0), dtype=np.float64)
+        mat = np.zeros((n_rows, 0), dtype=np.float64)
     meta = VectorMetadata(name=name, columns=tuple(columns))
     return FeatureColumn.vector(mat, meta)
 

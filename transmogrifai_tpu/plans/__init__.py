@@ -1,0 +1,23 @@
+"""Compiled execution plans over a feature DAG.
+
+One kernel library, two front doors: the serving :class:`ScoringPlan`
+(serving/plan.py) freezes a FITTED DAG into fused, shape-bucketed XLA
+programs per request batch, and the train-time :class:`PreparePlan`
+(plans/prepare.py) runs the SAME ``transform_arrays`` kernels while the
+DAG is being fitted — vectorization → combine → fold staging fused into
+jitted segment programs, so the training matrices are born on the
+device the sharded search occupies (docs/prepare.md). ``common.py``
+holds the machinery both share: power-of-two row bucketing, padding +
+validity masks, the zero-row metadata probe, stage classification and
+the compile-cache counters.
+"""
+from .common import (DEFAULT_MAX_BUCKET, DEFAULT_MIN_BUCKET,
+                     PlanCompileError, PlanCoverage, bucket_for,
+                     compiles, pad_rows, record_compile)
+from .placement import PlacementPolicy, placement_report
+from .prepare import PreparePlan, prepare_compiles
+
+__all__ = ["PreparePlan", "prepare_compiles", "PlacementPolicy",
+           "placement_report", "PlanCoverage", "PlanCompileError",
+           "bucket_for", "pad_rows", "compiles", "record_compile",
+           "DEFAULT_MIN_BUCKET", "DEFAULT_MAX_BUCKET"]

@@ -1,0 +1,190 @@
+"""Plan machinery shared by the serving ScoringPlan and the train-time
+PreparePlan.
+
+Factored out of serving/plan.py (PR 2) when the compiled prepare path
+landed: both plans freeze (parts of) a feature DAG into jitted XLA
+programs and need the same primitives —
+
+- **row bucketing**: pad incoming row counts up to power-of-two
+  buckets (``bucket_for``/``pad_rows``) so arbitrary batch/dataset
+  sizes hit a handful of cached compilations,
+- **zero-row metadata probe**: run stages over ZERO rows through the
+  numpy path (milliseconds, no device code) to capture every
+  intermediate column's type/width/metadata (``probe_stage``),
+- **stage classification**: decide per stage whether it can join the
+  device graph (``lowering_reason``) — it must expose an array kernel
+  and every input must be device-available or host-encodable,
+- **compile counters**: namespaced (plan, bucket) program counters
+  (``record_compile``/``compiles``) so benches can assert zero repeat
+  compiles per plan family.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..features.columns import Dataset, FeatureColumn, PredictionColumn
+from ..features.feature import Feature
+from ..stages.base import Transformer
+from ..types import Prediction
+
+__all__ = ["DEFAULT_MIN_BUCKET", "DEFAULT_MAX_BUCKET", "bucket_for",
+           "pad_rows", "PlanCompileError", "PlanStep", "PlanCoverage",
+           "empty_raw_dataset", "probe_stage", "lowering_reason",
+           "fallback_reason", "record_compile", "compiles", "plan_seq"]
+
+#: smallest padded batch — single-record requests share one program
+DEFAULT_MIN_BUCKET = 8
+#: largest padded batch — bigger inputs are chunked so the compile
+#: count stays bounded at log2(max/min)+1 programs per plan
+DEFAULT_MAX_BUCKET = 8192
+
+#: distinct compiled programs per namespace ("score" for ScoringPlan
+#: buckets, "prepare" for PreparePlan segments)
+_COMPILE_KEYS: Dict[str, set] = {}
+_PLAN_IDS = itertools.count()
+
+
+def plan_seq() -> int:
+    """Process-unique plan id (shared sequence across plan kinds)."""
+    return next(_PLAN_IDS)
+
+
+def record_compile(namespace: str, key) -> None:
+    _COMPILE_KEYS.setdefault(namespace, set()).add(key)
+
+
+def compiles(namespace: str) -> int:
+    """Distinct compiled programs recorded under ``namespace`` so far
+    in this process."""
+    return len(_COMPILE_KEYS.get(namespace, ()))
+
+
+def bucket_for(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
+               max_bucket: int = DEFAULT_MAX_BUCKET) -> int:
+    """Smallest power-of-two bucket >= n (clamped to the bucket range);
+    n beyond the largest bucket is the caller's cue to chunk."""
+    b = min_bucket
+    while b < n and b < max_bucket:
+        b *= 2
+    return min(b, max_bucket)
+
+
+def pad_rows(arr, bucket: int):
+    """Pad the leading (row) axis up to ``bucket`` with zeros. Host
+    numpy arrays pad host-side; device (jax) arrays pad on device so a
+    device-resident input never round-trips through the host."""
+    n = arr.shape[0]
+    if n == bucket:
+        return np.ascontiguousarray(arr) if isinstance(arr, np.ndarray) \
+            else arr
+    pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
+    if isinstance(arr, np.ndarray):
+        return np.pad(np.ascontiguousarray(arr), pad)
+    import jax.numpy as jnp
+    return jnp.pad(arr, pad)
+
+
+class PlanCompileError(RuntimeError):
+    """The feature DAG could not be frozen into a plan (e.g. a stage
+    crashed during the zero-row metadata probe). Callers fall back to
+    the per-stage numpy path."""
+
+
+@dataclass
+class PlanStep:
+    """One stage of a plan in execution order."""
+    stage: Transformer
+    out_name: str
+    input_names: Tuple[str, ...]
+    phase: str          # "pre" | "device" | "post" | "host" | "fit"
+    reason: str = ""    # why a fallback stage did not lower
+
+
+@dataclass
+class PlanCoverage:
+    """Which stages lowered into the fused program(s) and which fell
+    back to per-stage numpy (with the reason)."""
+    lowered: List[str] = field(default_factory=list)
+    fallback: List[Tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return len(self.lowered) + len(self.fallback)
+
+    @property
+    def lowered_fraction(self) -> float:
+        return len(self.lowered) / self.total if self.total else 1.0
+
+    def to_json(self) -> dict:
+        return {"lowered": list(self.lowered),
+                "fallback": [list(f) for f in self.fallback],
+                "lowered_fraction": round(self.lowered_fraction, 3)}
+
+
+def empty_raw_dataset(raw_features: Sequence[Feature]) -> Dataset:
+    """Zero-row typed dataset for the metadata probe."""
+    return Dataset({f.name: FeatureColumn.from_values(f.ftype, [])
+                    for f in raw_features})
+
+
+def probe_stage(stage: Transformer, proto: Dataset,
+                out_name: Optional[str] = None) -> Dataset:
+    """Run ONE stage over the zero-row proto dataset through the numpy
+    path, capturing its output column's type/width/metadata.
+    Prediction outputs are stubbed (they carry no metadata).
+    ``out_name`` pins the column name to the DAG handle's — a fitted
+    model re-deriving its own output name can disagree with the
+    estimator's cached feature after a rewiring (raw-feature filter),
+    and the DAG name is the one downstream stages were wired to."""
+    if out_name is None:
+        out_name = stage.get_output().name
+    if issubclass(stage.static_output_type(), Prediction):
+        return proto.with_column(
+            out_name, PredictionColumn.from_arrays(np.zeros(0)))
+    cols = [proto[f.name] for f in stage.input_features]
+    return proto.with_column(out_name, stage.transform_columns(cols))
+
+
+def fallback_reason(what: str, e: Exception) -> str:
+    """One-line fallback reason for coverage records (the TX-R01
+    contract: a swallowed hot-path exception must surface as a
+    recorded degradation, never vanish)."""
+    return f"{what}: {type(e).__name__}: {e}"
+
+
+def lowering_reason(stage: Transformer, input_names: Sequence[str],
+                    producer: Dict[str, str],
+                    proto_cols: Callable[[str], FeatureColumn],
+                    demoted: Optional[Dict[str, str]] = None) -> str:
+    """Empty string when ``stage`` can join the device graph; otherwise
+    the human-readable reason it must run through its host
+    ``transform_columns`` fallback. A stage lowers when it has an array
+    kernel AND every input is either produced on device already or
+    host-materialized and encodable; an input produced by a host
+    fallback DOWNSTREAM of the device graph ("post") blocks lowering
+    for single-program plans (the device program runs once)."""
+    if demoted and stage.uid in demoted:
+        return demoted[stage.uid]
+    if not stage.supports_arrays():
+        return "no array kernel (transform_arrays)"
+    for i, name in enumerate(input_names):
+        src = producer.get(name, "host")
+        if src == "post":
+            return (f"input {name!r} is produced by a host fallback "
+                    f"downstream of the device graph")
+        if src == "device":
+            if stage.encodes_input(i):
+                return (f"input {name!r} needs host encoding but is "
+                        f"produced on device")
+            continue
+        # host-materialized input: probe the encoder on the zero-row
+        # proto column
+        try:
+            stage.encode_input_column(i, proto_cols(name))
+        except Exception as e:
+            return fallback_reason(f"input {name!r} not encodable", e)
+    return ""

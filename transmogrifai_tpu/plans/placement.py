@@ -1,0 +1,120 @@
+"""Host-vs-device placement for fit-time statistics stages.
+
+The compiled prepare plan (plans/prepare.py) can fit some estimators
+directly from device-resident arrays (``Estimator.fit_device`` —
+SanityChecker, the scalers) instead of materializing their inputs back
+to host columns. Whether that is a WIN depends on the workload: on a
+cold CPU process the device fit pays an XLA trace+compile bill a tiny
+dataset never amortizes, while on wide/tall data (or any warm process)
+the host materialization is the cost. Rather than a hardcoded
+allowlist, placement is driven by the recorded compile/execute split
+(utils/compile_time.py, the same accumulator behind
+``stage_profile_top`` — "A Learned Performance Model for TPUs" is the
+grown-up version of this record-and-compare seed):
+
+- every fit the plan dispatches is measured under a section label;
+  wall seconds minus monitoring compile seconds is the steady-state
+  execute cost,
+- the decision for stage class C compares the recorded steady-state
+  device cost against the recorded host cost at a similar row count,
+  preferring the device path on a tie (it keeps the matrix resident),
+- with no record yet, the device path is tried first (optimistic) —
+  one measurement converts the guess into data for the rest of the
+  process.
+
+``TX_PREPARE_FIT=device|host`` overrides the policy wholesale (the
+escape hatches the identity tests pin); ``auto`` (default) applies the
+recorded-cost rule above.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["PlacementPolicy", "placement_report", "reset_placement"]
+
+_LOCK = threading.Lock()
+#: (stage class name, "host"|"device") -> accumulated fit cost record
+_RECORDS: Dict[Tuple[str, str], Dict[str, float]] = {}
+
+
+def _record(cls_name: str, where: str, seconds: float,
+            compile_seconds: float, n_rows: int) -> None:
+    with _LOCK:
+        rec = _RECORDS.setdefault((cls_name, where), {
+            "seconds": 0.0, "compile": 0.0, "calls": 0, "rows": 0})
+        rec["seconds"] += seconds
+        rec["compile"] += min(compile_seconds, seconds)
+        rec["calls"] += 1
+        rec["rows"] += int(n_rows)
+
+
+def _steady_state(rec: Optional[Dict[str, float]]) -> Optional[float]:
+    """Mean steady-state (execute) seconds per fit, or None without a
+    record. Compile seconds are excluded — they are first-call cost a
+    warm process (and every repeat train) never pays again."""
+    if rec is None or not rec["calls"]:
+        return None
+    return max(0.0, rec["seconds"] - rec["compile"]) / rec["calls"]
+
+
+def placement_report() -> List[dict]:
+    """Recorded per-(stage class, placement) fit costs, for bench
+    output and ``docs/prepare.md`` debugging."""
+    with _LOCK:
+        return [
+            {"stage": cls, "placement": where,
+             "seconds": round(rec["seconds"], 4),
+             "compileSeconds": round(rec["compile"], 4),
+             "executeSeconds": round(
+                 max(0.0, rec["seconds"] - rec["compile"]), 4),
+             "calls": int(rec["calls"]), "rows": int(rec["rows"])}
+            for (cls, where), rec in sorted(_RECORDS.items())]
+
+
+def reset_placement() -> None:
+    with _LOCK:
+        _RECORDS.clear()
+
+
+class PlacementPolicy:
+    """Decide where one estimator's fit statistics run, and record the
+    measured outcome so the next decision is data-driven."""
+
+    def __init__(self, mode: Optional[str] = None):
+        self.mode = mode or os.environ.get("TX_PREPARE_FIT", "auto")
+        if self.mode not in ("auto", "device", "host"):
+            raise ValueError(
+                f"TX_PREPARE_FIT must be auto, device or host, "
+                f"got {self.mode!r}")
+
+    def decide_fit(self, stage, n_rows: int) -> Tuple[str, str]:
+        """("device"|"host", reason). "device" is only returned for
+        stages exposing a ``fit_device`` kernel."""
+        supports = getattr(stage, "supports_device_fit", lambda: False)()
+        if not supports:
+            return "host", "no fit_device kernel"
+        if self.mode == "device":
+            return "device", "TX_PREPARE_FIT=device"
+        if self.mode == "host":
+            return "host", "TX_PREPARE_FIT=host"
+        cls = type(stage).__name__
+        with _LOCK:
+            dev = _RECORDS.get((cls, "device"))
+            host = _RECORDS.get((cls, "host"))
+        dev_s, host_s = _steady_state(dev), _steady_state(host)
+        if dev_s is None:
+            return "device", "no record yet; measuring the device path"
+        if host_s is None or dev_s <= host_s:
+            return "device", (f"recorded steady-state device fit "
+                              f"{dev_s:.4f}s <= host "
+                              f"{host_s if host_s is not None else '?'}")
+        return "host", (f"recorded steady-state device fit {dev_s:.4f}s "
+                        f"> host {host_s:.4f}s")
+
+    @staticmethod
+    def record_fit(stage, where: str, seconds: float,
+                   compile_seconds: float, n_rows: int) -> None:
+        _record(type(stage).__name__, where, seconds, compile_seconds,
+                n_rows)

@@ -25,6 +25,14 @@ from .validator import BestEstimator, CrossValidation, ValidationResult, \
 __all__ = ["ModelSelector", "SelectedModel", "ModelSelectorSummary"]
 
 
+def _is_device_array(x) -> bool:
+    try:
+        import jax
+        return isinstance(x, jax.Array)
+    except (ImportError, AttributeError):  # pragma: no cover - old jax
+        return False
+
+
 @dataclass
 class ModelSelectorSummary:
     """Full validation record (reference ModelSelectorSummary.scala:59)."""
@@ -258,6 +266,20 @@ class ModelSelector(Predictor):
         #: the fold search — consumed by fit so search and final fit
         #: share ONE split structurally (not by re-derivation)
         self.preset_split = None
+
+    def fit_columns(self, cols) -> SelectedModel:
+        """Overrides the Predictor boundary: a feature matrix the
+        compiled prepare plan left on device (plans/prepare.py) feeds
+        the search AS-IS — the fold gathers, stacked validation arrays
+        and family kernels all consume it without a host round-trip
+        (the label is tiny and host-side by construction)."""
+        y = np.asarray(cols[0].data, dtype=np.float64)
+        data = cols[1].data
+        X = data if _is_device_array(data) \
+            else np.asarray(data, dtype=np.float64)
+        model = self.fit_arrays(X, y)
+        model.vector_metadata = cols[1].metadata
+        return model
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray) -> SelectedModel:
         if not self.models:

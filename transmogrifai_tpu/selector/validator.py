@@ -368,13 +368,22 @@ class _ValidatorBase:
         masks = np.zeros((len(splits), len(y)))
         for f, (train_idx, _) in enumerate(splits):
             masks[f, train_idx] = 1.0
+        # a feature matrix the compiled prepare plan left on device
+        # (plans/prepare.py) stages its folds with device gathers and a
+        # device stack — the matrices the search consumes never
+        # round-trip through the host (y is host-side by construction)
+        xp = np
+        if not isinstance(X, (np.ndarray, type(None))) \
+                and type(X).__module__.partition(".")[0] != "numpy":
+            import jax.numpy as jnp
+            xp = jnp
         fold_data = [(X[tr], y[tr], X[va], y[va]) for tr, va in splits]
         # stacked validation folds for the device-resident fast path
         # (fold sizes are equal by _assignments construction)
         spec = self.evaluator.device_metric_spec()
         X_val_st = y_val_st = None
         if spec is not None and len({len(va) for _, va in splits}) == 1:
-            X_val_st = np.stack([fd[2] for fd in fold_data])
+            X_val_st = xp.stack([fd[2] for fd in fold_data])
             y_val_st = np.stack([fd[3] for fd in fold_data])
         return splits, masks, fold_data, spec, X_val_st, y_val_st
 

@@ -35,16 +35,21 @@ zero.
 """
 from __future__ import annotations
 
-import itertools
 import logging
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..features.columns import Dataset, FeatureColumn, PredictionColumn
-from ..features.feature import Feature, topo_layers
+from ..features.feature import topo_layers
 from ..features.generator import FeatureGeneratorStage
+from ..plans.common import (DEFAULT_MAX_BUCKET, DEFAULT_MIN_BUCKET,
+                            PlanCompileError, PlanCoverage,
+                            PlanStep as _Step, bucket_for, compiles,
+                            empty_raw_dataset as _empty_raw_dataset,
+                            fallback_reason as _shared_fallback_reason,
+                            pad_rows as _pad_rows, plan_seq,
+                            record_compile)
 from ..runtime import telemetry as _telemetry
 from ..runtime.faults import maybe_inject
 from ..runtime.retry import RetryPolicy
@@ -60,74 +65,11 @@ __all__ = ["ScoringPlan", "PlanCoverage", "PlanCompileError",
            "plan_compiles", "bucket_for", "DEFAULT_MIN_BUCKET",
            "DEFAULT_MAX_BUCKET"]
 
-#: smallest padded batch — single-record requests share one program
-DEFAULT_MIN_BUCKET = 8
-#: largest padded batch — bigger requests are chunked so the compile
-#: count stays bounded at log2(max/min)+1 programs per plan
-DEFAULT_MAX_BUCKET = 8192
-
-#: distinct (plan, bucket) XLA programs compiled so far in this process
-_COMPILE_KEYS: set = set()
-_PLAN_IDS = itertools.count()
-
 
 def plan_compiles() -> int:
     """Distinct compiled scoring programs so far in this process (the
     compile-count diagnostic bench.py's score mode reports)."""
-    return len(_COMPILE_KEYS)
-
-
-def bucket_for(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
-               max_bucket: int = DEFAULT_MAX_BUCKET) -> int:
-    """Smallest power-of-two bucket >= n (clamped to the bucket range);
-    n beyond the largest bucket is the caller's cue to chunk."""
-    b = min_bucket
-    while b < n and b < max_bucket:
-        b *= 2
-    return min(b, max_bucket)
-
-
-class PlanCompileError(RuntimeError):
-    """The fitted DAG could not be frozen into a plan (e.g. a stage
-    crashed during the zero-row metadata probe). Callers fall back to
-    the per-stage numpy path."""
-
-
-@dataclass
-class _Step:
-    """One stage of the plan in execution order."""
-    stage: Transformer
-    out_name: str
-    input_names: Tuple[str, ...]
-    phase: str          # "pre" | "device" | "post"
-    reason: str = ""    # why a fallback stage did not lower
-
-
-@dataclass
-class PlanCoverage:
-    """Which stages lowered into the fused program and which fell back
-    to per-stage numpy (with the reason)."""
-    lowered: List[str] = field(default_factory=list)
-    fallback: List[Tuple[str, str]] = field(default_factory=list)
-
-    @property
-    def total(self) -> int:
-        return len(self.lowered) + len(self.fallback)
-
-    @property
-    def lowered_fraction(self) -> float:
-        return len(self.lowered) / self.total if self.total else 1.0
-
-    def to_json(self) -> dict:
-        return {"lowered": list(self.lowered),
-                "fallback": [list(f) for f in self.fallback],
-                "lowered_fraction": round(self.lowered_fraction, 3)}
-
-
-def _empty_raw_dataset(raw_features: Sequence[Feature]) -> Dataset:
-    """Zero-row typed dataset for the metadata probe."""
-    return Dataset({f.name: FeatureColumn.from_values(f.ftype, [])
-                    for f in raw_features})
+    return compiles("score")
 
 
 class ScoringPlan:
@@ -151,7 +93,7 @@ class ScoringPlan:
         #: None = auto (on for accelerators, off for CPU which does not
         #: implement donation and would warn per call)
         self.donate = donate
-        self._plan_id = next(_PLAN_IDS)
+        self._plan_id = plan_seq()
         self._compiled = False
         self.coverage = PlanCoverage()
         #: serving guardrails (guard.py) — None means DISABLED: the
@@ -343,7 +285,7 @@ class ScoringPlan:
         """One-line fallback reason for coverage records (the TX-R01
         contract: a swallowed hot-path exception must surface as a
         recorded degradation, never vanish)."""
-        return f"{what}: {type(e).__name__}: {e}"
+        return _shared_fallback_reason(what, e)
 
     def _verify_device_fn(self, jax):
         """Abstractly trace the composed device program (zero device
@@ -614,7 +556,7 @@ class ScoringPlan:
                 mask[:rows] = 1.0
             else:
                 mask[:rows] = valid_mask[start:stop]
-            _COMPILE_KEYS.add((self._plan_id, bucket))
+            record_compile("score", (self._plan_id, bucket))
             outs = self._dispatch_device(inputs, mask)
             for i, o in enumerate(outs):
                 out_chunks[i].append(np.asarray(o)[:rows])
@@ -748,11 +690,3 @@ def _poison_first_valid_row(scored: Dataset, result_names, qmask
     return scored
 
 
-def _pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
-    """Pad the leading (row) axis up to ``bucket`` with zeros."""
-    arr = np.ascontiguousarray(arr)
-    n = arr.shape[0]
-    if n == bucket:
-        return arr
-    pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
-    return np.pad(arr, pad)

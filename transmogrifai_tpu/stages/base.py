@@ -333,6 +333,47 @@ class Estimator(PipelineStage):
         self.fitted_model = model
         return self._wire_model(model)
 
+    # -- compiled-prepare lowering (plans/prepare.py) ----------------------
+    def fit_device(self, arrays: List[Any],
+                   protos: List["FeatureColumn"]) -> "Model":
+        """Array-level fit kernel for the compiled prepare plan: one
+        array per wired input slot (device-resident jax arrays for
+        columns produced inside the fused feature program, dense numpy
+        for host-materialized numeric/vector inputs), plus the
+        zero-row proto columns carrying each input's type/metadata.
+        Must return a Model IDENTICAL to ``fit_columns`` on the same
+        values — the statistics math may (should) run on device, the
+        fitted state must not depend on where. Stages without a device
+        fit keep this default; the plan then records a host fallback
+        (the inputs are pulled back to columns) with the reason."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no device fit kernel")
+
+    def supports_device_fit(self) -> bool:
+        """Whether this estimator exposes a ``fit_device`` kernel (the
+        prepare plan's placement probe, plans/placement.py). A subclass
+        that overrides ``fit_columns`` BELOW the class defining
+        ``fit_device`` opts back out: routing its fit through the
+        inherited device kernel would silently bypass the override."""
+        cls = type(self)
+        if cls.fit_device is Estimator.fit_device:
+            return False
+        mro = cls.__mro__
+        dev_i = next(i for i, c in enumerate(mro)
+                     if "fit_device" in c.__dict__)
+        col_i = next((i for i, c in enumerate(mro)
+                      if "fit_columns" in c.__dict__), None)
+        return col_i is None or col_i >= dev_i
+
+    def fit_from_arrays(self, arrays: List[Any],
+                        protos: List["FeatureColumn"]) -> "Model":
+        """``fit_device`` behind the same wiring/bookkeeping ``fit``
+        performs (uid inheritance, ``fitted_model`` back-pointer) so
+        DAG stage-swapping works identically for both fit paths."""
+        model = self.fit_device(arrays, protos)
+        self.fitted_model = model
+        return self._wire_model(model)
+
     def _wire_model(self, model: "Model") -> "Model":
         """Fitted model inherits the estimator's uid, wiring and operation
         name so DAG stage-swapping by uid works

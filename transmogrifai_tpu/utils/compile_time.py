@@ -9,11 +9,21 @@ listener that accumulates them
 
 - globally (``compile_seconds()``), snapshotted around each workflow
   stage so ``StageMetric.compile_seconds`` splits first-call compile
-  time from steady-state execute time, and
+  time from steady-state execute time,
 - per thread NAME (``compile_seconds_by_thread()``): the validator
   renames its dispatch workers ``tx-family-<Name>``
   (selector/validator.py), so a model-selection search attributes its
-  compile bill family by family.
+  compile bill family by family, and
+- per SECTION label (``section()`` / ``seconds_by_section()``): the
+  compiled prepare plan (plans/prepare.py) runs many stages inside ONE
+  fused program, so thread- and stage-wall attribution alone would
+  lose the per-stage compile/execute split that the telemetry-
+  autotuning roadmap item consumes. A section is a labelled span
+  (``with section("prepare:seg0"): ...``) on a per-thread stack;
+  monitoring events observed inside attribute to EVERY open label, so
+  a segment's total includes its per-stage sub-sections. Each label
+  also records wall seconds and call count, giving callers the
+  ``execute = wall - compile`` split per label.
 
 Installation is lazy and idempotent; on a JAX without the monitoring
 API everything degrades to zeros (callers must treat 0.0 as "unknown",
@@ -22,15 +32,28 @@ not "free").
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
+from contextlib import contextmanager
 from typing import Dict
 
-__all__ = ["install", "compile_seconds", "compile_seconds_by_thread"]
+__all__ = ["install", "compile_seconds", "compile_seconds_by_thread",
+           "section", "seconds_by_section", "reset_sections"]
 
 _LOCK = threading.Lock()
 _TOTAL = {"seconds": 0.0}
 _BY_THREAD: Dict[str, float] = defaultdict(float)
+#: label -> {"seconds": wall, "compile": event seconds, "calls": n}
+_SECTIONS: Dict[str, Dict[str, float]] = {}
 _STATE = {"installed": False, "available": False}
+_SECTION_STACK = threading.local()
+
+
+def _stack():
+    st = getattr(_SECTION_STACK, "stack", None)
+    if st is None:
+        st = _SECTION_STACK.stack = []
+    return st
 
 
 def _on_event_duration(event: str, duration: float, **_kw) -> None:
@@ -40,9 +63,14 @@ def _on_event_duration(event: str, duration: float, **_kw) -> None:
     if "compile" not in event and "trace" not in event and \
             "lower" not in event:
         return
+    open_labels = list(_stack())
     with _LOCK:
         _TOTAL["seconds"] += duration
         _BY_THREAD[threading.current_thread().name] += duration
+        for label in open_labels:
+            rec = _SECTIONS.setdefault(
+                label, {"seconds": 0.0, "compile": 0.0, "calls": 0})
+            rec["compile"] += duration
 
 
 def install() -> bool:
@@ -72,3 +100,46 @@ def compile_seconds_by_thread(prefix: str = "") -> Dict[str, float]:
     with _LOCK:
         return {k: v for k, v in _BY_THREAD.items()
                 if k.startswith(prefix)}
+
+
+@contextmanager
+def section(label: str):
+    """Attribute wall + compile seconds inside this span to ``label``
+    (nested sections attribute compile events to every open label).
+    Works inside a jit trace too: the body of a traced function runs
+    exactly once per trace, so a per-stage section there measures that
+    stage's TRACE cost — the per-stage half of the plan-section
+    telemetry (docs/prepare.md)."""
+    install()
+    st = _stack()
+    st.append(label)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        st.pop()
+        wall = time.perf_counter() - t0
+        with _LOCK:
+            rec = _SECTIONS.setdefault(
+                label, {"seconds": 0.0, "compile": 0.0, "calls": 0})
+            rec["seconds"] += wall
+            rec["calls"] += 1
+
+
+def seconds_by_section(prefix: str = "") -> Dict[str, Dict[str, float]]:
+    """Snapshot of ``{label: {"seconds", "compile", "calls"}}`` for
+    labels starting with ``prefix``. ``seconds`` is wall time inside
+    the span, ``compile`` the monitoring-event (trace/lower/compile)
+    seconds observed while it was open; ``seconds - compile`` is the
+    steady-state execute estimate for the label."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _SECTIONS.items()
+                if k.startswith(prefix)}
+
+
+def reset_sections(prefix: str = "") -> None:
+    """Drop section records (filtered by prefix; "" drops all) — test
+    and bench isolation."""
+    with _LOCK:
+        for k in [k for k in _SECTIONS if k.startswith(prefix)]:
+            del _SECTIONS[k]

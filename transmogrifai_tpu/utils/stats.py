@@ -76,12 +76,29 @@ def correlation_with_label(X, y, w: Optional[np.ndarray] = None
                            ) -> np.ndarray:
     """Pearson correlation of each feature column with the label
     (the reference appends the label to the matrix and takes the last
-    correlation row, SanityChecker.scala:535)."""
+    correlation row, SanityChecker.scala:535).
+
+    Computed DIRECTLY per column — O(n·d) — with the same weighted
+    population normalization as :func:`correlation_matrix`. The former
+    append-and-gram implementation built the full (d+1)² correlation
+    matrix to read one row: O(n·d²), the dominant SanityChecker fit
+    cost on wide matrices (last-ulp differences vs the gram path are
+    possible; only this column of it was ever consumed)."""
+    # canonicalize first (as the former gram path did): under x64-off
+    # this lands on f32 without requesting — and warning about — f64
     X = jnp.asarray(X)
-    y = jnp.asarray(y, X.dtype).reshape(-1, 1)
-    M = jnp.concatenate([X, y], axis=1)
-    corr = correlation_matrix(M, w)
-    return np.asarray(corr[:-1, -1])
+    y = jnp.asarray(y, X.dtype).reshape(-1)
+    n = X.shape[0]
+    w = jnp.ones((n,), X.dtype) if w is None else jnp.asarray(w, X.dtype)
+    wsum = jnp.sum(w)
+    sw = jnp.sqrt(w)
+    Xc = (X - (w @ X) / wsum) * sw[:, None]
+    yc = (y - jnp.sum(w * y) / wsum) * sw
+    cov = (yc @ Xc) / wsum
+    sd = jnp.sqrt((jnp.sum(Xc * Xc, axis=0) / wsum)
+                  * (jnp.sum(yc * yc) / wsum))
+    corr = jnp.where(sd > 0, cov / jnp.where(sd > 0, sd, 1.0), jnp.nan)
+    return np.asarray(corr)
 
 
 @dataclass

@@ -158,11 +158,11 @@ def _fit_and_transform_layers(
                 ds = ds.with_column(
                     out.name, timed(
                         stage, "transform",
-                        lambda: model.transform_columns(
+                        lambda: model.transform_columns(  # tx-lint: disable=TX-J09 (TX_PREPARE=host escape hatch)
                             [ds[f.name] for f in model.input_features])))
             elif isinstance(stage, Transformer):
                 ds = timed(stage, "transform",
-                           lambda: stage.transform_dataset(ds))
+                           lambda: stage.transform_dataset(ds))  # tx-lint: disable=TX-J09 (TX_PREPARE=host escape hatch)
             else:
                 raise TypeError(f"Cannot execute stage {stage!r}")
     return ds, fitted
@@ -232,10 +232,10 @@ def _transform_with_fitted(layers: List[List[PipelineStage]],
             if isinstance(stage, Estimator):
                 model = fitted[stage.uid]
                 out = stage.get_output()
-                ds = ds.with_column(out.name, model.transform_columns(
+                ds = ds.with_column(out.name, model.transform_columns(  # tx-lint: disable=TX-J09 (per-fold refit segments stay host-side)
                     [ds[f.name] for f in model.input_features]))
             else:
-                ds = stage.transform_dataset(ds)
+                ds = stage.transform_dataset(ds)  # tx-lint: disable=TX-J09 (per-fold refit segments stay host-side)
     return ds
 
 
@@ -449,10 +449,9 @@ class Workflow:
         prefitted = None
         if self._workflow_cv:
             prefitted = self._find_best_with_workflow_cv(result_features, ds)
-        layers = topo_layers(result_features)
         listener = getattr(self, "_listener", None)
-        train_ds, fitted = _fit_and_transform_layers(
-            layers, ds, fit=True, listener=listener, prefitted=prefitted)
+        train_ds, fitted = self._prepare(result_features, ds, listener,
+                                         prefitted)
         result = tuple(f.copy_with_new_stages(fitted)
                        for f in result_features)
         if listener is not None:
@@ -462,6 +461,46 @@ class Workflow:
             raw_feature_filter_results=self.raw_feature_filter_results,
             blacklisted_feature_names=[f.name for f
                                        in self.blacklisted_features])
+
+    def _prepare(self, result_features, ds, listener, prefitted):
+        """Fit + transform the feature DAG over the training data.
+
+        Default (``TX_PREPARE=plan``): the compiled prepare path
+        (plans/prepare.py) — the fitted DAG executes through the SAME
+        ``transform_arrays`` kernel library serving uses, fused into
+        jitted segment programs, and the training matrices are born on
+        device for the selector search (docs/prepare.md).
+        ``TX_PREPARE=host`` is the escape hatch: the per-stage host
+        ``transform_columns`` walk, exactly the pre-plan behavior. A
+        plan that cannot be built degrades to the host path with the
+        reason recorded (never silently)."""
+        import os
+        mode = os.environ.get("TX_PREPARE", "plan")
+        if mode not in ("plan", "host"):
+            raise ValueError(
+                f"TX_PREPARE must be 'plan' or 'host', got {mode!r}")
+        layers = topo_layers(result_features)
+        if mode == "plan":
+            from ..plans import PlanCompileError, PreparePlan
+            plan = PreparePlan(result_features, listener=listener)
+            try:
+                train_ds, fitted = plan.execute(ds, prefitted=prefitted)
+                #: introspection: coverage / fit placements / segment
+                #: seconds of the most recent train (bench reads this)
+                self.last_prepare_plan = plan
+                return train_ds, fitted
+            except PlanCompileError as e:
+                from ..runtime import telemetry as _telemetry
+                _telemetry.count("prepare_plan_fallbacks")
+                _telemetry.event("prepare_plan_fallback",
+                                 error=f"{type(e).__name__}: {e}")
+                _log.warning(
+                    "compiled prepare unavailable (%s); falling back to "
+                    "the host transform_columns path", e)
+        self.last_prepare_plan = None
+        return _fit_and_transform_layers(layers, ds, fit=True,
+                                         listener=listener,
+                                         prefitted=prefitted)
 
     def _find_best_with_workflow_cv(self, result_features, ds
                                     ) -> Optional[Dict[str, PipelineStage]]:
