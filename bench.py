@@ -496,6 +496,221 @@ def _measure_serve_faults() -> dict:
     }
 
 
+def _measure_serve_loop() -> dict:
+    """TX_BENCH_MODE=serve_loop: the async micro-batching serving loop
+    (ISSUE 8, docs/serving_loop.md) vs per-request guarded dispatch on
+    the synthetic-Titanic model (CPU, warm). Baseline: one
+    ``score_guarded([record])`` plan dispatch per request — the
+    pre-loop serving story. Then an OPEN-LOOP arrival process (seeded
+    exponential inter-arrivals) drives the coalescing server across
+    several multiples of the baseline's throughput, recording
+    p50/p95/p99 latency (arrival -> resolution), achieved rows/sec,
+    mean batch occupancy and device-lane saturation per rate. Headline
+    ``serve_rows_per_s`` is the best achieved rate whose p99 is
+    equal-or-better than the per-request baseline's p99 (acceptance:
+    >= 5x), with zero plan compiles across the measured runs and
+    per-request rows bitwise identical to offline ``score_guarded()``
+    on the same rows. The plan's recorded ``bucket_profile()`` — what
+    the coalescer picks its deadline-or-full threshold from — is
+    emitted too."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from transmogrifai_tpu.utils.jax_setup import enable_compilation_cache
+    enable_compilation_cache()
+    import numpy as np
+
+    from examples.titanic import build_features, synthetic_titanic, \
+        stratified_split
+    from transmogrifai_tpu.models import LogisticRegression
+    from transmogrifai_tpu.serving import (ScoringPlan, ServeConfig,
+                                           plan_compiles,
+                                           serve_in_process)
+
+    records = synthetic_titanic(1309)
+    train, test = stratified_split(records)
+    survived, features = build_features()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        survived, features).get_output()
+    from transmogrifai_tpu.workflow import Workflow
+    model = (Workflow().set_result_features(survived, pred)
+             .set_input_records(train).train(validate="off"))
+
+    n_req = int(os.environ.get("TX_BENCH_SERVE_REQUESTS", "400"))
+    reqs = [dict(r) for r in (test * (n_req // len(test) + 1))[:n_req]]
+
+    # -- baseline: per-request guarded dispatch (batch of 1 per call) --
+    base_plan = ScoringPlan(model).compile().with_guardrails(
+        sentinel=False)
+    for r in reqs[:20]:
+        base_plan.score_guarded([r])               # warm bucket 8
+    base_n = min(n_req, 200)
+    base_lat = []
+    for r in reqs[:base_n]:
+        t0 = time.perf_counter()
+        base_plan.score_guarded([r])
+        base_lat.append(time.perf_counter() - t0)
+    base_lat_ms = np.array(base_lat) * 1000.0
+    base_rps = 1000.0 / float(np.mean(base_lat_ms))
+    base_p99 = float(np.percentile(base_lat_ms, 99))
+
+    def simulate_baseline(rate_rps: float) -> dict:
+        """Per-request dispatch under the SAME open-loop arrival
+        process: one worker drains a FIFO, each request costing a
+        MEASURED per-request service time — the latency a server
+        without coalescing exhibits at this offered rate (discrete-
+        event over real service samples, so it is exact rather than
+        wall-clock noisy)."""
+        rng = np.random.default_rng(int(rate_rps) % 97 + 11)
+        arrivals = np.cumsum(rng.exponential(1.0 / rate_rps,
+                                             size=n_req))
+        services = np.asarray(base_lat)
+        t_free, lat = 0.0, []
+        for i in range(n_req):
+            start = max(arrivals[i], t_free)
+            t_free = start + float(services[i % len(services)])
+            lat.append(t_free - arrivals[i])
+        lat_ms = np.array(lat) * 1000.0
+        span = max(t_free - arrivals[0], 1e-9)
+        return {
+            "offered_rows_per_s": round(rate_rps, 1),
+            "achieved_rows_per_s": round(n_req / span, 1),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        }
+
+    # offline reference rows (same guard config) for bitwise parity
+    ref_plan = ScoringPlan(model).compile().with_guardrails(
+        sentinel=False)
+    ref = ref_plan.score_guarded(reqs).scored
+    ref_col = ref[pred.name]
+
+    # -- the serving loop ---------------------------------------------
+    max_wait_ms = float(os.environ.get("TX_BENCH_SERVE_WAIT_MS", "2.0"))
+    server, client = serve_in_process(
+        {"titanic": model},
+        ServeConfig(max_wait_ms=max_wait_ms, sentinel=False))
+    try:
+        # warm every bucket shape this load can hit, through the
+        # server's own resident plan
+        entry = server.plans.get("titanic")
+        b = entry.plan.min_bucket
+        while b <= min(entry.plan.max_bucket,
+                       server.config.max_batch * 2):
+            entry.plan.score(reqs[:b][: max(b, 1)])
+            b *= 2
+        client.score_many(reqs[:64])               # warm the loop path
+        compiles0 = plan_compiles()
+
+        # bitwise parity: every request answered by the loop matches
+        # the offline guarded scoring of the same rows
+        rows = client.score_many(reqs)
+        parity = True
+        n_prob = ref_col.probability.shape[1]
+        for i, row in enumerate(rows):
+            v = row[pred.name]
+            probs = np.array([v[f"probability_{j}"]
+                              for j in range(n_prob)])
+            if v["prediction"] != ref_col.data[i] or \
+                    not np.array_equal(probs, ref_col.probability[i]):
+                parity = False
+                break
+
+        def run_rate(rate_rps: float) -> dict:
+            rng = np.random.default_rng(int(rate_rps) % 97 + 11)
+            arrivals = np.cumsum(rng.exponential(1.0 / rate_rps,
+                                                 size=n_req))
+            done = [0.0] * n_req
+            stats0 = dict(server.stats)
+            futs = []
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                while True:
+                    now = time.perf_counter() - t0
+                    if now >= arrivals[i]:
+                        break
+                    time.sleep(min(arrivals[i] - now, 0.0005))
+                fut = client.submit(reqs[i], model="titanic")
+                fut.add_done_callback(
+                    lambda f, i=i: done.__setitem__(
+                        i, time.perf_counter()))
+                futs.append(fut)
+            for f in futs:
+                f.result(timeout=120)
+            lat_ms = np.array([(done[i] - (t0 + arrivals[i])) * 1000.0
+                               for i in range(n_req)])
+            span = max(max(done) - (t0 + arrivals[0]), 1e-9)
+            batches = server.stats["batches"] - stats0["batches"]
+            rows_done = server.stats["rows"] - stats0["rows"]
+            busy = (server.stats["dispatch_seconds"]
+                    - stats0["dispatch_seconds"])
+            return {
+                "offered_rows_per_s": round(rate_rps, 1),
+                "achieved_rows_per_s": round(n_req / span, 1),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                "mean_batch_occupancy": round(
+                    rows_done / max(batches, 1), 2),
+                "dispatch_saturation": round(busy / span, 3),
+                "batches": int(batches),
+            }
+
+        multiples = [float(m) for m in os.environ.get(
+            "TX_BENCH_SERVE_RATES", "1,2,5,10").split(",")]
+        sweep = [run_rate(base_rps * m) for m in multiples]
+        base_sweep = [simulate_baseline(base_rps * m)
+                      for m in multiples]
+        repeat_compiles = plan_compiles() - compiles0
+
+        # equal-or-better p99 UNDER THE SAME ARRIVAL PROCESS: at each
+        # offered rate, the loop's measured p99 vs what per-request
+        # dispatch would exhibit at that rate (beyond its ~base_rps
+        # capacity the baseline's queue — and p99 — diverges)
+        qualifying = [s for s, b in zip(sweep, base_sweep)
+                      if s["p99_ms"] <= b["p99_ms"]]
+        headline = (max(qualifying,
+                        key=lambda r: r["achieved_rows_per_s"])
+                    if qualifying else
+                    min(sweep, key=lambda r: r["p99_ms"]))
+        profile = {str(k): {kk: (round(vv, 5) if isinstance(vv, float)
+                                 else vv) for kk, vv in rec.items()}
+                   for k, rec in sorted(
+                       entry.plan.bucket_profile().items())}
+        desc = server.describe()
+    finally:
+        server.stop()
+
+    value = headline["achieved_rows_per_s"]
+    return {
+        "metric": "serve_rows_per_s",
+        "value": value,
+        "unit": "rows/s",
+        # headline ratio: coalesced loop throughput at equal-or-better
+        # p99 vs one guarded plan dispatch per request
+        "vs_baseline": round(value / base_rps, 2),
+        "speedup_vs_per_request": round(value / base_rps, 2),
+        "meets_equal_p99": bool(qualifying),
+        "per_request_rows_per_s": round(base_rps, 1),
+        "per_request_p50_ms": round(
+            float(np.percentile(base_lat_ms, 50)), 3),
+        "per_request_p99_ms": round(base_p99, 3),
+        "headline_rate": headline,
+        "rate_sweep": sweep,
+        "per_request_sweep": base_sweep,
+        "requests_per_rate": n_req,
+        "max_wait_ms": max_wait_ms,
+        "repeat_compiles": repeat_compiles,
+        "bitwise_parity_vs_offline_guarded": bool(parity),
+        "bucket_profile": profile,
+        "mean_batch_occupancy": round(desc["mean_batch_occupancy"], 2),
+        "dispatch_saturation": round(desc["dispatch_saturation"], 3),
+        "full_dispatches": desc["full_dispatches"],
+        "deadline_dispatches": desc["deadline_dispatches"],
+        "platform": "cpu",
+    }
+
+
 def _wide_prepare_records(rows: int, seed: int = 0):
     """Wide synthetic dataset for the prepare bench: high-cardinality
     categoricals + maps + a numeric block (>= 100 raw columns), the
@@ -806,6 +1021,8 @@ def _measure() -> dict:
         return _measure_faults()
     if os.environ.get("TX_BENCH_MODE") == "serve_faults":
         return _measure_serve_faults()
+    if os.environ.get("TX_BENCH_MODE") == "serve_loop":
+        return _measure_serve_loop()
     from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
                                                    pin_platform_from_env)
     pin_platform_from_env()
@@ -976,11 +1193,13 @@ def _probe_ambient() -> tuple[bool, str, list]:
 
 
 def main() -> None:
-    if os.environ.get("TX_BENCH_MODE") in ("sharded_search", "prepare"):
+    if os.environ.get("TX_BENCH_MODE") in ("sharded_search", "prepare",
+                                           "serve_loop"):
         # these modes are DEFINED on the forced-CPU backend (the
         # sharded sweep on a virtual device pool, the prepare
-        # comparison on the x64 CPU path): no ambient probe, no child
-        # watchdog — the CPU backend cannot hang
+        # comparison on the x64 CPU path, the serve-loop latency SLO
+        # sweep): no ambient probe, no child watchdog — the CPU
+        # backend cannot hang
         try:
             out = _measure()
         except Exception as e:
@@ -1041,6 +1260,8 @@ def _headline_metric() -> tuple:
         return "resume_saved_fraction", "fraction"
     if os.environ.get("TX_BENCH_MODE") == "serve_faults":
         return "quarantine_rate", "fraction"
+    if os.environ.get("TX_BENCH_MODE") == "serve_loop":
+        return "serve_rows_per_s", "rows/s"
     return "titanic_holdout_aupr", "AuPR"
 
 

@@ -356,6 +356,68 @@ class TestJaxAstRules:
         findings, _ = lint_paths([str(p)])
         assert findings == []
 
+    def test_j10_time_sleep_in_serving_async_handler(self):
+        code = textwrap.dedent("""
+            import time
+
+            async def handle(queue):
+                time.sleep(0.01)
+                return queue.popleft()
+        """)
+        findings = lint_source(code, "transmogrifai_tpu/serving/server.py")
+        assert [f.rule_id for f in findings] == ["TX-J10"]
+        assert findings[0].severity == "error"
+        assert "asyncio.sleep" in (findings[0].hint or "")
+        # the same call in a SYNC serving function is not its business
+        assert lint_source(textwrap.dedent("""
+            import time
+
+            def worker():
+                time.sleep(0.01)
+        """), "x/serving/server.py") == []
+        # nor is an async handler OUTSIDE serving/
+        assert lint_source(code, "x/workers/pool.py") == []
+
+    def test_j10_device_sync_and_materialization(self):
+        findings = lint_source(textwrap.dedent("""
+            import numpy as np
+
+            async def handle(out):
+                out.block_until_ready()
+                return np.asarray(out)
+        """), "x/serving/loop.py")
+        assert [f.rule_id for f in findings] == ["TX-J10", "TX-J10"]
+
+    def test_j10_file_io_and_bare_sleep(self):
+        findings = lint_source(textwrap.dedent("""
+            from time import sleep
+
+            async def handle(path):
+                sleep(0.5)
+                with open(path) as fh:
+                    return fh.read()
+        """), "x/serving/io.py")
+        assert [f.rule_id for f in findings] == ["TX-J10", "TX-J10"]
+
+    def test_j10_awaited_sleep_and_executor_idiom_clean(self):
+        # `await asyncio.sleep` and blocking work pushed into a NESTED
+        # sync function (the run_in_executor idiom) are the blessed
+        # patterns and stay clean
+        assert lint_source(textwrap.dedent("""
+            import asyncio
+            import time
+            import numpy as np
+
+            async def handle(loop, pool, out):
+                await asyncio.sleep(0.001)
+
+                def materialize():
+                    time.sleep(0.0)
+                    return np.asarray(out)
+
+                return await loop.run_in_executor(pool, materialize)
+        """), "x/serving/server.py") == []
+
     def test_j07_grid_value_into_static_argname(self):
         findings = _src("""
             import functools

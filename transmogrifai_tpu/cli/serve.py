@@ -1,0 +1,157 @@
+"""``python -m transmogrifai_tpu.cli serve`` — the async micro-batching
+scoring server (docs/serving_loop.md) over a JSON-lines TCP front end.
+
+Protocol: one JSON object per line on the socket; the server answers
+one JSON line per request, in order::
+
+    -> {"record": {"age": 31.0, ...}, "model": "titanic", "tenant": "a"}
+    <- {"ok": true, "result": {"pred_...": {...}}}
+    <- {"ok": false, "error": "...", "kind": "transient"}
+
+Start one process serving a model zoo::
+
+    python -m transmogrifai_tpu.cli serve \\
+        --model titanic=/models/titanic --model churn=/models/churn \\
+        --port 8765 --max-wait-ms 5 --plan-cache 4
+
+The hot path is the :class:`~transmogrifai_tpu.serving.ServingServer`
+coalescing loop: deadline-or-full bucket batching, double-buffered
+encode vs dispatch, per-tenant guardrails + breaker + sentinel, LRU
+plan cache. ``--max-requests`` exits after N answered requests (CI
+smoke); ``--port 0`` binds an ephemeral port (printed on stdout)."""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import List, Optional
+
+__all__ = ["add_serve_parser", "run_serve", "serve_forever"]
+
+
+def add_serve_parser(sub) -> None:
+    sv = sub.add_parser(
+        "serve",
+        help="async micro-batching scoring server (JSON lines over "
+             "TCP; docs/serving_loop.md)")
+    sv.add_argument("--model", action="append", required=True,
+                    metavar="[NAME=]DIR",
+                    help="saved model directory, optionally named "
+                         "(repeatable; the first is the default model)")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8765,
+                    help="TCP port (0 = ephemeral, printed on stdout)")
+    sv.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="deadline half of deadline-or-full coalescing")
+    sv.add_argument("--target-batch", type=int, default=None,
+                    help="coalescer target batch (default: derived "
+                         "from the plan's recorded bucket profile)")
+    sv.add_argument("--max-batch", type=int, default=256,
+                    help="hard cap on rows per dispatch")
+    sv.add_argument("--plan-cache", type=int, default=4,
+                    help="LRU budget of resident compiled plans")
+    sv.add_argument("--deadline-seconds", type=float, default=None,
+                    help="per-batch device dispatch deadline (a hung "
+                         "dispatch is orphaned, the batch falls back "
+                         "to the host path)")
+    sv.add_argument("--no-guardrails", action="store_true",
+                    help="disable per-tenant admission/output/breaker "
+                         "guardrails (docs/serving_guardrails.md)")
+    sv.add_argument("--no-sentinel", action="store_true",
+                    help="disable the per-tenant drift sentinel")
+    sv.add_argument("--max-requests", type=int, default=None,
+                    help="exit after answering N requests (smoke/CI)")
+
+
+def _parse_models(specs: List[str]) -> List[tuple]:
+    out = []
+    for spec in specs:
+        if "=" in spec:
+            name, path = spec.split("=", 1)
+        else:
+            path = spec
+            name = os.path.basename(os.path.normpath(path)) or "model"
+        out.append((name, path))
+    return out
+
+
+async def serve_forever(server, host: str, port: int,
+                        max_requests: Optional[int] = None,
+                        ready_cb=None) -> int:
+    """Run ``server``'s loop behind a JSON-lines TCP front end until
+    cancelled (or ``max_requests`` answers). Importable so tests drive
+    the exact CLI path in-process with in-memory models."""
+    from ..runtime.errors import classify_error
+    await server.start()
+    answered = {"n": 0}
+    done = asyncio.Event()
+
+    async def handle(reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                    row = await server.score_async(
+                        msg.get("record", msg), model=msg.get("model"),
+                        tenant=msg.get("tenant", "default"))
+                    out = {"ok": True, "result": row}
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # a bad request/record answers with the classified
+                    # error instead of dropping the connection
+                    out = {"ok": False,
+                           "error": f"{type(e).__name__}: {e}",
+                           "kind": classify_error(e)}
+                writer.write((json.dumps(out, default=float) + "\n")
+                             .encode())
+                await writer.drain()
+                answered["n"] += 1
+                if max_requests and answered["n"] >= max_requests:
+                    done.set()
+                    break
+        finally:
+            writer.close()
+
+    tcp = await asyncio.start_server(handle, host, port)
+    bound = tcp.sockets[0].getsockname()[1]
+    print(json.dumps({"serving": True, "host": host, "port": bound,
+                      "models": server.plans.names()}), flush=True)
+    if ready_cb is not None:
+        ready_cb(bound)
+    try:
+        if max_requests:
+            await done.wait()
+        else:
+            await asyncio.Event().wait()       # until cancelled
+    except asyncio.CancelledError:
+        pass
+    finally:
+        tcp.close()
+        await tcp.wait_closed()
+        await server.shutdown()
+    print(json.dumps({"served": answered["n"],
+                      **server.describe()}, default=float), flush=True)
+    return 0
+
+
+def run_serve(args) -> int:
+    from ..serving.server import ServeConfig, ServingServer
+    from ..utils.jax_setup import pin_platform_from_env
+    pin_platform_from_env()
+    config = ServeConfig(
+        max_wait_ms=args.max_wait_ms,
+        target_batch=args.target_batch,
+        max_batch=args.max_batch,
+        plan_budget=args.plan_cache,
+        deadline_seconds=args.deadline_seconds,
+        guardrails=not args.no_guardrails,
+        sentinel=not args.no_sentinel)
+    server = ServingServer(config)
+    for name, path in _parse_models(args.model):
+        server.add_model(name, path)
+    return asyncio.run(serve_forever(server, args.host, args.port,
+                                     max_requests=args.max_requests))

@@ -58,6 +58,13 @@ RULES: Dict[str, tuple] = {
                         "workflow/ code that the compiled PreparePlan "
                         "replaces; only the TX_PREPARE=host escape "
                         "hatch may stay, inline-suppressed"),
+    "TX-J10": (ERROR, "blocking call inside a serving async handler: "
+                      "time.sleep, a synchronous device "
+                      ".block_until_ready()/np.asarray "
+                      "materialization, or open() file I/O in an "
+                      "async def under serving/ stalls the event loop "
+                      "for every in-flight request — route blocking "
+                      "work through an executor"),
     # -- resilience rules (selector/serving hot paths only) ----------------
     "TX-R01": (ERROR, "except Exception / bare except in a selector or "
                       "serving hot path swallows XlaRuntimeError "

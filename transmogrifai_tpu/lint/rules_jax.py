@@ -59,6 +59,16 @@ call) are caught here in milliseconds:
   Python per-row loop over ``transform_value``. The TX_PREPARE=host
   escape hatch is the ONLY blessed host walk and carries an inline
   suppression so the exemption is visible and reviewable.
+- TX-J10 blocking call inside a serving ASYNC handler (``serving/``
+  files only): ``time.sleep`` (the loop stalls for every in-flight
+  request — ``await asyncio.sleep`` exists), a synchronous device
+  materialization (``.block_until_ready()``, ``np.asarray``/
+  ``np.array`` on device output), or file I/O (``open``) directly in
+  an ``async def`` body. The serving loop (serving/server.py) routes
+  ALL blocking work through named executors; an inline blocking call
+  in a coroutine wedges the coalescer for every tenant at once.
+  Nested SYNC functions inside an async def are exempt — that is
+  exactly the run_in_executor idiom.
 - TX-J08 implicit replication under ``shard_map``/``pjit``: the body
   function closes over an array-like value from the enclosing scope
   instead of receiving it through ``in_specs``. A closed-over operand
@@ -403,6 +413,9 @@ class _Visitor(ast.NodeVisitor):
         self.findings: List[LintFinding] = []
         #: stack of enclosing FunctionDefs, innermost last
         self.fn_stack: List[ast.FunctionDef] = []
+        #: TX-J10: directly inside an `async def` body (a nested SYNC
+        #: def resets this — that's the run_in_executor idiom)
+        self.in_async = False
         #: stack of "inside a loop" flags per function level
         self.loop_depth = 0
         #: when non-None we are inside a jitted function: set of traced
@@ -532,13 +545,22 @@ class _Visitor(ast.NodeVisitor):
             self.jit_ctx = self.jit_ctx | params
         self.fn_stack.append(node)
         self.loop_depth = 0
+        outer_async = self.in_async
+        self.in_async = isinstance(node, ast.AsyncFunctionDef)
         self.generic_visit(node)
+        self.in_async = outer_async
         self.fn_stack.pop()
         self.loop_depth = outer_loops
         self.jit_ctx, self.jit_fn_name = outer_ctx, outer_name
         self.grid_ctx, self.grid_fn_name = outer_grid, outer_grid_name
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Await(self, node: ast.Await) -> None:
+        # an awaited call is by definition not a BLOCKING call (e.g.
+        # `await sleep(...)` from asyncio) — mark it so TX-J10 skips it
+        setattr(node.value, "_tx_awaited", True)
+        self.generic_visit(node)
 
     # -- loops -------------------------------------------------------------
     def visit_For(self, node: ast.For) -> None:
@@ -759,9 +781,71 @@ class _Visitor(ast.NodeVisitor):
                      "entry in in_specs — P('data') to shard rows, "
                      "P() when replication is genuinely intended")
 
+    # -- TX-J10: blocking calls in serving async handlers ------------------
+    def _check_async_blocking(self, node: ast.Call) -> None:
+        """Inside an ``async def`` in a serving/ file, a blocking call
+        stalls the event loop — every queued request of every tenant
+        waits behind it. The serving loop's contract is that blocking
+        work runs in named executors (serving/server.py)."""
+        if getattr(node, "_tx_awaited", False):
+            return
+        where = (f" in async handler {self.fn_stack[-1].name!r}"
+                 if self.fn_stack else "")
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            root = fn.value
+            if fn.attr == "sleep" and isinstance(root, ast.Name) \
+                    and root.id == "time":
+                self.add(
+                    "TX-J10", node,
+                    f"blocking time.sleep(...){where} — the serving "
+                    f"event loop (and every in-flight request) stalls "
+                    f"for the duration",
+                    ERROR,
+                    hint="await asyncio.sleep(...) instead")
+            elif fn.attr == "block_until_ready":
+                self.add(
+                    "TX-J10", node,
+                    f"synchronous device sync .block_until_ready()"
+                    f"{where} — blocks the event loop on device "
+                    f"completion",
+                    ERROR,
+                    hint="submit the dispatch to an executor "
+                         "(loop.run_in_executor) and await it")
+            elif isinstance(root, ast.Name) and root.id in self.al.numpy \
+                    and fn.attr in ("asarray", "array", "concatenate"):
+                self.add(
+                    "TX-J10", node,
+                    f"np.{fn.attr}(...) host materialization{where} — "
+                    f"a device-output copy (and a blocking sync) on "
+                    f"the event loop",
+                    ERROR,
+                    hint="run host encode/materialization in an "
+                         "executor (the serving loop's encode pool "
+                         "idiom, serving/server.py)")
+        elif isinstance(fn, ast.Name):
+            if fn.id == "open":
+                self.add(
+                    "TX-J10", node,
+                    f"file I/O (open){where} — disk latency on the "
+                    f"serving event loop",
+                    ERROR,
+                    hint="do file I/O in an executor, or outside the "
+                         "async hot path")
+            elif fn.id == "sleep":
+                self.add(
+                    "TX-J10", node,
+                    f"blocking sleep(...){where} (un-awaited, so this "
+                    f"is time.sleep, not asyncio's)",
+                    ERROR,
+                    hint="await asyncio.sleep(...) instead")
+
     # -- calls -------------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:
         al = self.al
+        # TX-J10: blocking calls inside serving async handlers --------------
+        if self.serving and self.in_async:
+            self._check_async_blocking(node)
         # TX-J08: shard_map/pjit closing over unsharded arrays --------------
         self._check_shard_closure(node)
         # TX-J09: host materialization in the train hot path ----------------

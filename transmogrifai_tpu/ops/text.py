@@ -140,21 +140,38 @@ class SmartTextVectorizerModel(SequenceModel):
         self.num_hashes = num_hashes
         self.track_nulls = track_nulls
 
+    def _vector_metas(self) -> List[VectorColumnMetadata]:
+        # built once per fitted model, not per batch: a hashing slot
+        # emits num_hashes (+null) metadata records whose content is
+        # fully determined at fit time, and rebuilding ~512 records per
+        # transform call was the dominant FIXED cost of every serving
+        # batch (size-independent; profiled in the PR-8 serve loop)
+        metas = getattr(self, "_metas_cache", None)
+        if metas is None:
+            metas = []
+            for f, (kind, cats) in zip(self.input_features,
+                                       self.strategies):
+                if kind == "pivot":
+                    metas.extend(_pivot_metas(f, list(cats),
+                                              self.track_nulls))
+                else:
+                    metas.extend(_hash_metas(f, self.num_hashes,
+                                             self.track_nulls))
+            self._metas_cache = metas
+        return metas
+
     def transform_columns(self, cols: List[FeatureColumn]) -> FeatureColumn:
-        blocks, metas = [], []
-        for f, col, (kind, cats) in zip(self.input_features, cols,
-                                        self.strategies):
+        blocks = []
+        for col, (kind, cats) in zip(cols, self.strategies):
             if kind == "pivot":
                 rows = [None if v is None else (v,) for v in col.data]
                 blocks.append(_pivot_block(rows, list(cats),
                                            self.track_nulls))
-                metas.extend(_pivot_metas(f, list(cats), self.track_nulls))
             else:
                 blocks.append(_hash_block(col.data, self.num_hashes,
                                           self.track_nulls))
-                metas.extend(_hash_metas(f, self.num_hashes,
-                                         self.track_nulls))
-        return vector_output(self.get_output().name, blocks, metas)
+        return vector_output(self.get_output().name, blocks,
+                             self._vector_metas())
 
 
 class SmartTextVectorizer(SequenceEstimator):

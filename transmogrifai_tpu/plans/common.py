@@ -34,7 +34,8 @@ from ..types import Prediction
 __all__ = ["DEFAULT_MIN_BUCKET", "DEFAULT_MAX_BUCKET", "bucket_for",
            "pad_rows", "PlanCompileError", "PlanStep", "PlanCoverage",
            "empty_raw_dataset", "probe_stage", "lowering_reason",
-           "fallback_reason", "record_compile", "compiles", "plan_seq"]
+           "fallback_reason", "record_compile", "compiles", "plan_seq",
+           "bucket_section", "bucket_profile"]
 
 #: smallest padded batch — single-record requests share one program
 DEFAULT_MIN_BUCKET = 8
@@ -86,6 +87,46 @@ def pad_rows(arr, bucket: int):
         return np.pad(np.ascontiguousarray(arr), pad)
     import jax.numpy as jnp
     return jnp.pad(arr, pad)
+
+
+def _bucket_label(namespace: str, plan_id: int, bucket: int) -> str:
+    return f"{namespace}:{plan_id}:b{bucket}"
+
+
+def bucket_section(namespace: str, plan_id: int, bucket: int):
+    """A ``utils/compile_time.section`` labelled for ONE (plan, bucket)
+    dispatch — the per-bucket cost ledger the serving coalescer reads
+    (``bucket_profile``) to pick its deadline-or-full thresholds from
+    recorded data instead of static defaults (the learned-performance-
+    model direction in PAPERS.md)."""
+    from ..utils.compile_time import section
+    return section(_bucket_label(namespace, plan_id, bucket))
+
+
+def bucket_profile(namespace: str, plan_id: int,
+                   rows_by_bucket: Optional[Dict[int, int]] = None
+                   ) -> Dict[int, dict]:
+    """Per-bucket dispatch cost observed so far for one plan:
+    ``{bucket: {calls, wall_seconds, compile_seconds, execute_seconds,
+    rows}}``. ``execute_seconds`` is the steady-state estimate
+    (wall minus trace/lower/compile events observed inside the span);
+    treat 0.0 as "unknown", not "free" (utils/compile_time.py)."""
+    from ..utils.compile_time import seconds_by_section
+    prefix = f"{namespace}:{plan_id}:b"
+    out: Dict[int, dict] = {}
+    for label, rec in seconds_by_section(prefix).items():
+        try:
+            bucket = int(label[len(prefix):])
+        except ValueError:              # pragma: no cover - foreign label
+            continue
+        out[bucket] = {
+            "calls": int(rec["calls"]),
+            "wall_seconds": rec["seconds"],
+            "compile_seconds": rec["compile"],
+            "execute_seconds": max(rec["seconds"] - rec["compile"], 0.0),
+            "rows": int((rows_by_bucket or {}).get(bucket, 0)),
+        }
+    return out
 
 
 class PlanCompileError(RuntimeError):

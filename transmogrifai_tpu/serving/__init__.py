@@ -2,21 +2,27 @@
 shape-bucketed XLA scoring programs (docs/serving.md), with optional
 serving guardrails — schema admission, per-row quarantine, output
 guards, a scoring circuit breaker and an online drift sentinel
-(docs/serving_guardrails.md)."""
+(docs/serving_guardrails.md) — and an async micro-batching serving
+loop that coalesces live requests into compiled bucket dispatches
+under latency SLOs (docs/serving_loop.md)."""
 from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
                     GuardedScoreResult, GuardReason, OutputGuard,
                     SchemaGuard, ServingGuard)
-from .plan import (PlanCompileError, PlanCoverage, ScoringPlan,
-                   bucket_for, plan_compiles)
+from .plan import (EncodedScoreBatch, PlanCompileError, PlanCoverage,
+                   ScoringPlan, bucket_for, plan_compiles)
 from .sentinel import (DriftSentinel, DriftThresholds,
                        FeatureFingerprint, compute_fingerprints,
                        load_fingerprints, save_fingerprints)
+from .server import (PlanCache, ServeConfig, ServeRejected,
+                     ServingClient, ServingServer, serve_in_process)
 
-__all__ = ["ScoringPlan", "PlanCoverage", "PlanCompileError",
-           "plan_compiles", "bucket_for",
+__all__ = ["ScoringPlan", "EncodedScoreBatch", "PlanCoverage",
+           "PlanCompileError", "plan_compiles", "bucket_for",
            "AdmissionPolicy", "SchemaGuard", "OutputGuard",
            "CircuitBreaker", "BreakerOpenError", "ServingGuard",
            "GuardReason", "GuardedScoreResult",
            "DriftSentinel", "DriftThresholds", "FeatureFingerprint",
            "compute_fingerprints", "save_fingerprints",
-           "load_fingerprints"]
+           "load_fingerprints",
+           "ServeConfig", "ServingServer", "ServingClient", "PlanCache",
+           "ServeRejected", "serve_in_process"]

@@ -36,6 +36,7 @@ zero.
 from __future__ import annotations
 
 import logging
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -45,7 +46,9 @@ from ..features.feature import topo_layers
 from ..features.generator import FeatureGeneratorStage
 from ..plans.common import (DEFAULT_MAX_BUCKET, DEFAULT_MIN_BUCKET,
                             PlanCompileError, PlanCoverage,
-                            PlanStep as _Step, bucket_for, compiles,
+                            PlanStep as _Step, bucket_for,
+                            bucket_profile as _shared_bucket_profile,
+                            bucket_section as _bucket_section, compiles,
                             empty_raw_dataset as _empty_raw_dataset,
                             fallback_reason as _shared_fallback_reason,
                             pad_rows as _pad_rows, plan_seq,
@@ -61,15 +64,31 @@ from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
 
 _log = logging.getLogger(__name__)
 
-__all__ = ["ScoringPlan", "PlanCoverage", "PlanCompileError",
-           "plan_compiles", "bucket_for", "DEFAULT_MIN_BUCKET",
-           "DEFAULT_MAX_BUCKET"]
+__all__ = ["ScoringPlan", "EncodedScoreBatch", "PlanCoverage",
+           "PlanCompileError", "plan_compiles", "bucket_for",
+           "DEFAULT_MIN_BUCKET", "DEFAULT_MAX_BUCKET"]
 
 
 def plan_compiles() -> int:
     """Distinct compiled scoring programs so far in this process (the
     compile-count diagnostic bench.py's score mode reports)."""
     return compiles("score")
+
+
+@dataclass
+class EncodedScoreBatch:
+    """A raw Dataset host-encoded, chunked, padded and masked — ready
+    for device dispatch. Splitting :meth:`ScoringPlan.score_raw_dataset`
+    into :meth:`~ScoringPlan.encode_raw_dataset` +
+    :meth:`~ScoringPlan.dispatch_encoded` lets the serving loop
+    double-buffer: batch k+1's host-side boxing/encoding overlaps batch
+    k's in-flight device program (serving/server.py)."""
+    #: raw Dataset AFTER the plan's "pre"-phase host fallbacks ran
+    ds: Dataset
+    n_rows: int
+    #: (bucket, padded input arrays, validity mask, live rows) per chunk
+    chunks: List[Tuple[int, tuple, np.ndarray, int]] = \
+        field(default_factory=list)
 
 
 class ScoringPlan:
@@ -105,6 +124,8 @@ class ScoringPlan:
         #: GuardedScoreResult of the most recent guarded batch
         self.last_guard_result: Optional[GuardedScoreResult] = None
         self._deadline_pool = None
+        #: live rows dispatched per bucket (bucket_profile denominator)
+        self._bucket_rows: Dict[int, int] = {}
 
     # -- compilation -------------------------------------------------------
     def compile(self) -> "ScoringPlan":
@@ -516,15 +537,20 @@ class ScoringPlan:
         self.last_guard_result = result
         return result
 
-    def _score_host_fallback(self, ds: Dataset) -> Dataset:
+    def score_host_columnar(self, ds: Dataset) -> Dataset:
         """The existing host columnar path (per-stage numpy kernels,
         layer by layer) as a whole-batch fallback when the device is
-        unavailable — same outputs as ``engine="columnar"``."""
+        unavailable — same outputs as ``engine="columnar"``. Public:
+        the serving loop routes breaker-open / failed-dispatch batches
+        here per tenant (serving/server.py)."""
         from ..workflow.workflow import _fit_and_transform_layers
         _telemetry.count("serving_host_fallback_batches")
         layers = topo_layers(self.model.result_features)
         scored, _ = _fit_and_transform_layers(layers, ds, fit=False)
         return self._select_outputs(scored)
+
+    #: pre-PR-8 internal name, kept for call-site compatibility
+    _score_host_fallback = score_host_columnar
 
     def score_raw_dataset(self, ds: Dataset,
                           valid_mask: Optional[np.ndarray] = None
@@ -534,17 +560,28 @@ class ScoringPlan:
         ``valid_mask`` (guarded path) zeroes quarantined rows inside
         the padded device batch — same shapes, zero recompiles."""
         self.compile()
+        return self.dispatch_encoded(
+            self.encode_raw_dataset(ds, valid_mask=valid_mask))
+
+    def encode_raw_dataset(self, ds: Dataset,
+                           valid_mask: Optional[np.ndarray] = None
+                           ) -> EncodedScoreBatch:
+        """The HOST half of scoring: run the "pre"-phase numpy
+        fallbacks, encode every device input column once, and chunk/
+        pad/mask the arrays onto the power-of-two bucket lattice. Pure
+        host work — the serving loop runs it for batch k+1 while batch
+        k's device program is still in flight (double-buffering)."""
+        self.compile()
         n = ds.n_rows
         # phase "pre": numpy fallbacks feeding the device graph
         for step in self._steps:
             if step.phase == "pre":
                 ds = step.stage.transform_dataset(ds)
 
-        # encode once per host input, then run per bucket chunk
+        # encode once per host input, then chunk onto the bucket lattice
         encoded = [(key, enc(ds[name]))
                    for key, name, enc in self._host_inputs]
-        out_chunks: List[List[np.ndarray]] = [[] for _ in
-                                              self._device_outputs]
+        chunks: List[Tuple[int, tuple, np.ndarray, int]] = []
         for start in range(0, max(n, 1), self.max_bucket):
             stop = min(start + self.max_bucket, n)
             rows = stop - start
@@ -556,14 +593,37 @@ class ScoringPlan:
                 mask[:rows] = 1.0
             else:
                 mask[:rows] = valid_mask[start:stop]
-            record_compile("score", (self._plan_id, bucket))
-            outs = self._dispatch_device(inputs, mask)
-            for i, o in enumerate(outs):
-                out_chunks[i].append(np.asarray(o)[:rows])
+            chunks.append((bucket, inputs, mask, rows))
             if n == 0:
                 break
+        return EncodedScoreBatch(ds=ds, n_rows=n, chunks=chunks)
 
-        return self._finish_score(ds, out_chunks)
+    def dispatch_encoded(self, enc: EncodedScoreBatch) -> Dataset:
+        """The DEVICE half of scoring: dispatch every encoded chunk
+        through the fused program (per-bucket cost recorded for
+        :meth:`bucket_profile`), then materialize columns and run the
+        "post"-phase host fallbacks."""
+        out_chunks: List[List[np.ndarray]] = [[] for _ in
+                                              self._device_outputs]
+        for bucket, inputs, mask, rows in enc.chunks:
+            record_compile("score", (self._plan_id, bucket))
+            self._bucket_rows[bucket] = \
+                self._bucket_rows.get(bucket, 0) + rows
+            with _bucket_section("score", self._plan_id, bucket):
+                outs = self._dispatch_device(inputs, mask)
+            for i, o in enumerate(outs):
+                out_chunks[i].append(np.asarray(o)[:rows])
+        return self._finish_score(enc.ds, out_chunks)
+
+    def bucket_profile(self) -> Dict[int, dict]:
+        """Observed per-bucket dispatch cost of THIS plan:
+        ``{bucket: {calls, wall_seconds, compile_seconds,
+        execute_seconds, rows}}`` (plans/common.bucket_profile over
+        utils/compile_time sections). The serving coalescer
+        (serving/server.py) reads this to pick its deadline-or-full
+        target bucket from recorded data; bench emits it."""
+        return _shared_bucket_profile("score", self._plan_id,
+                                      self._bucket_rows)
 
     def _dispatch_device(self, inputs, mask):
         """One fused-program dispatch behind the runtime retry policy:

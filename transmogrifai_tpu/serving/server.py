@@ -1,0 +1,758 @@
+"""Async micro-batching serving loop: live requests -> bucketed
+compiled dispatches under latency SLOs.
+
+Every prior serving entry point scores a MATERIALIZED batch: the caller
+already holds all the rows. "Millions of users" (ROADMAP north star)
+means concurrent single-record requests arriving on their own clock —
+and per-request dispatch wastes the compiled bucket programs the
+:class:`~.plan.ScoringPlan` exists to amortize (a batch-of-1 pays the
+same fixed dispatch cost as a batch-of-64), while unbounded coalescing
+blows the tail latency. This module is the middle path, the
+batching-vs-latency tradeoff the Gemma-on-TPU serving comparison in
+PAPERS.md frames:
+
+- **Deadline-or-full coalescing.** Requests queue per (model, tenant)
+  lane; a lane dispatches when its queue reaches the coalescer's
+  target bucket OR the oldest request has waited ``max_wait_ms`` —
+  whichever comes first. The target bucket is picked from the plan's
+  RECORDED per-bucket dispatch costs (:meth:`~.plan.ScoringPlan
+  .bucket_profile`, the "A Learned Performance Model for TPUs"
+  direction in PAPERS.md) rather than a static default.
+- **Double buffering.** Host-side boxing/encoding of batch k+1
+  (:meth:`~.plan.ScoringPlan.encode_raw_dataset`, the encode pool)
+  overlaps batch k's in-flight device program
+  (:meth:`~.plan.ScoringPlan.dispatch_encoded`, the device lane); a
+  semaphore bounds the pipeline at one in-flight dispatch so the
+  collector never runs unboundedly ahead.
+- **Per-tenant guardrails.** Each tenant carries its own PR-5 stack:
+  schema admission with machine-readable quarantine reasons, an output
+  guard, a circuit breaker + per-batch deadline around device dispatch
+  with the host columnar fallback, and a drift sentinel fed from the
+  live stream. One tenant's breaker trip routes ITS batches to the
+  fallback pool — another tenant's queue keeps dispatching to the
+  device lane (isolation asserted in tests/test_serving_loop.py). A
+  hung backend is ORPHANED at the deadline: the device executor is
+  abandoned and replaced, so the event loop never wedges behind it.
+- **Multi-model plan cache.** N fitted models stay resident under an
+  LRU budget keyed by (model dir, bucket range); evictions are counted
+  (``serve_plan_cache_evictions``) and an evicted model transparently
+  recompiles on next use — one process serves a model zoo.
+
+The whole hot path runs through the already-fused ScoringPlan bucket
+programs, so steady state pays ZERO compiles (asserted); per-request
+results are bitwise identical to offline ``score_guarded()`` on the
+same rows (asserted). Entry points: ``python -m transmogrifai_tpu.cli
+serve`` (JSON-lines over TCP, cli/serve.py) and the in-process
+:class:`ServingClient` for tests/bench (``TX_BENCH_MODE=serve_loop``).
+Blocking calls are banned from the async handlers by lint rule TX-J10
+(docs/lint.md); everything blocking runs in a named executor.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures as _cf
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import (Any, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
+
+import numpy as np
+
+from ..runtime import telemetry as _telemetry
+from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
+                    GuardReason, OutputGuard, SchemaGuard,
+                    _invalidate_rows)
+from .plan import EncodedScoreBatch, ScoringPlan
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ServeConfig", "ServingServer", "ServingClient", "PlanCache",
+           "ServeRejected", "serve_in_process"]
+
+#: coalescer target when no bucket profile has been recorded yet
+_DEFAULT_TARGET = 64
+
+
+class ServeRejected(RuntimeError):
+    """A request was refused before scoring (queue over its
+    backpressure limit, unknown model, or server shutdown)."""
+
+
+@dataclass
+class ServeConfig:
+    """Knobs of the serving loop (docs/serving_loop.md)."""
+    #: SLO half of deadline-or-full: a request waits at most this long
+    #: in the coalescing queue before its lane dispatches
+    max_wait_ms: float = 5.0
+    #: coalescer target batch; None derives it per lane from the
+    #: plan's recorded ``bucket_profile()`` (largest bucket whose warm
+    #: per-dispatch cost fits inside max_wait_ms)
+    target_batch: Optional[int] = None
+    #: hard cap on rows per dispatch (<= the plan's max bucket)
+    max_batch: int = 256
+    #: per-lane backpressure: requests beyond this are rejected with
+    #: ServeRejected instead of growing the queue without bound
+    queue_limit: int = 4096
+    #: LRU budget of the multi-model plan cache (resident plans)
+    plan_budget: int = 4
+    #: per-tenant PR-5 guardrails (admission/output/breaker/sentinel);
+    #: False = raw dispatch (no quarantine, no breaker, no sentinel)
+    guardrails: bool = True
+    admission: Optional[AdmissionPolicy] = None
+    #: drift sentinel per tenant (requires guardrails)
+    sentinel: bool = True
+    drift_thresholds: Any = None
+    #: per-batch device dispatch deadline; a dispatch still running at
+    #: the deadline is ORPHANED (executor abandoned + replaced) and the
+    #: batch falls back to the host columnar path
+    deadline_seconds: Optional[float] = None
+    #: per-tenant breaker parameters (breaker_factory overrides, e.g.
+    #: to inject a test clock)
+    breaker_failures: int = 3
+    breaker_cooldown_seconds: float = 30.0
+    breaker_factory: Optional[Callable[[], CircuitBreaker]] = None
+
+
+@dataclass
+class _Request:
+    record: dict
+    future: asyncio.Future
+    arrived: float
+
+
+@dataclass
+class _CacheEntry:
+    model: Any
+    plan: ScoringPlan
+    result_names: List[str]
+    guards: Dict[str, "_TenantGuards"] = field(default_factory=dict)
+
+
+class _TenantGuards:
+    """One tenant's PR-5 stack over a shared compiled plan. The plan
+    itself stays UNGUARDED (``plan.guard is None``) — guard state that
+    used to live on the plan (breaker, sentinel sketches) lives here,
+    per tenant, so tenants fail and recover independently."""
+
+    def __init__(self, model, config: ServeConfig):
+        self.schema: Optional[SchemaGuard] = None
+        self.output: Optional[OutputGuard] = None
+        self.breaker: Optional[CircuitBreaker] = None
+        self.sentinel = None
+        if not config.guardrails:
+            return
+        self.schema = SchemaGuard(model, policy=config.admission)
+        self.output = OutputGuard()
+        self.breaker = (config.breaker_factory()
+                        if config.breaker_factory is not None else
+                        CircuitBreaker(
+                            failure_threshold=config.breaker_failures,
+                            cooldown_seconds=(
+                                config.breaker_cooldown_seconds)))
+        if config.sentinel:
+            from .sentinel import DriftSentinel
+            self.sentinel = DriftSentinel.for_model(
+                model, thresholds=config.drift_thresholds)
+
+
+class PlanCache:
+    """LRU of compiled ScoringPlans keyed by (model dir, bucket range)
+    — the compile-cache budget that turns one process into a model-zoo
+    server. Eviction drops the plan (and its jitted programs) but
+    keeps the loader, so an evicted model transparently reloads +
+    recompiles on next use; hits/misses/evictions are counted."""
+
+    def __init__(self, budget: int = 4):
+        if budget < 1:
+            raise ValueError("plan cache budget must be >= 1")
+        self.budget = int(budget)
+        #: name -> loader (model dir string, or an in-memory model)
+        self._loaders: Dict[str, Any] = {}
+        self._entries: "collections.OrderedDict[Tuple, _CacheEntry]" = \
+            collections.OrderedDict()
+        self.evictions = 0
+
+    def register(self, name: str, model_or_dir: Any) -> None:
+        self._loaders[name] = model_or_dir
+
+    def names(self) -> List[str]:
+        return sorted(self._loaders)
+
+    def get(self, name: str,
+            buckets: Tuple[int, int] = (None, None)) -> _CacheEntry:
+        """Resident entry for ``name`` (LRU-bumped), loading the model
+        and compiling its plan on a miss. Blocking — call from an
+        executor, never from the event loop."""
+        if name not in self._loaders:
+            raise ServeRejected(f"unknown model {name!r}; registered: "
+                                f"{self.names()}")
+        key = (name, buckets)
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+            _telemetry.count("serve_plan_cache_hits")
+            return entry
+        _telemetry.count("serve_plan_cache_misses")
+        loader = self._loaders[name]
+        if isinstance(loader, str):
+            from ..workflow.workflow import WorkflowModel
+            model = WorkflowModel.load(loader)
+        else:
+            model = loader
+        kwargs = {}
+        if buckets[0] is not None:
+            kwargs["min_bucket"] = buckets[0]
+        if buckets[1] is not None:
+            kwargs["max_bucket"] = buckets[1]
+        plan = ScoringPlan(model, **kwargs).compile()
+        entry = _CacheEntry(
+            model=model, plan=plan,
+            result_names=[f.name for f in model.result_features])
+        self._entries[key] = entry
+        while len(self._entries) > self.budget:
+            old_key, _old = self._entries.popitem(last=False)
+            self.evictions += 1
+            _telemetry.count("serve_plan_cache_evictions")
+            _telemetry.event("serve_plan_evicted", model=old_key[0])
+        return entry
+
+
+class _Lane:
+    """One (model, tenant) coalescing queue + its collector task."""
+
+    def __init__(self, model_name: str, tenant: str):
+        self.model_name = model_name
+        self.tenant = tenant
+        self.queue: "collections.deque[_Request]" = collections.deque()
+        self.wakeup: Optional[asyncio.Event] = None   # built on the loop
+        self.full: Optional[asyncio.Event] = None
+        #: the collector's current deadline-or-full threshold; the
+        #: enqueue edge signals ``full`` when the queue reaches it so
+        #: the collector wakes ONCE per batch, not once per request
+        self.target: int = _DEFAULT_TARGET
+        self.task: Optional[asyncio.Task] = None
+
+
+@dataclass
+class _PreparedBatch:
+    """Everything the dispatch stage needs, produced host-side in the
+    encode pool (the double-buffered half)."""
+    entry: _CacheEntry
+    guards: _TenantGuards
+    requests: List[_Request]
+    enc: EncodedScoreBatch
+    ds: Any
+    quarantined: List[GuardReason]
+    qmask: np.ndarray
+    #: set when the per-batch deadline orphaned this batch's dispatch:
+    #: the batch was already answered through the host fallback, so a
+    #: hung device thread that eventually wakes must NOT run the
+    #: finish stage (it would double-count telemetry and re-observe
+    #: rows on the sentinel, long after the batch resolved)
+    abandoned: bool = False
+
+
+class ServingServer:
+    """The asyncio micro-batching scorer. Typical in-process use::
+
+        server = ServingServer(ServeConfig(max_wait_ms=2.0))
+        server.add_model("titanic", model)       # or a saved model dir
+        client = server.start_background()
+        row = client.score({"age": 31.0, ...}, model="titanic")
+        server.stop()
+
+    ``python -m transmogrifai_tpu.cli serve`` wraps the same object in
+    a JSON-lines TCP front end (cli/serve.py)."""
+
+    def __init__(self, config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig()
+        self.plans = PlanCache(budget=self.config.plan_budget)
+        self._lanes: Dict[Tuple[str, str], _Lane] = {}
+        self._default_model: Optional[str] = None
+        self._running = False
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._encode_pool = _cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tx-serve-encode")
+        self._device_pool = _cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tx-serve-device")
+        self._fallback_pool = _cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tx-serve-fallback")
+        self._dispatch_sem: Optional[asyncio.Semaphore] = None
+        #: float accumulators (occupancy/saturation; bench reads these)
+        self.stats: Dict[str, float] = {
+            "requests": 0, "batches": 0, "rows": 0,
+            "full_dispatches": 0, "deadline_dispatches": 0,
+            "dispatch_seconds": 0.0, "orphaned_dispatches": 0,
+        }
+        self._first_dispatch_at: Optional[float] = None
+        self._last_dispatch_at: Optional[float] = None
+
+    # -- registry ----------------------------------------------------------
+    def add_model(self, name: str, model_or_dir: Any,
+                  default: bool = False) -> "ServingServer":
+        """Register a fitted model (in-memory ``WorkflowModel`` or a
+        saved model directory). The first registered model is the
+        default for requests that name none."""
+        self.plans.register(name, model_or_dir)
+        if default or self._default_model is None:
+            self._default_model = name
+        return self
+
+    # -- async request edge ------------------------------------------------
+    async def score_async(self, record: dict, model: Optional[str] = None,
+                          tenant: str = "default") -> dict:
+        """Enqueue one record; resolves with the scored row dict (the
+        ``ScoreFunction`` row contract — result features by name, plus
+        a ``"_guard"`` reason list for quarantined/invalidated rows)."""
+        if not self._running:
+            raise ServeRejected("serving loop is not running")
+        name = model or self._default_model
+        if name is None:
+            raise ServeRejected("no model registered")
+        lane = self._lane(name, tenant)
+        if len(lane.queue) >= self.config.queue_limit:
+            _telemetry.count("serve_queue_rejections")
+            raise ServeRejected(
+                f"lane {name}/{tenant} queue is at its backpressure "
+                f"limit ({self.config.queue_limit})")
+        loop = asyncio.get_running_loop()
+        req = _Request(record=record, future=loop.create_future(),
+                       arrived=time.monotonic())
+        lane.queue.append(req)
+        self.stats["requests"] += 1
+        _telemetry.count("serve_requests")
+        if len(lane.queue) == 1:
+            lane.wakeup.set()               # lane was idle: start timer
+        if len(lane.queue) >= lane.target:
+            lane.full.set()                 # bucket filled: fire early
+        return await req.future
+
+    def _lane(self, model_name: str, tenant: str) -> _Lane:
+        key = (model_name, tenant)
+        lane = self._lanes.get(key)
+        if lane is None:
+            lane = self._lanes[key] = _Lane(model_name, tenant)
+            lane.wakeup = asyncio.Event()
+            lane.full = asyncio.Event()
+            lane.task = asyncio.get_running_loop().create_task(
+                self._lane_loop(lane),
+                name=f"tx-serve-lane-{model_name}-{tenant}")
+        return lane
+
+    # -- the coalescing collector ------------------------------------------
+    def _target_batch(self, plan: ScoringPlan) -> int:
+        """Deadline-or-full's "full": the coalescer's target batch.
+        Explicit config wins; otherwise the largest bucket whose
+        RECORDED warm per-dispatch cost still fits inside the wait
+        budget (``bucket_profile()``), so the threshold comes from
+        this process's measured dispatch costs, not a constant."""
+        cfg = self.config
+        if cfg.target_batch:
+            return max(1, min(cfg.target_batch, cfg.max_batch))
+        budget_s = cfg.max_wait_ms / 1000.0
+        best = 0
+        for bucket, rec in plan.bucket_profile().items():
+            if rec["calls"] < 1 or bucket > cfg.max_batch:
+                continue
+            per_dispatch = rec["execute_seconds"] / rec["calls"]
+            if per_dispatch <= budget_s and bucket > best:
+                best = bucket
+        return best or min(_DEFAULT_TARGET, cfg.max_batch)
+
+    async def _collect(self, lane: _Lane, target: int
+                       ) -> List[_Request]:
+        """Deadline-or-full: wait for the first request, then ONE
+        timer until the lane holds ``target`` requests (the enqueue
+        edge fires ``lane.full``) or the OLDEST request has waited
+        ``max_wait_ms`` — whichever comes first."""
+        lane.target = max(1, target)
+        while not lane.queue:
+            lane.wakeup.clear()
+            await lane.wakeup.wait()
+            if not self._running:
+                return []
+        deadline = lane.queue[0].arrived + self.config.max_wait_ms / 1000.0
+        while len(lane.queue) < lane.target:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            lane.full.clear()
+            try:
+                await asyncio.wait_for(lane.full.wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+        n = min(len(lane.queue), self.config.max_batch)
+        batch = [lane.queue.popleft() for _ in range(n)]
+        key = ("full_dispatches" if n >= lane.target
+               else "deadline_dispatches")
+        self.stats[key] += 1
+        _telemetry.count(f"serve_{key}")
+        return batch
+
+    async def _lane_loop(self, lane: _Lane) -> None:
+        """One lane's collector: coalesce -> host-encode (encode pool)
+        -> bounded-spawn the dispatch stage. The semaphore is acquired
+        HERE and released when the dispatch completes, so exactly one
+        batch is on the device while this loop coalesces + encodes the
+        next one — the double buffer."""
+        from ..runtime.errors import classify_error
+        loop = asyncio.get_running_loop()
+        target = _DEFAULT_TARGET
+        while self._running:
+            batch: List[_Request] = []
+            try:
+                batch = await self._collect(lane, target)
+                if not batch:
+                    continue
+                prep = await loop.run_in_executor(
+                    self._encode_pool, self._prepare_batch, lane, batch)
+                target = self._target_batch(prep.entry.plan)
+                await self._dispatch_sem.acquire()
+                loop.create_task(self._dispatch_resolve(prep))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a failed prepare fails THIS batch's requests with the
+                # recorded, classified reason and the lane keeps
+                # serving (the TX-R01/TX-R02 contract: never silent)
+                _telemetry.count("serve_batch_failures")
+                _telemetry.event("serve_batch_failed", lane=lane.tenant,
+                                 model=lane.model_name,
+                                 kind=classify_error(e),
+                                 error=f"{type(e).__name__}: {e}")
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+
+    # -- host-side batch prep (encode pool thread) -------------------------
+    def _prepare_batch(self, lane: _Lane, batch: List[_Request]
+                       ) -> _PreparedBatch:
+        """Blocking host work: plan-cache lookup (may reload/recompile
+        an evicted model), schema admission with per-row quarantine
+        reasons, raw-Dataset boxing, and bucket encode/padding."""
+        entry = self.plans.get(lane.model_name)
+        guards = entry.guards.get(lane.tenant)
+        if guards is None:
+            guards = entry.guards[lane.tenant] = _TenantGuards(
+                entry.model, self.config)
+        records = [r.record for r in batch]
+        n = len(records)
+        if guards.schema is not None:
+            ds, quarantined = guards.schema.admit_records(records)
+        else:
+            from ..workflow.workflow import _generate_raw_data
+            ds = _generate_raw_data(entry.model.raw_features(), records,
+                                    require_responses=False)
+            quarantined = []
+        qmask = np.zeros(n, dtype=bool)
+        for r in quarantined:
+            if 0 <= r.row < n:
+                qmask[r.row] = True
+        enc = entry.plan.encode_raw_dataset(
+            ds, valid_mask=(~qmask).astype(np.float64))
+        return _PreparedBatch(entry=entry, guards=guards, requests=batch,
+                              enc=enc, ds=ds, quarantined=quarantined,
+                              qmask=qmask)
+
+    # -- device dispatch + guarded resolution ------------------------------
+    async def _dispatch_resolve(self, prep: _PreparedBatch) -> None:
+        try:
+            rows = await self._dispatch_guarded(prep)
+            for req, row in zip(prep.requests, rows):
+                if not req.future.done():
+                    req.future.set_result(row)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # classified-bug dispatches (and finish-stage crashes)
+            # fail the batch's requests with the recorded reason
+            from ..runtime.errors import classify_error
+            _telemetry.count("serve_batch_failures")
+            _telemetry.event("serve_batch_failed",
+                             kind=classify_error(e),
+                             error=f"{type(e).__name__}: {e}")
+            for req in prep.requests:
+                if not req.future.done():
+                    req.future.set_exception(e)
+        finally:
+            self._dispatch_sem.release()
+
+    async def _dispatch_guarded(self, prep: _PreparedBatch
+                                ) -> List[dict]:
+        """Breaker-gated device dispatch with the per-batch deadline
+        and host columnar fallback — the per-tenant serving half of
+        ``ScoringPlan.score_guarded`` over a shared unguarded plan.
+        Dispatch + post-dispatch bookkeeping run in ONE executor hop
+        (``_device_batch``): every loop round-trip costs real tail
+        latency on a contended host."""
+        loop = asyncio.get_running_loop()
+        breaker = prep.guards.breaker
+        t0 = time.monotonic()
+        try:
+            if breaker is not None:
+                breaker.before_dispatch()
+            fut = self._device_pool.submit(self._device_batch, prep)
+            aw = asyncio.wrap_future(fut)
+            deadline = self.config.deadline_seconds
+            if deadline is not None:
+                try:
+                    rows = await asyncio.wait_for(aw, deadline)
+                except asyncio.TimeoutError:
+                    # the device thread may be wedged inside the
+                    # backend: ORPHAN the executor (new lane for the
+                    # next batch) rather than queueing behind it
+                    prep.abandoned = True
+                    self._orphan_device_pool()
+                    _telemetry.count("serving_deadline_exceeded")
+                    raise TimeoutError(
+                        f"DEADLINE_EXCEEDED: serve batch exceeded the "
+                        f"{deadline}s device dispatch deadline"
+                    ) from None
+            else:
+                rows = await aw
+            if breaker is not None:
+                breaker.record_success()
+            self._note_dispatch(prep, t0)
+            return rows
+        except BreakerOpenError as e:
+            _telemetry.count("serving_breaker_short_circuits")
+            _log.warning("serve lane breaker open; host fallback: %s", e)
+        except Exception as e:
+            from ..runtime.errors import BUG, classify_error
+            if breaker is None or classify_error(e) == BUG:
+                raise
+            breaker.record_failure()
+            _telemetry.count("serving_device_failures")
+            _telemetry.event("serving_fallback",
+                             error=f"{type(e).__name__}: {e}",
+                             breaker=breaker.state)
+            _log.warning(
+                "serve device dispatch failed (%s: %s); host fallback "
+                "(breaker %s)", type(e).__name__, e, breaker.state)
+        # breaker open / classified device failure: the tenant's batch
+        # scores through the host columnar path in the FALLBACK pool —
+        # the device lane stays free for healthy tenants
+        rows = await loop.run_in_executor(
+            self._fallback_pool, self._fallback_batch, prep)
+        self._note_dispatch(prep, t0)
+        return rows
+
+    def _device_batch(self, prep: _PreparedBatch) -> List[dict]:
+        """Device-pool thread: fused-program dispatch + guarded finish
+        in one hop. An abandoned batch (deadline fired; answered via
+        fallback) skips both — this thread may be waking from a hang
+        long after anyone cared."""
+        if prep.abandoned:
+            return []
+        scored = prep.entry.plan.dispatch_encoded(prep.enc)
+        if prep.abandoned:
+            return []
+        return self._finish_batch(prep, scored, used_fallback=False)
+
+    def _fallback_batch(self, prep: _PreparedBatch) -> List[dict]:
+        """Fallback-pool thread: host columnar scoring + guarded
+        finish for a tenant whose device path is unavailable."""
+        scored = prep.entry.plan.score_host_columnar(prep.ds)
+        return self._finish_batch(prep, scored, used_fallback=True)
+
+    def _note_dispatch(self, prep: _PreparedBatch, t0: float) -> None:
+        now = time.monotonic()
+        self.stats["batches"] += 1
+        self.stats["rows"] += len(prep.requests)
+        self.stats["dispatch_seconds"] += now - t0
+        if self._first_dispatch_at is None:
+            self._first_dispatch_at = t0
+        self._last_dispatch_at = now
+        _telemetry.count("serve_batches")
+        _telemetry.count("serve_rows", len(prep.requests))
+
+    def _finish_batch(self, prep: _PreparedBatch, scored,
+                      used_fallback: bool) -> List[dict]:
+        """Blocking post-dispatch host work: output guard, quarantined-
+        row invalidation, sentinel observation, per-request row boxing
+        (identical bookkeeping to ``ScoringPlan._score_guarded_raw``)."""
+        from ..local.scoring import _unbox
+        guards, names = prep.guards, prep.entry.result_names
+        n, qmask = len(prep.requests), prep.qmask
+        invalidated: List[GuardReason] = []
+        if guards.output is not None:
+            scored, invalidated = guards.output.check(
+                scored, names, skip_rows=qmask)
+        if qmask.any():
+            scored = _invalidate_rows(scored, names, qmask)
+        if guards.sentinel is not None:
+            obs = (prep.ds.take(np.flatnonzero(~qmask)) if qmask.any()
+                   else prep.ds)
+            guards.sentinel.observe_dataset(obs)
+        n_bad = int(qmask.sum())
+        _telemetry.count("serving_rows_scored", n - n_bad)
+        if n_bad:
+            _telemetry.count("serving_rows_quarantined", n_bad)
+        if invalidated:
+            _telemetry.count("serving_rows_invalidated",
+                             len({r.row for r in invalidated}))
+        by_row: Dict[int, List[dict]] = {}
+        for r in prep.quarantined:
+            by_row.setdefault(r.row, []).append(
+                {"kind": "quarantined", **r.to_json()})
+        for r in invalidated:
+            by_row.setdefault(r.row, []).append(
+                {"kind": "invalidated", **r.to_json()})
+        cols = [scored[nm] for nm in names]
+        rows: List[dict] = []
+        for i in range(n):
+            if i in by_row:
+                row: dict = {nm: None for nm in names}
+                row["_guard"] = by_row[i]
+            else:
+                row = {nm: _unbox(col.boxed(i))
+                       for nm, col in zip(names, cols)}
+            if used_fallback:
+                row["_host_fallback"] = True
+            rows.append(row)
+        return rows
+
+    def _orphan_device_pool(self) -> None:
+        """Abandon a wedged device executor (its thread may be stuck
+        inside the backend forever) and stand up a fresh lane so the
+        loop keeps dispatching — the serving twin of the selector's
+        family-deadline abandonment."""
+        self.stats["orphaned_dispatches"] += 1
+        old = self._device_pool
+        self._device_pool = _cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tx-serve-device")
+        old.shutdown(wait=False)
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        """Arm the loop-bound primitives (call from the event loop the
+        server will live on)."""
+        self.loop = asyncio.get_running_loop()
+        self._dispatch_sem = asyncio.Semaphore(1)
+        self._running = True
+
+    async def shutdown(self) -> None:
+        self._running = False
+        for lane in self._lanes.values():
+            if lane.wakeup is not None:
+                lane.wakeup.set()
+            if lane.task is not None:
+                lane.task.cancel()
+            for req in lane.queue:
+                if not req.future.done():
+                    req.future.set_exception(
+                        ServeRejected("serving loop stopped"))
+            lane.queue.clear()
+
+    def start_background(self) -> "ServingClient":
+        """Run the server on a daemon-thread event loop and return a
+        sync :class:`ServingClient` — the in-process entry point for
+        tests and the bench."""
+        if self._thread is not None:
+            return ServingClient(self)
+        ready = threading.Event()
+
+        def runner():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(self.start())
+            ready.set()
+            loop.run_forever()
+            loop.run_until_complete(self.shutdown())
+            loop.close()
+
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="tx-serve-loop")
+        self._thread.start()
+        ready.wait(timeout=30)
+        return ServingClient(self)
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._running = False
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(lambda: None)  # wake
+            self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=30)
+        self._thread = None
+        self._encode_pool.shutdown(wait=False)
+        self._device_pool.shutdown(wait=False)
+        self._fallback_pool.shutdown(wait=False)
+
+    # -- introspection -----------------------------------------------------
+    def describe(self) -> dict:
+        """Loop stats for bench/ops: occupancy (mean rows per
+        dispatch) and device-lane saturation (fraction of wall time a
+        dispatch was in flight)."""
+        batches = self.stats["batches"] or 1
+        wall = None
+        if self._first_dispatch_at is not None:
+            wall = max((self._last_dispatch_at or 0)
+                       - self._first_dispatch_at, 1e-9)
+        return {
+            "requests": int(self.stats["requests"]),
+            "batches": int(self.stats["batches"]),
+            "rows": int(self.stats["rows"]),
+            "mean_batch_occupancy": self.stats["rows"] / batches,
+            "full_dispatches": int(self.stats["full_dispatches"]),
+            "deadline_dispatches": int(self.stats["deadline_dispatches"]),
+            "orphaned_dispatches": int(self.stats["orphaned_dispatches"]),
+            "dispatch_saturation": (
+                self.stats["dispatch_seconds"] / wall
+                if wall is not None else 0.0),
+            "plan_cache": {"budget": self.plans.budget,
+                           "resident": len(self.plans._entries),
+                           "evictions": self.plans.evictions},
+            "models": self.plans.names(),
+            "lanes": sorted("/".join(k) for k in self._lanes),
+        }
+
+
+class ServingClient:
+    """Synchronous in-process facade over a background-thread
+    :class:`ServingServer` — what tests and ``TX_BENCH_MODE=serve_loop``
+    drive. ``submit`` returns a concurrent future for open-loop load
+    generation; ``score`` blocks for one row."""
+
+    def __init__(self, server: ServingServer):
+        self.server = server
+
+    def submit(self, record: dict, model: Optional[str] = None,
+               tenant: str = "default") -> "_cf.Future":
+        if self.server.loop is None:
+            raise ServeRejected("server not started")
+        return asyncio.run_coroutine_threadsafe(
+            self.server.score_async(record, model=model, tenant=tenant),
+            self.server.loop)
+
+    def score(self, record: dict, model: Optional[str] = None,
+              tenant: str = "default", timeout: float = 60.0) -> dict:
+        return self.submit(record, model=model, tenant=tenant).result(
+            timeout)
+
+    def score_many(self, records: Sequence[dict],
+                   model: Optional[str] = None, tenant: str = "default",
+                   timeout: float = 120.0) -> List[dict]:
+        """Submit every record CONCURRENTLY (they coalesce into shared
+        bucket dispatches) and return rows in request order."""
+        futs = [self.submit(r, model=model, tenant=tenant)
+                for r in records]
+        return [f.result(timeout) for f in futs]
+
+
+def serve_in_process(models: Dict[str, Any],
+                     config: Optional[ServeConfig] = None
+                     ) -> Tuple[ServingServer, ServingClient]:
+    """One-call setup for tests/bench: register ``models`` (name ->
+    fitted model or saved dir), start the loop on a background thread,
+    return (server, client). Caller owns ``server.stop()``."""
+    server = ServingServer(config)
+    for name, m in models.items():
+        server.add_model(name, m)
+    client = server.start_background()
+    return server, client
