@@ -65,9 +65,10 @@ def _load_probe_verdict():
     if os.environ.get("TX_BENCH_PROBE_REFRESH") == "1":
         return None
     try:
-        with open(_STATE_PATH) as fh:
-            d = json.load(fh)["probe"][_probe_key()]
-        return bool(d["healthy"]), str(d.get("note", ""))
+        from transmogrifai_tpu.observability.store import ProfileStore
+        d = ProfileStore(_STATE_PATH).probe_verdict(_probe_key())
+        if d is not None:
+            return bool(d["healthy"]), str(d.get("note", ""))
     except Exception:
         pass
     try:
@@ -78,26 +79,24 @@ def _load_probe_verdict():
         return None
 
 
-def _store_probe_verdict(healthy: bool, note: str) -> None:
+def _store_probe_verdict(healthy: bool, note: str,
+                         transcript=None) -> None:
+    """Persist one probe verdict: /tmp fast path + the repo-level
+    profile store (the SAME atomic-merge writer the cost profiles use,
+    transmogrifai_tpu/observability/store.py) — verdict AND transcript
+    survive across bench rounds, closing the ROADMAP "hidden
+    prerequisite"."""
     verdict = {"healthy": healthy, "note": note, "time": time.time()}
     try:
         with open(_probe_cache_path(), "w") as fh:
             json.dump(verdict, fh)
     except OSError:  # pragma: no cover - read-only /tmp
         pass
-    # repo-level state: merge (other keys belong to other environments)
     try:
-        state = {}
-        if os.path.exists(_STATE_PATH):
-            with open(_STATE_PATH) as fh:
-                state = json.load(fh)
-        state.setdefault("probe", {})[_probe_key()] = verdict
-        tmp = _STATE_PATH + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(state, fh, indent=1, sort_keys=True)
-            fh.write("\n")
-        os.replace(tmp, _STATE_PATH)
-    except (OSError, ValueError):  # pragma: no cover - read-only repo
+        from transmogrifai_tpu.observability.store import ProfileStore
+        ProfileStore(_STATE_PATH).record_probe(
+            _probe_key(), healthy, note, transcript=transcript)
+    except Exception:  # pragma: no cover - read-only repo
         pass
 
 
@@ -678,8 +677,112 @@ def _measure_serve_loop() -> dict:
                    for k, rec in sorted(
                        entry.plan.bucket_profile().items())}
         desc = server.describe()
+
+        # tracing overhead: rerun the arrival sweep's UNSATURATED
+        # rates (achieved >= 90% of offered — past saturation the loop
+        # is at capacity and single-run queueing noise dwarfs any
+        # per-span cost) with TX_TRACE=1 (in-memory spans, ~1.3us per
+        # span), BEST-OF-2 per rate on both sides: a single 400-
+        # request run's p99 is four stragglers on a shared 1-core
+        # host, the same reason the sharded-search bench is best-of-2
+        from transmogrifai_tpu.observability import trace as _trace
+        overhead_rows = []
+        for m, off_row in zip(multiples, sweep):
+            if off_row["achieved_rows_per_s"] \
+                    < 0.9 * off_row["offered_rows_per_s"]:
+                overhead_rows.append(
+                    {"offered_rows_per_s":
+                         off_row["offered_rows_per_s"],
+                     "saturated": True})
+                continue
+            offs = [run_rate(base_rps * m) for _ in range(2)]
+            _trace.configure(True)
+            try:
+                ons = [run_rate(base_rps * m) for _ in range(2)]
+            finally:
+                _trace.configure(False)
+                _trace.reset()
+            off_best = max(r["achieved_rows_per_s"] for r in offs)
+            overhead_rows.append({
+                "offered_rows_per_s": off_row["offered_rows_per_s"],
+                "rows_per_s_untraced": off_best,
+                "rows_per_s_traced": max(
+                    r["achieved_rows_per_s"] for r in ons),
+                "p50_ms_untraced": min(r["p50_ms"] for r in offs),
+                "p50_ms_traced": min(r["p50_ms"] for r in ons),
+                "p99_ms_untraced": min(r["p99_ms"] for r in offs),
+                "p99_ms_traced": min(r["p99_ms"] for r in ons),
+                # re-checked on the comparison runs themselves: a rate
+                # the sweep once achieved can still sit at capacity
+                "saturated": bool(
+                    off_best < 0.9 * off_row["offered_rows_per_s"]),
+            })
+
+        # the trace ARTIFACT (JSONL -> tx trace / Perfetto) records a
+        # 1x-rate pass separately: file serialization costs real CPU
+        # on this 1-core host and must not contaminate the overhead
+        # number; the artifact also proves the >=95% request child-
+        # span coverage acceptance, computed here from the live spans
+        trace_path = os.environ.get("TX_BENCH_TRACE_PATH",
+                                    "/tmp/tx_serve_loop_trace.jsonl")
+        try:
+            os.unlink(trace_path)
+        except OSError:
+            pass
+        _trace.configure(True, path=trace_path)
+        try:
+            run_rate(base_rps)
+            all_spans = _trace.spans()
+            reqs = [s for s in all_spans
+                    if s["name"] == "serve.request"][:50]
+            covs = [_trace.coverage(all_spans, s["trace"])
+                    for s in reqs]
+            trace_coverage_min = round(min(covs), 4) if covs else 0.0
+        finally:
+            _trace.flush()
+            _trace.configure(False)
+            _trace.reset()
+        live_metrics = server.metrics_snapshot()
     finally:
         server.stop()
+
+    asserted = [r for r in overhead_rows
+                if not r.get("saturated", True)]
+    if asserted:
+        overhead_fraction = max(
+            max(0.0, 1.0 - r["rows_per_s_traced"]
+                / r["rows_per_s_untraced"]) for r in asserted)
+        # latency asserts on the MEAN p50 across the asserted rates
+        # (+0.5ms timer-jitter allowance): per-rate medians still
+        # carry +-1-2ms of coalescing-alignment luck in BOTH
+        # directions on this host, and p99 of a 400-request open-loop
+        # run is four stragglers of the same luck (it swings 12->57ms
+        # between IDENTICAL untraced runs) — both are reported per
+        # rate above, the aggregate is what is asserted
+        p50_off = sum(r["p50_ms_untraced"]
+                      for r in asserted) / len(asserted)
+        p50_on = sum(r["p50_ms_traced"]
+                     for r in asserted) / len(asserted)
+        p50_ok = p50_on <= p50_off * 1.05 + 0.5
+    else:  # pragma: no cover - every rate saturated
+        overhead_fraction, p50_ok = 1.0, False
+        p50_off = p50_on = 0.0
+    tracing = {
+        "trace_artifact": trace_path,
+        "rate_comparison": overhead_rows,
+        "asserted_rates": len(asserted),
+        "throughput_overhead_fraction": round(overhead_fraction, 4),
+        "mean_p50_ms_untraced": round(p50_off, 3),
+        "mean_p50_ms_traced": round(p50_on, 3),
+        "p50_within_5pct_plus_jitter": bool(p50_ok),
+        "within_5pct": bool(overhead_fraction < 0.05 and p50_ok),
+        "request_child_span_coverage_min": trace_coverage_min,
+    }
+
+    # fold this run's measured section/bucket/family costs into the
+    # persisted profile store (BENCH_STATE.json) — the cost history the
+    # telemetry-autotuning roadmap item reads (docs/observability.md)
+    merged = _persist_profiles()
 
     value = headline["achieved_rows_per_s"]
     return {
@@ -702,6 +805,10 @@ def _measure_serve_loop() -> dict:
         "max_wait_ms": max_wait_ms,
         "repeat_compiles": repeat_compiles,
         "bitwise_parity_vs_offline_guarded": bool(parity),
+        "tracing": tracing,
+        "live_metrics_schema": live_metrics["schema"],
+        "live_latency_ms": live_metrics["latency_ms"],
+        "profile_store_keys_merged": len(merged),
         "bucket_profile": profile,
         "mean_batch_occupancy": round(desc["mean_batch_occupancy"], 2),
         "dispatch_saturation": round(desc["dispatch_saturation"], 3),
@@ -868,8 +975,21 @@ def _measure_prepare() -> dict:
         "placement_report": placement_report(),
         "prepare_compiles": repeat_compiles,
         "prepare_parity_max_dev": parity_dev,
+        "profile_store_keys_merged": len(_persist_profiles()),
         "platform": "cpu",
     }
+
+
+def _persist_profiles() -> dict:
+    """Merge this process's measured section/bucket/family costs into
+    the persisted profile store (observability/store.py; best-effort on
+    a read-only checkout)."""
+    try:
+        from transmogrifai_tpu.observability import \
+            persist_process_profiles
+        return persist_process_profiles()
+    except Exception:  # pragma: no cover - defensive
+        return {}
 
 
 def _measure_sharded_search() -> dict:
@@ -1133,6 +1253,16 @@ def _parse_result(stdout: str) -> dict | None:
     return None
 
 
+def _np_safe(o):
+    """json.dumps default: numpy scalars (np.float64/np.bool_ riding
+    in measurement dicts) serialize as their Python values."""
+    item = getattr(o, "item", None)
+    if callable(item):
+        return item()
+    raise TypeError(f"Object of type {type(o).__name__} is not JSON "
+                    f"serializable")
+
+
 def _probe_once() -> tuple[bool, str]:
     """Initialize the ambient backend in a disposable child under a
     short timeout; a hung tunnel is detected here for PROBE_TIMEOUT_S
@@ -1184,11 +1314,11 @@ def _probe_ambient() -> tuple[bool, str, list]:
             f"({time.perf_counter() - t0:.1f}s): "
             + ("ok platform=" + note if ok else note))
         if ok:
-            _store_probe_verdict(True, note)
+            _store_probe_verdict(True, note, transcript=transcript)
             return True, note, transcript
         if i + 1 < PROBE_ATTEMPTS:
             time.sleep(5 * (i + 1))
-    _store_probe_verdict(False, note)
+    _store_probe_verdict(False, note, transcript=transcript)
     return False, note, transcript
 
 
@@ -1206,7 +1336,7 @@ def main() -> None:
             metric, unit = _headline_metric()
             out = {"metric": metric, "value": 0.0, "unit": unit,
                    "vs_baseline": 0.0, "error_msg": repr(e)}
-        print(json.dumps(out))
+        print(json.dumps(out, default=_np_safe))
         return
     # attempt 1: ambient backend (TPU when the tunnel is up) in a child
     # the watchdog can kill — covers init AND mid-run hangs. A cheap
@@ -1222,7 +1352,7 @@ def main() -> None:
             out = _parse_result(r.stdout)
             if r.returncode == 0 and out is not None and out.get("value"):
                 out["probe_transcript"] = transcript
-                print(json.dumps(out))
+                print(json.dumps(out, default=_np_safe))
                 return
             note = (f"ambient run rc={r.returncode}: "
                     + (out or {}).get("error_msg",
@@ -1244,7 +1374,7 @@ def main() -> None:
                "unit": unit, "vs_baseline": 0.0, "error_msg": repr(e),
                "platform_note": note}
     out["probe_transcript"] = transcript
-    print(json.dumps(out))
+    print(json.dumps(out, default=_np_safe))
 
 
 def _headline_metric() -> tuple:
@@ -1272,7 +1402,7 @@ def _inner() -> None:
         metric, unit = _headline_metric()
         out = {"metric": metric, "value": 0.0,
                "unit": unit, "vs_baseline": 0.0, "error_msg": repr(e)}
-    print(json.dumps(out))
+    print(json.dumps(out, default=_np_safe))
 
 
 if __name__ == "__main__":

@@ -647,6 +647,101 @@ class TestWorkflowValidate:
         assert model.score(recs).n_rows == 60
 
 
+class TestObservabilityRule:
+    """TX-O01: telemetry/trace emission inside a jitted body records
+    TRACE time, not run time (docs/lint.md, docs/observability.md)."""
+
+    def test_o01_telemetry_event_and_count_in_jit(self):
+        findings = _src("""
+            import jax
+            from transmogrifai_tpu.runtime import telemetry
+
+            @jax.jit
+            def kernel(x):
+                telemetry.event("dispatched", rows=8)
+                telemetry.count("kernel_calls")
+                return x * 2
+        """)
+        assert [f.rule_id for f in findings] == ["TX-O01", "TX-O01"]
+        assert all(f.severity == "error" for f in findings)
+        assert "COMPILE" in findings[0].message
+
+    def test_o01_wall_clock_read_in_jit(self):
+        findings = _src("""
+            import jax
+            import time
+
+            @jax.jit
+            def kernel(x):
+                t0 = time.perf_counter()
+                y = x * 2
+                return y, time.perf_counter() - t0
+        """)
+        assert [f.rule_id for f in findings] == ["TX-O01", "TX-O01"]
+        assert "trace time" in findings[0].message
+
+    def test_o01_tracer_span_in_jit(self):
+        findings = _src("""
+            import jax
+            from transmogrifai_tpu.observability import trace
+
+            @jax.jit
+            def kernel(x):
+                trace.add_event("inner", n=1)
+                return x
+        """)
+        assert _rules(findings) == {"TX-O01"}
+
+    def test_o01_host_side_emission_is_fine(self):
+        # the same calls AROUND the jitted dispatch are the blessed
+        # pattern — no findings
+        assert _src("""
+            import jax
+            import time
+            from transmogrifai_tpu.runtime import telemetry
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def dispatch(x):
+                t0 = time.perf_counter()
+                out = kernel(x)
+                telemetry.event("dispatched",
+                                seconds=time.perf_counter() - t0)
+                return out
+        """) == []
+
+    def test_o01_compile_time_section_is_exempt(self):
+        # measuring trace cost inside a traced body is section()'s
+        # documented job (plans/prepare.py per-stage sections)
+        assert _src("""
+            import jax
+            from transmogrifai_tpu.utils import compile_time
+
+            @jax.jit
+            def kernel(x):
+                with compile_time.section("prepare:stage:X"):
+                    y = x * 2
+                return y
+        """) == []
+
+    def test_o01_inline_suppression(self, tmp_path):
+        # suppressions live at the file layer (engine applies them)
+        p = tmp_path / "kern.py"
+        p.write_text(textwrap.dedent("""
+            import jax
+            import time
+
+            @jax.jit
+            def kernel(x):
+                t0 = time.time()  # tx-lint: disable=TX-O01
+                return x
+        """))
+        findings, _ = lint_paths([str(p)])
+        assert [f.rule_id for f in findings] == []
+
+
 class TestRepoGate:
     def test_package_source_is_lint_clean(self):
         """The analyzer gates this repo: any new hot-path defect in

@@ -5,8 +5,17 @@ Protocol: one JSON object per line on the socket; the server answers
 one JSON line per request, in order::
 
     -> {"record": {"age": 31.0, ...}, "model": "titanic", "tenant": "a"}
-    <- {"ok": true, "result": {"pred_...": {...}}}
-    <- {"ok": false, "error": "...", "kind": "transient"}
+    <- {"ok": true, "request_id": "req-1a2b-3", "result": {...}}
+    <- {"ok": false, "request_id": "...", "error": "...",
+        "kind": "transient"}
+
+Every response echoes a ``request_id`` — generated at admission, or
+the client's own ``"id"`` field when supplied — the same id that keys
+the request's span tree when tracing is on (``TX_TRACE``,
+docs/observability.md). A ``{"metrics": true}`` line is a CONTROL
+request: it answers the live metrics snapshot instead of scoring, and
+``--metrics-port`` serves the same JSON over HTTP (``GET /``) for
+scrapers that should not touch the scoring socket.
 
 Start one process serving a model zoo::
 
@@ -61,6 +70,10 @@ def add_serve_parser(sub) -> None:
                     help="disable the per-tenant drift sentinel")
     sv.add_argument("--max-requests", type=int, default=None,
                     help="exit after answering N requests (smoke/CI)")
+    sv.add_argument("--metrics-port", type=int, default=None,
+                    help="also serve the live metrics JSON over HTTP "
+                         "on this port (GET /; 0 = ephemeral, printed "
+                         "on stdout; docs/observability.md)")
 
 
 def _parse_models(specs: List[str]) -> List[tuple]:
@@ -77,10 +90,14 @@ def _parse_models(specs: List[str]) -> List[tuple]:
 
 async def serve_forever(server, host: str, port: int,
                         max_requests: Optional[int] = None,
-                        ready_cb=None) -> int:
+                        ready_cb=None,
+                        metrics_port: Optional[int] = None,
+                        metrics_ready_cb=None) -> int:
     """Run ``server``'s loop behind a JSON-lines TCP front end until
     cancelled (or ``max_requests`` answers). Importable so tests drive
-    the exact CLI path in-process with in-memory models."""
+    the exact CLI path in-process with in-memory models.
+    ``metrics_port`` additionally serves the live
+    ``server.metrics_snapshot()`` JSON over HTTP."""
     from ..runtime.errors import classify_error
     await server.start()
     answered = {"n": 0}
@@ -92,18 +109,30 @@ async def serve_forever(server, host: str, port: int,
                 line = await reader.readline()
                 if not line:
                     break
+                rid = None
                 try:
                     msg = json.loads(line)
-                    row = await server.score_async(
+                    if isinstance(msg, dict) and msg.get("metrics"):
+                        # control request: live metrics, no scoring,
+                        # does not consume the --max-requests budget
+                        out = {"ok": True,
+                               "metrics": server.metrics_snapshot()}
+                        writer.write((json.dumps(out, default=float)
+                                      + "\n").encode())
+                        await writer.drain()
+                        continue
+                    if isinstance(msg, dict) and "id" in msg:
+                        rid = str(msg["id"])
+                    rid, row = await server.score_with_id(
                         msg.get("record", msg), model=msg.get("model"),
-                        tenant=msg.get("tenant", "default"))
-                    out = {"ok": True, "result": row}
+                        tenant=msg.get("tenant", "default"), rid=rid)
+                    out = {"ok": True, "request_id": rid, "result": row}
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
                     # a bad request/record answers with the classified
                     # error instead of dropping the connection
-                    out = {"ok": False,
+                    out = {"ok": False, "request_id": rid,
                            "error": f"{type(e).__name__}: {e}",
                            "kind": classify_error(e)}
                 writer.write((json.dumps(out, default=float) + "\n")
@@ -116,10 +145,35 @@ async def serve_forever(server, host: str, port: int,
         finally:
             writer.close()
 
+    async def handle_metrics(reader, writer):
+        # minimal HTTP/1.1 responder: whatever the request line says,
+        # answer the metrics snapshot (a scrape endpoint, not a router)
+        try:
+            await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 5.0)
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                asyncio.LimitOverrunError):
+            pass
+        body = json.dumps(server.metrics_snapshot(),
+                          default=float).encode()
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/json\r\n"
+                     b"Content-Length: " + str(len(body)).encode() +
+                     b"\r\nConnection: close\r\n\r\n" + body)
+        await writer.drain()
+        writer.close()
+
     tcp = await asyncio.start_server(handle, host, port)
     bound = tcp.sockets[0].getsockname()[1]
-    print(json.dumps({"serving": True, "host": host, "port": bound,
-                      "models": server.plans.names()}), flush=True)
+    http = None
+    banner = {"serving": True, "host": host, "port": bound,
+              "models": server.plans.names()}
+    if metrics_port is not None:
+        http = await asyncio.start_server(handle_metrics, host,
+                                          metrics_port)
+        banner["metrics_port"] = http.sockets[0].getsockname()[1]
+        if metrics_ready_cb is not None:
+            metrics_ready_cb(banner["metrics_port"])
+    print(json.dumps(banner), flush=True)
     if ready_cb is not None:
         ready_cb(bound)
     try:
@@ -132,6 +186,9 @@ async def serve_forever(server, host: str, port: int,
     finally:
         tcp.close()
         await tcp.wait_closed()
+        if http is not None:
+            http.close()
+            await http.wait_closed()
         await server.shutdown()
     print(json.dumps({"served": answered["n"],
                       **server.describe()}, default=float), flush=True)
@@ -139,9 +196,11 @@ async def serve_forever(server, host: str, port: int,
 
 
 def run_serve(args) -> int:
+    from ..observability import persist_process_profiles, trace
     from ..serving.server import ServeConfig, ServingServer
     from ..utils.jax_setup import pin_platform_from_env
     pin_platform_from_env()
+    trace.configure_from_env()
     config = ServeConfig(
         max_wait_ms=args.max_wait_ms,
         target_batch=args.target_batch,
@@ -153,5 +212,14 @@ def run_serve(args) -> int:
     server = ServingServer(config)
     for name, path in _parse_models(args.model):
         server.add_model(name, path)
-    return asyncio.run(serve_forever(server, args.host, args.port,
-                                     max_requests=args.max_requests))
+    try:
+        return asyncio.run(serve_forever(
+            server, args.host, args.port,
+            max_requests=args.max_requests,
+            metrics_port=args.metrics_port))
+    finally:
+        trace.flush()
+        if os.environ.get("TX_PROFILE_PERSIST") == "1":
+            # fold this session's measured section/bucket costs into
+            # the persisted profile store (docs/observability.md)
+            persist_process_profiles()

@@ -65,6 +65,15 @@ RULES: Dict[str, tuple] = {
                       "async def under serving/ stalls the event loop "
                       "for every in-flight request — route blocking "
                       "work through an executor"),
+    "TX-O01": (ERROR, "telemetry/trace emission inside a jitted "
+                      "function body: telemetry.event/count, a span "
+                      "enter/exit, or a wall-clock read (time.time/"
+                      "perf_counter) runs at TRACE time, not run time "
+                      "— it records compilation, fires once per "
+                      "compile instead of once per call, and a "
+                      "changing value bakes into the trace "
+                      "(recompile); compile_time.section is the "
+                      "blessed trace-cost probe"),
     # -- resilience rules (selector/serving hot paths only) ----------------
     "TX-R01": (ERROR, "except Exception / bare except in a selector or "
                       "serving hot path swallows XlaRuntimeError "

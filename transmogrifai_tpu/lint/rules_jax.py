@@ -69,6 +69,16 @@ call) are caught here in milliseconds:
   in a coroutine wedges the coalescer for every tenant at once.
   Nested SYNC functions inside an async def are exempt — that is
   exactly the run_in_executor idiom.
+- TX-O01 telemetry/trace emission inside a jitted function body:
+  ``telemetry.event(...)``/``telemetry.count(...)``, a tracer span
+  enter/exit (``trace.span``/``add_span``/``add_event``), or a
+  wall-clock read (``time.time``/``perf_counter``/``monotonic``).
+  The body of a jitted function runs at TRACE time — such a call
+  records compilation (not execution), fires once per compile instead
+  of once per call, and a value derived from it baked into the trace
+  forces recompiles. ``compile_time.section`` is deliberately exempt:
+  measuring trace cost inside a traced body is its documented job
+  (plans/prepare.py per-stage sections).
 - TX-J08 implicit replication under ``shard_map``/``pjit``: the body
   function closes over an array-like value from the enclosing scope
   instead of receiving it through ``in_specs``. A closed-over operand
@@ -840,12 +850,55 @@ class _Visitor(ast.NodeVisitor):
                     ERROR,
                     hint="await asyncio.sleep(...) instead")
 
+    # -- TX-O01: telemetry/trace emission inside a jitted body -------------
+    _CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "time_ns",
+                    "perf_counter_ns", "monotonic_ns"}
+    _TELEMETRY_ATTRS = {"event", "count", "note_dispatch"}
+    _TRACER_ATTRS = {"span", "add_span", "add_event"}
+
+    def _check_traced_telemetry(self, node: ast.Call) -> None:
+        """Inside a jitted function the body executes once per TRACE:
+        a telemetry counter/event, a tracer span, or a wall-clock read
+        there records compile-time behavior as if it were run-time —
+        and a changing value baked into the trace recompiles. Emit
+        telemetry AROUND the dispatch, never inside the traced body.
+        (``compile_time.section`` is exempt: measuring trace cost
+        inside the body is exactly its job.)"""
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) \
+                or not isinstance(fn.value, ast.Name):
+            return
+        root, attr = fn.value.id, fn.attr
+        what = None
+        if root == "time" and attr in self._CLOCK_ATTRS:
+            what = (f"wall-clock read time.{attr}() — measures trace "
+                    f"time once per compile, not run time per call")
+        elif "telemetry" in root.lower() \
+                and attr in self._TELEMETRY_ATTRS:
+            what = (f"telemetry emission {root}.{attr}(...) — fires "
+                    f"once per COMPILE, not once per call")
+        elif root in ("trace", "_trace") and attr in self._TRACER_ATTRS:
+            what = (f"tracer call {root}.{attr}(...) — a span opened "
+                    f"inside a traced body records tracing, not "
+                    f"execution")
+        if what is not None:
+            self.add(
+                "TX-O01", node,
+                f"{what} (inside jitted {self.jit_fn_name!r})",
+                ERROR,
+                hint="move the telemetry/clock to the host code "
+                     "around the jitted call; compile_time.section is "
+                     "the blessed probe for trace-time cost")
+
     # -- calls -------------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:
         al = self.al
         # TX-J10: blocking calls inside serving async handlers --------------
         if self.serving and self.in_async:
             self._check_async_blocking(node)
+        # TX-O01: telemetry/trace/clock inside a jitted body ----------------
+        if self.jit_ctx is not None:
+            self._check_traced_telemetry(node)
         # TX-J08: shard_map/pjit closing over unsharded arrays --------------
         self._check_shard_closure(node)
         # TX-J09: host materialization in the train hot path ----------------

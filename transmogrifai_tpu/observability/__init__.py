@@ -1,0 +1,23 @@
+"""End-to-end observability: span tracing, live serving metrics, and
+the persisted performance-profile store (docs/observability.md).
+
+- :mod:`.trace` — Dapper-style spans across train / search / serve
+  with near-zero disabled cost (``TX_TRACE=1|/path.jsonl``), JSONL
+  export, Perfetto conversion.
+- :mod:`.metrics` — streaming per-tenant latency histograms + the
+  metrics-endpoint snapshot schema.
+- :mod:`.store` — the atomic-merge ``BENCH_STATE.json`` writer:
+  per-(stage, family, bucket) cost records and the bench probe
+  verdict, accumulated across runs for the telemetry-autotuning
+  roadmap item.
+"""
+from . import trace
+from .metrics import (METRICS_SCHEMA_VERSION, LatencyHistogram,
+                      ServeMetrics)
+from .store import (ProfileStore, default_store_path,
+                    gather_process_profiles, persist_process_profiles)
+
+__all__ = ["trace", "LatencyHistogram", "ServeMetrics",
+           "METRICS_SCHEMA_VERSION", "ProfileStore",
+           "default_store_path", "gather_process_profiles",
+           "persist_process_profiles"]

@@ -1,0 +1,182 @@
+"""Persisted performance-profile store: the queryable cost record the
+telemetry-autotuning roadmap item consumes.
+
+One atomic-merge JSON writer over the repo-level ``BENCH_STATE.json``
+(the only file that survives across bench rounds — /tmp does not): the
+bench's ambient-backend probe verdict (+ transcript), and the
+per-(stage, family, bucket) wall/compile/execute records that
+``utils/compile_time`` sections and the validator's family profile
+observe, all merge through the same read-modify-write (temp file +
+``os.replace``) so concurrent writers never tear the store and repeated
+runs ACCUMULATE cost history instead of overwriting it.
+
+Layout (top-level keys are independent namespaces)::
+
+    {
+      "probe":    {"<jax>-<platform>": {healthy, note, time,
+                                        transcript?}},
+      "profiles": {"score:b64":        {calls, wall_seconds,
+                                        compile_seconds,
+                                        execute_seconds, rows,
+                                        updated},
+                   "family:GBT":       {...},
+                   "prepare:seg:...":  {...}}
+    }
+
+``TX_PROFILE_STORE`` overrides the path (tests point it at a tmp dir).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+__all__ = ["ProfileStore", "default_store_path", "gather_process_profiles",
+           "persist_process_profiles"]
+
+#: accumulating numeric fields of one profile record; everything else
+#: (``updated``, foreign keys) overwrites on merge
+_ACCUMULATE = ("calls", "wall_seconds", "compile_seconds",
+               "execute_seconds", "rows")
+
+
+def default_store_path() -> str:
+    """``TX_PROFILE_STORE`` if set, else the repo-level
+    ``BENCH_STATE.json`` next to bench.py."""
+    env = os.environ.get("TX_PROFILE_STORE")
+    if env:
+        return env
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), "BENCH_STATE.json")
+
+
+class ProfileStore:
+    """Atomic read-merge-write over one JSON file. Every mutation is a
+    whole-file rewrite through a temp file + ``os.replace`` (the
+    save_model idiom) so a concurrent reader never sees a torn store
+    and a crashed writer leaves the previous state intact."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_store_path()
+
+    def load(self) -> dict:
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                d = json.load(fh)
+            return d if isinstance(d, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _write(self, state: dict) -> bool:
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(state, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            os.replace(tmp, self.path)
+            return True
+        except OSError:  # pragma: no cover - read-only checkout
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    # -- probe verdicts (bench ambient-backend health) ---------------------
+    def record_probe(self, key: str, healthy: bool, note: str,
+                     transcript: Optional[list] = None) -> bool:
+        """Merge one probe verdict under ``probe[key]`` — bench.py's
+        writer, now shared with the profile records (the ROADMAP
+        "hidden prerequisite": the probe's verdict AND its transcript
+        persist across rounds in the same store)."""
+        state = self.load()
+        verdict = {"healthy": bool(healthy), "note": str(note),
+                   "time": time.time()}
+        if transcript is not None:
+            verdict["transcript"] = list(transcript)
+        state.setdefault("probe", {})[key] = verdict
+        return self._write(state)
+
+    def probe_verdict(self, key: str) -> Optional[dict]:
+        return self.load().get("probe", {}).get(key)
+
+    # -- cost profiles -----------------------------------------------------
+    def record_profiles(self, records: Dict[str, dict]) -> bool:
+        """Accumulate ``{key: {calls, wall_seconds, compile_seconds,
+        execute_seconds, rows}}`` into ``profiles`` — numeric fields
+        SUM (repeated runs build history), ``updated`` stamps the last
+        contribution."""
+        if not records:
+            return True
+        state = self.load()
+        profiles = state.setdefault("profiles", {})
+        now = time.time()
+        for key, rec in records.items():
+            cur = profiles.setdefault(key, {})
+            for f in _ACCUMULATE:
+                if f in rec:
+                    total = round(float(cur.get(f, 0.0))
+                                  + float(rec[f] or 0.0), 6)
+                    cur[f] = int(total) if f in ("calls", "rows") \
+                        else total
+            cur["updated"] = now
+        return self._write(state)
+
+    def profiles(self, prefix: str = "") -> Dict[str, dict]:
+        return {k: dict(v) for k, v in
+                self.load().get("profiles", {}).items()
+                if k.startswith(prefix)}
+
+
+def gather_process_profiles() -> Dict[str, dict]:
+    """Everything this process has measured so far, keyed for the
+    store:
+
+    - ``utils/compile_time`` sections (``prepare:*`` fit/segment
+      labels, ``score:<plan>:b<bucket>`` dispatch labels — plan ids
+      are process-local, so bucket labels normalize to
+      ``score:b<bucket>``),
+    - the validator's per-family compile/wall profile
+      (``family:<Name>``).
+    """
+    from ..utils.compile_time import seconds_by_section
+    out: Dict[str, dict] = {}
+
+    def _acc(key: str, wall: float, compile_s: float, calls: int,
+             rows: int = 0) -> None:
+        rec = out.setdefault(key, {"calls": 0, "wall_seconds": 0.0,
+                                   "compile_seconds": 0.0,
+                                   "execute_seconds": 0.0, "rows": 0})
+        rec["calls"] += int(calls)
+        rec["wall_seconds"] += float(wall)
+        rec["compile_seconds"] += float(compile_s)
+        rec["execute_seconds"] += max(float(wall) - float(compile_s),
+                                      0.0)
+        rec["rows"] += int(rows)
+
+    for label, rec in seconds_by_section().items():
+        parts = label.split(":")
+        if len(parts) == 3 and parts[2].startswith("b") \
+                and parts[1].isdigit():
+            label = f"{parts[0]}:{parts[2]}"     # strip the plan id
+        _acc(label, rec["seconds"], rec["compile"], rec["calls"])
+
+    try:
+        from ..selector.validator import family_profile
+        for row in family_profile():
+            _acc(f"family:{row['family']}", row["seconds"],
+                 row["compileSeconds"], row["calls"])
+    except Exception:  # pragma: no cover - selector not imported yet
+        pass
+    return out
+
+
+def persist_process_profiles(path: Optional[str] = None
+                             ) -> Dict[str, dict]:
+    """Gather + merge this process's cost records into the store; the
+    bench modes call this after measuring, and a traced ``tx serve``
+    session calls it at shutdown. Returns what was merged."""
+    records = gather_process_profiles()
+    ProfileStore(path).record_profiles(records)
+    return records

@@ -12,13 +12,18 @@ quarantines land next to its stage profile.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+from collections import deque
 from typing import Dict, List, Tuple
+
+from ..observability import trace as _trace
 
 _log = logging.getLogger(__name__)
 
 __all__ = ["count", "counters", "reset", "note_dispatch", "dispatch_log",
-           "event", "events_mark", "events_since"]
+           "event", "events_mark", "events_since", "events_dropped",
+           "OVERFLOW_EVENT"]
 
 _LOCK = threading.Lock()
 _COUNTERS: Dict[str, int] = {}
@@ -27,7 +32,30 @@ _COUNTERS: Dict[str, int] = {}
 #: acceptance gate asserts over ("zero re-dispatch of journaled
 #: (family, cand, fold) entries")
 _DISPATCH_LOG: List[Tuple[str, str, Tuple[int, ...], int]] = []
-_EVENTS: List[dict] = []
+#: the event stream is a RING: a long-running `tx serve` process emits
+#: events forever, so the in-process list is bounded
+#: (``TX_TELEMETRY_EVENTS_CAP``, default 4096) — overflow drops the
+#: OLDEST events, counts them (``telemetry_events_dropped``), and
+#: ``events_since`` marks the gap with an explicit overflow record
+_EVENTS: "deque[dict]" = deque()
+#: absolute stream index of _EVENTS[0] (how many events were dropped
+#: off the front so far) — events_mark()/events_since() marks are
+#: absolute stream positions, so they stay valid across overflow
+_EVENTS_BASE = 0
+
+#: the synthetic record events_since() prepends when its mark fell off
+#: the ring
+OVERFLOW_EVENT = "telemetry_events_overflow"
+
+
+def _events_cap() -> int:
+    """Env-tunable ring capacity (re-read per event so tests and a
+    live process can retune without reimport)."""
+    try:
+        return max(16, int(os.environ.get("TX_TELEMETRY_EVENTS_CAP",
+                                          "4096")))
+    except ValueError:
+        return 4096
 
 
 def count(name: str, n: int = 1) -> None:
@@ -65,27 +93,58 @@ def dispatch_log() -> List[Tuple[str, str, Tuple[int, ...], int]]:
 def event(event_name: str, **fields) -> None:
     """Append one fault event (``retry`` / ``quarantine`` /
     ``journal_resume`` / ``plan_fallback`` / ...) and log it — the
-    runtime degrades LOUDLY, never silently."""
+    runtime degrades LOUDLY, never silently. With tracing enabled the
+    event ALSO attaches to the current span (observability/trace.py),
+    so a retry/quarantine lands inside the dispatch that suffered it."""
+    global _EVENTS_BASE
     rec = {"event": event_name, **fields}
     with _LOCK:
         _EVENTS.append(rec)
+        cap = _events_cap()
+        while len(_EVENTS) > cap:
+            _EVENTS.popleft()
+            _EVENTS_BASE += 1
+            _COUNTERS["telemetry_events_dropped"] = \
+                _COUNTERS.get("telemetry_events_dropped", 0) + 1
+    if _trace.enabled():
+        _trace.add_event(event_name, **fields)
     _log.warning("runtime: %s %s", event_name,
                  " ".join(f"{k}={v}" for k, v in fields.items()))
 
 
 def events_mark() -> int:
+    """Absolute position in the event stream (events emitted so far) —
+    stable across ring overflow."""
     with _LOCK:
-        return len(_EVENTS)
+        return _EVENTS_BASE + len(_EVENTS)
 
 
 def events_since(mark: int) -> List[dict]:
+    """Events from ``mark`` on. If the ring dropped events past the
+    mark, the FIRST returned record is an explicit
+    ``{"event": OVERFLOW_EVENT, "dropped": n}`` marker — consumers see
+    the gap instead of a silently shortened history."""
     with _LOCK:
-        return [dict(e) for e in _EVENTS[mark:]]
+        if mark >= _EVENTS_BASE:
+            start = mark - _EVENTS_BASE
+            return [dict(e) for e in list(_EVENTS)[start:]]
+        out: List[dict] = [{"event": OVERFLOW_EVENT,
+                            "dropped": _EVENTS_BASE - mark}]
+        out.extend(dict(e) for e in _EVENTS)
+        return out
+
+
+def events_dropped() -> int:
+    """Events lost to ring overflow so far in this process."""
+    with _LOCK:
+        return _COUNTERS.get("telemetry_events_dropped", 0)
 
 
 def reset() -> None:
     """Zero every accumulator (tests / bench isolation)."""
+    global _EVENTS_BASE
     with _LOCK:
         _COUNTERS.clear()
         _DISPATCH_LOG.clear()
         _EVENTS.clear()
+        _EVENTS_BASE = 0

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..models.base import Predictor, pad_cand_idx
+from ..observability import trace as _trace
 from ..runtime import telemetry as _telemetry
 from ..runtime.errors import BUG, classify_error
 from ..runtime.faults import maybe_inject
@@ -342,9 +343,17 @@ class RacingCrossValidation(CrossValidation):
                     lambda e=est, g=grid, a=alive: self._eval_rung_cands(
                         e, g, X_r, y_r, rung_masks, Xv_r, yv_r, spec,
                         a, shards)))
-            mats = self._dispatch_device_evals(
-                tasks, X_r, rung_masks, Xv_r, yv_r, spec, ctx=ctx,
-                rung=r, rung_label=f"rung{r}")
+            # one span per racing rung: the family dispatches below
+            # parent to it, so a trace shows rung -> family -> compile
+            # sections (docs/observability.md)
+            with _trace.span("search.rung", rung=r, final=final,
+                             folds=folds_r,
+                             budget=round(float(b), 4),
+                             families=len(fam_idx),
+                             alive=sum(len(a) for _, a in fam_idx)):
+                mats = self._dispatch_device_evals(
+                    tasks, X_r, rung_masks, Xv_r, yv_r, spec, ctx=ctx,
+                    rung=r, rung_label=f"rung{r}")
             n_evaluated = 0
             for (fi, alive), mm in zip(fam_idx, mats):
                 est, grid = models[fi]

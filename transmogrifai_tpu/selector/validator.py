@@ -59,6 +59,7 @@ def reset_family_profile() -> None:
 from ..evaluators.base import Evaluator
 from ..models.base import (FamilyPreconditionError,
                            PredictionModel, Predictor)
+from ..observability import trace as _trace
 from ..runtime import telemetry as _telemetry
 from ..runtime.context import RuntimeContext
 from ..runtime.errors import (AllFamiliesFailedError, BUG,
@@ -453,12 +454,25 @@ class _ValidatorBase:
                 rec["calls"] += 1
                 th.name = prev
 
+        # family spans run on pool worker threads where the context-var
+        # stack is empty: parent them explicitly to whatever span was
+        # open at dispatch time (the train root / rung span)
+        span_parent = _trace.current_ref()
+
         def run_task(name, key, cands, thunk):
+            with _trace.span("search.family", parent=span_parent,
+                             family=name, rung=rung_label,
+                             cands=len(cands), folds=folds):
+                return run_task_traced(name, key, cands, thunk)
+
+        def run_task_traced(name, key, cands, thunk):
             if ctx is not None:
                 cached = ctx.journal_lookup(key, rung_label, cands)
                 if cached is not None:
                     # journal stores per-candidate fold vectors; the
                     # dispatch contract is (folds, candidates)
+                    _trace.add_event("journal.replay", family=name,
+                                     rung=rung_label, cands=len(cands))
                     return np.asarray(cached, dtype=np.float64).T
 
             def attempt():
@@ -645,10 +659,17 @@ class _ValidatorBase:
         cands = tuple(range(len(grid)))
         cached = ctx.journal_lookup(key, "exact-host", cands)
         if cached is not None:
+            _trace.add_event("journal.replay",
+                             family=type(estimator).__name__,
+                             rung="exact-host", cands=len(cands))
             return self._results_from_journal(estimator, grid, cached)
         try:
-            host = self._family_host_results(estimator, grid, X, y,
-                                             masks, fold_data)
+            with _trace.span("search.family",
+                             family=type(estimator).__name__,
+                             rung="exact-host", path="host",
+                             cands=len(cands), folds=len(fold_data)):
+                host = self._family_host_results(estimator, grid, X, y,
+                                                 masks, fold_data)
         except Exception as e:
             kind = classify_error(e)
             if kind == BUG:

@@ -53,6 +53,7 @@ from ..plans.common import (DEFAULT_MAX_BUCKET, DEFAULT_MIN_BUCKET,
                             fallback_reason as _shared_fallback_reason,
                             pad_rows as _pad_rows, plan_seq,
                             record_compile)
+from ..observability import trace as _trace
 from ..runtime import telemetry as _telemetry
 from ..runtime.faults import maybe_inject
 from ..runtime.retry import RetryPolicy
@@ -460,6 +461,16 @@ class ScoringPlan:
                            columnar_admission: bool = True
                            ) -> GuardedScoreResult:
         """Core guarded path over a materialized raw Dataset."""
+        with _trace.span("score.guarded", rows=ds.n_rows):
+            return self._score_guarded_raw_inner(
+                ds, pre_reasons=pre_reasons,
+                columnar_admission=columnar_admission)
+
+    def _score_guarded_raw_inner(self, ds: Dataset,
+                                 pre_reasons: Optional[
+                                     List[GuardReason]] = None,
+                                 columnar_admission: bool = True
+                                 ) -> GuardedScoreResult:
         n = ds.n_rows
         quarantined: List[GuardReason] = list(pre_reasons or [])
         if self.guard is not None and columnar_admission:
@@ -573,6 +584,13 @@ class ScoringPlan:
         k's device program is still in flight (double-buffering)."""
         self.compile()
         n = ds.n_rows
+        with _trace.span("score.encode", rows=n):
+            return self._encode_raw_dataset_inner(ds, valid_mask)
+
+    def _encode_raw_dataset_inner(self, ds: Dataset,
+                                  valid_mask: Optional[np.ndarray]
+                                  ) -> EncodedScoreBatch:
+        n = ds.n_rows
         # phase "pre": numpy fallbacks feeding the device graph
         for step in self._steps:
             if step.phase == "pre":
@@ -605,14 +623,19 @@ class ScoringPlan:
         "post"-phase host fallbacks."""
         out_chunks: List[List[np.ndarray]] = [[] for _ in
                                               self._device_outputs]
-        for bucket, inputs, mask, rows in enc.chunks:
-            record_compile("score", (self._plan_id, bucket))
-            self._bucket_rows[bucket] = \
-                self._bucket_rows.get(bucket, 0) + rows
-            with _bucket_section("score", self._plan_id, bucket):
-                outs = self._dispatch_device(inputs, mask)
-            for i, o in enumerate(outs):
-                out_chunks[i].append(np.asarray(o)[:rows])
+        with _trace.span("score.dispatch", rows=enc.n_rows,
+                         chunks=len(enc.chunks)):
+            for bucket, inputs, mask, rows in enc.chunks:
+                record_compile("score", (self._plan_id, bucket))
+                self._bucket_rows[bucket] = \
+                    self._bucket_rows.get(bucket, 0) + rows
+                # the bucket section reports into the span as a child
+                # carrying the per-bucket compile/execute split
+                # (utils/compile_time section observer)
+                with _bucket_section("score", self._plan_id, bucket):
+                    outs = self._dispatch_device(inputs, mask)
+                for i, o in enumerate(outs):
+                    out_chunks[i].append(np.asarray(o)[:rows])
         return self._finish_score(enc.ds, out_chunks)
 
     def bucket_profile(self) -> Dict[int, dict]:

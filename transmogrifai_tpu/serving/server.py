@@ -59,8 +59,12 @@ from dataclasses import dataclass, field
 from typing import (Any, Callable, Dict, List, Optional, Sequence,
                     Tuple)
 
+import itertools
+
 import numpy as np
 
+from ..observability import trace as _trace
+from ..observability.metrics import ServeMetrics
 from ..runtime import telemetry as _telemetry
 from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
                     GuardReason, OutputGuard, SchemaGuard,
@@ -121,6 +125,10 @@ class _Request:
     record: dict
     future: asyncio.Future
     arrived: float
+    #: request id, generated at admission (or supplied by the TCP
+    #: client) and propagated enqueue -> coalesce -> encode -> dispatch
+    #: -> reply; the trace id of this request's span tree
+    rid: str = ""
 
 
 @dataclass
@@ -174,6 +182,8 @@ class PlanCache:
         self._entries: "collections.OrderedDict[Tuple, _CacheEntry]" = \
             collections.OrderedDict()
         self.evictions = 0
+        self.hits = 0
+        self.misses = 0
 
     def register(self, name: str, model_or_dir: Any) -> None:
         self._loaders[name] = model_or_dir
@@ -193,8 +203,10 @@ class PlanCache:
         entry = self._entries.get(key)
         if entry is not None:
             self._entries.move_to_end(key)
+            self.hits += 1
             _telemetry.count("serve_plan_cache_hits")
             return entry
+        self.misses += 1
         _telemetry.count("serve_plan_cache_misses")
         loader = self._loaders[name]
         if isinstance(loader, str):
@@ -247,6 +259,14 @@ class _PreparedBatch:
     ds: Any
     quarantined: List[GuardReason]
     qmask: np.ndarray
+    #: (model, tenant) lane + batch sequence number — span attributes
+    model: str = ""
+    tenant: str = ""
+    seq: int = 0
+    #: monotonic marks of the batch's pipeline stages
+    #: (encode_t0/encode_t1/guard_t0/guard_t1, fallback flag); the
+    #: request spans are reconstructed from these at resolve time
+    marks: Dict[str, float] = field(default_factory=dict)
     #: set when the per-batch deadline orphaned this batch's dispatch:
     #: the batch was already answered through the host fallback, so a
     #: hung device thread that eventually wakes must NOT run the
@@ -282,6 +302,11 @@ class ServingServer:
         self._fallback_pool = _cf.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tx-serve-fallback")
         self._dispatch_sem: Optional[asyncio.Semaphore] = None
+        #: live metrics (per-tenant latency histograms, answered/failed
+        #: counts) — served by the {"metrics": true} control request
+        #: and `tx serve --metrics-port` (docs/observability.md)
+        self.metrics = ServeMetrics()
+        self._batch_seq = itertools.count(1)
         #: float accumulators (occupancy/saturation; bench reads these)
         self.stats: Dict[str, float] = {
             "requests": 0, "batches": 0, "rows": 0,
@@ -308,6 +333,21 @@ class ServingServer:
         """Enqueue one record; resolves with the scored row dict (the
         ``ScoreFunction`` row contract — result features by name, plus
         a ``"_guard"`` reason list for quarantined/invalidated rows)."""
+        _rid, row = await self.score_with_id(record, model=model,
+                                             tenant=tenant)
+        return row
+
+    async def score_with_id(self, record: dict,
+                            model: Optional[str] = None,
+                            tenant: str = "default",
+                            rid: Optional[str] = None
+                            ) -> Tuple[str, dict]:
+        """:meth:`score_async` plus the request id: generated here at
+        ADMISSION (or supplied by the caller, e.g. the TCP protocol's
+        ``"id"`` field) and carried through coalesce -> encode ->
+        dispatch -> reply, so one request's wait/batch/device time is
+        attributable end to end. The TCP front end echoes it in every
+        response line (cli/serve.py)."""
         if not self._running:
             raise ServeRejected("serving loop is not running")
         name = model or self._default_model
@@ -321,7 +361,8 @@ class ServingServer:
                 f"limit ({self.config.queue_limit})")
         loop = asyncio.get_running_loop()
         req = _Request(record=record, future=loop.create_future(),
-                       arrived=time.monotonic())
+                       arrived=time.monotonic(),
+                       rid=rid or _trace.new_request_id())
         lane.queue.append(req)
         self.stats["requests"] += 1
         _telemetry.count("serve_requests")
@@ -329,7 +370,7 @@ class ServingServer:
             lane.wakeup.set()               # lane was idle: start timer
         if len(lane.queue) >= lane.target:
             lane.full.set()                 # bucket filled: fire early
-        return await req.future
+        return req.rid, await req.future
 
     def _lane(self, model_name: str, tenant: str) -> _Lane:
         key = (model_name, tenant)
@@ -434,6 +475,7 @@ class ServingServer:
         """Blocking host work: plan-cache lookup (may reload/recompile
         an evicted model), schema admission with per-row quarantine
         reasons, raw-Dataset boxing, and bucket encode/padding."""
+        marks = {"encode_t0": time.monotonic()}
         entry = self.plans.get(lane.model_name)
         guards = entry.guards.get(lane.tenant)
         if guards is None:
@@ -454,17 +496,26 @@ class ServingServer:
                 qmask[r.row] = True
         enc = entry.plan.encode_raw_dataset(
             ds, valid_mask=(~qmask).astype(np.float64))
+        marks["encode_t1"] = time.monotonic()
         return _PreparedBatch(entry=entry, guards=guards, requests=batch,
                               enc=enc, ds=ds, quarantined=quarantined,
-                              qmask=qmask)
+                              qmask=qmask, model=lane.model_name,
+                              tenant=lane.tenant,
+                              seq=next(self._batch_seq), marks=marks)
 
     # -- device dispatch + guarded resolution ------------------------------
     async def _dispatch_resolve(self, prep: _PreparedBatch) -> None:
         try:
             rows = await self._dispatch_guarded(prep)
+            now = time.monotonic()
             for req, row in zip(prep.requests, rows):
                 if not req.future.done():
                     req.future.set_result(row)
+            self.metrics.observe_batch(
+                prep.tenant,
+                [now - req.arrived for req in prep.requests])
+            if _trace.enabled():
+                self._emit_request_spans(prep, now)
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -472,14 +523,62 @@ class ServingServer:
             # fail the batch's requests with the recorded reason
             from ..runtime.errors import classify_error
             _telemetry.count("serve_batch_failures")
+            self.metrics.note_failure()
             _telemetry.event("serve_batch_failed",
                              kind=classify_error(e),
                              error=f"{type(e).__name__}: {e}")
+            if _trace.enabled():
+                self._emit_request_spans(prep, time.monotonic(),
+                                         error=f"{type(e).__name__}: "
+                                               f"{e}")
             for req in prep.requests:
                 if not req.future.done():
                     req.future.set_exception(e)
         finally:
             self._dispatch_sem.release()
+
+    def _emit_request_spans(self, prep: _PreparedBatch, resolved: float,
+                            error: Optional[str] = None) -> None:
+        """Reconstruct each request's span tree from the batch's
+        monotonic marks at resolve time: root ``serve.request`` (trace
+        id = request id) with CONTIGUOUS children wait / encode /
+        dispatch / guard, so >= 95% of the request wall-clock is
+        covered by child spans (the acceptance gate tests assert).
+        Retrospective emission keeps the hot path free of context
+        managers across async hops — the cost is a handful of dict
+        appends per request, paid only when tracing is on."""
+        m = prep.marks
+        enc0 = m.get("encode_t0")
+        enc1 = m.get("encode_t1", enc0)
+        guard0 = m.get("guard_t0", resolved)
+        attrs = {"model": prep.model, "tenant": prep.tenant,
+                 "batch": prep.seq, "batch_rows": len(prep.requests)}
+        if m.get("fallback"):
+            attrs["host_fallback"] = True
+        if error is not None:
+            attrs["status"], attrs["error"] = "error", error
+        for req in prep.requests:
+            root = _trace.add_span("serve.request", req.arrived,
+                                   resolved, trace_id=req.rid,
+                                   attrs=attrs)
+            parent = (req.rid, root)
+            if enc0 is None:
+                continue
+            _trace.add_span("serve.wait", req.arrived, enc0,
+                            parent=parent)
+            _trace.add_span("serve.encode", enc0, enc1, parent=parent)
+            _trace.add_span("serve.dispatch", enc1, guard0,
+                            parent=parent,
+                            attrs={"fallback": bool(m.get("fallback"))})
+            # guard runs from finish-stage start to RESOLUTION: the
+            # guard/boxing work plus the executor->loop handoff that
+            # delivers the reply — the four children partition the
+            # request's latency completely
+            _trace.add_span("serve.guard", guard0, resolved,
+                            parent=parent,
+                            attrs={"boxing_seconds": round(
+                                max(m.get("guard_t1", guard0) - guard0,
+                                    0.0), 6)})
 
     async def _dispatch_guarded(self, prep: _PreparedBatch
                                 ) -> List[dict]:
@@ -576,6 +675,8 @@ class ServingServer:
         row invalidation, sentinel observation, per-request row boxing
         (identical bookkeeping to ``ScoringPlan._score_guarded_raw``)."""
         from ..local.scoring import _unbox
+        prep.marks["guard_t0"] = time.monotonic()
+        prep.marks["fallback"] = used_fallback
         guards, names = prep.guards, prep.entry.result_names
         n, qmask = len(prep.requests), prep.qmask
         invalidated: List[GuardReason] = []
@@ -614,6 +715,7 @@ class ServingServer:
             if used_fallback:
                 row["_host_fallback"] = True
             rows.append(row)
+        prep.marks["guard_t1"] = time.monotonic()
         return rows
 
     def _orphan_device_pool(self) -> None:
@@ -710,6 +812,57 @@ class ServingServer:
                            "evictions": self.plans.evictions},
             "models": self.plans.names(),
             "lanes": sorted("/".join(k) for k in self._lanes),
+        }
+
+    def metrics_snapshot(self) -> dict:
+        """The LIVE metrics document (schema versioned,
+        docs/observability.md): loop counters, per-tenant latency
+        quantiles from the streaming histograms, per-lane queue depth,
+        plan-cache hits/evictions, per-tenant breaker state, and the
+        serving slice of the process telemetry counters. Answered by
+        the ``{"metrics": true}`` TCP control request and the
+        ``tx serve --metrics-port`` HTTP endpoint while the loop is
+        SERVING — no stop() required. Cheap enough for the event loop:
+        dict reads + fixed-bin quantile interpolation, no device work,
+        no I/O."""
+        from ..observability.metrics import METRICS_SCHEMA_VERSION
+        breakers = {}
+        for (name, _buckets), entry in list(self.plans._entries.items()):
+            for tenant, guards in list(entry.guards.items()):
+                if guards.breaker is not None:
+                    breakers[f"{name}/{tenant}"] = guards.breaker.state
+        serving_counters = {
+            k: v for k, v in _telemetry.counters().items()
+            if k.startswith(("serve_", "serving_", "breaker_",
+                             "drift_"))}
+        return {
+            "schema": METRICS_SCHEMA_VERSION,
+            "uptime_seconds": round(self.metrics.uptime_seconds(), 3),
+            "running": self._running,
+            "requests": int(self.stats["requests"]),
+            "answered": self.metrics.answered,
+            "failed_batches": self.metrics.failed,
+            "batches": int(self.stats["batches"]),
+            "rows": int(self.stats["rows"]),
+            "mean_batch_occupancy": round(
+                self.stats["rows"] / (self.stats["batches"] or 1), 3),
+            "full_dispatches": int(self.stats["full_dispatches"]),
+            "deadline_dispatches": int(
+                self.stats["deadline_dispatches"]),
+            "orphaned_dispatches": int(
+                self.stats["orphaned_dispatches"]),
+            "queue_depth": {"/".join(k): len(lane.queue)
+                            for k, lane in sorted(self._lanes.items())},
+            "latency_ms": self.metrics.latency_json(),
+            "plan_cache": {"budget": self.plans.budget,
+                           "resident": len(self.plans._entries),
+                           "hits": self.plans.hits,
+                           "misses": self.plans.misses,
+                           "evictions": self.plans.evictions},
+            "breakers": breakers,
+            "counters": serving_counters,
+            "trace": {"enabled": _trace.enabled(),
+                      "path": _trace.trace_path()},
         }
 
 

@@ -38,7 +38,8 @@ from contextlib import contextmanager
 from typing import Dict
 
 __all__ = ["install", "compile_seconds", "compile_seconds_by_thread",
-           "section", "seconds_by_section", "reset_sections"]
+           "section", "seconds_by_section", "reset_sections",
+           "set_section_observer"]
 
 _LOCK = threading.Lock()
 _TOTAL = {"seconds": 0.0}
@@ -47,6 +48,16 @@ _BY_THREAD: Dict[str, float] = defaultdict(float)
 _SECTIONS: Dict[str, Dict[str, float]] = {}
 _STATE = {"installed": False, "available": False}
 _SECTION_STACK = threading.local()
+#: optional callback ``(label, wall_seconds, compile_seconds)`` fired
+#: as each section CLOSES — how the span tracer
+#: (observability/trace.py) attaches a section's compile/execute split
+#: to the enclosing span. None (the default) costs nothing.
+_SECTION_OBSERVER = {"fn": None}
+
+
+def set_section_observer(fn) -> None:
+    """Register (or clear, with None) the section-close observer."""
+    _SECTION_OBSERVER["fn"] = fn
 
 
 def _stack():
@@ -113,6 +124,11 @@ def section(label: str):
     install()
     st = _stack()
     st.append(label)
+    observer = _SECTION_OBSERVER["fn"]
+    if observer is not None:
+        with _LOCK:
+            prev = _SECTIONS.get(label)
+            compile_before = prev["compile"] if prev else 0.0
     t0 = time.perf_counter()
     try:
         yield
@@ -124,6 +140,13 @@ def section(label: str):
                 label, {"seconds": 0.0, "compile": 0.0, "calls": 0})
             rec["seconds"] += wall
             rec["calls"] += 1
+            compile_after = rec["compile"]
+        if observer is not None:
+            # per-invocation compile share: this label's event seconds
+            # accumulated while the span was open (approximate under
+            # concurrent same-label sections; exact single-threaded)
+            observer(label, wall, max(compile_after - compile_before,
+                                      0.0))
 
 
 def seconds_by_section(prefix: str = "") -> Dict[str, Dict[str, float]]:

@@ -17,6 +17,7 @@ Spark design:
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -450,8 +451,14 @@ class Workflow:
         if self._workflow_cv:
             prefitted = self._find_best_with_workflow_cv(result_features, ds)
         listener = getattr(self, "_listener", None)
-        train_ds, fitted = self._prepare(result_features, ds, listener,
-                                         prefitted)
+        # the train root span: prepare segments, family dispatches,
+        # racing rungs and journal replays all nest under it
+        # (docs/observability.md; off-by-default, TX_TRACE enables)
+        from ..observability import trace as _trace
+        with _trace.span("train", rows=ds.n_rows,
+                         prepare=os.environ.get("TX_PREPARE", "plan")):
+            train_ds, fitted = self._prepare(result_features, ds,
+                                             listener, prefitted)
         result = tuple(f.copy_with_new_stages(fitted)
                        for f in result_features)
         if listener is not None:
