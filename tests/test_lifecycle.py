@@ -1,0 +1,385 @@
+"""Self-healing serving tests (serving/lifecycle.py + runtime/refit.py).
+
+The acceptance drills, in the ISSUE's words:
+
+- END-TO-END: a drifted stream trips the sentinel to degrade, a
+  background journal-warm retrain produces a candidate, the canary
+  passes, and the PlanCache entry hot-swaps atomically — with
+  ``requests_dropped == 0`` across the whole episode and ZERO
+  steady-state recompiles after the pre-warm; a non-drifted tenant on
+  the same model keeps the ORIGINAL entry object and stays bitwise
+  stable.
+- ROLLBACK: a ``TX_FAULT_PLAN`` post-swap fault restores the previous
+  model instantly, with counters and spans asserting every transition.
+- FAILURE ISOLATION: a retrain OOM (retries exhausted) quarantines the
+  lane and the old model keeps serving; a canary fault rejects the
+  candidate without touching the serving path.
+- OFF BY DEFAULT: without ``lifecycle`` config the server carries no
+  manager, the snapshot slice is None, ``register_refit`` refuses.
+
+Everything here must stay tier-1-safe on a 1-CPU container: one small
+trained model per module, its refits reuse the same tiny dataset.
+"""
+import collections
+import os
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.observability import trace
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.runtime import FaultInjector, telemetry
+from transmogrifai_tpu.runtime.context import RuntimeContext
+from transmogrifai_tpu.runtime.refit import (RefitUnavailableError,
+                                             labeled_rows,
+                                             rebuild_training_workflow,
+                                             run_refit)
+from transmogrifai_tpu.runtime.retry import RetryPolicy
+from transmogrifai_tpu.serving import (DriftThresholds, LifecycleConfig,
+                                       ScoringPlan, ServeConfig,
+                                       plan_compiles, serve_in_process)
+from transmogrifai_tpu.serving.lifecycle import ST_IDLE
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _records(n=160, seed=5, shift=0.0):
+    rng = np.random.default_rng(seed)
+    cats = ["a", "b", "c"]
+    recs = []
+    for _ in range(n):
+        x = float(rng.normal()) + shift
+        z = float(rng.uniform(0, 4))
+        recs.append({"x": x, "z": z,
+                     "cat": cats[int(rng.integers(0, len(cats)))],
+                     "label": float(x - shift + 0.5 * rng.normal() > 0)})
+    return recs
+
+
+@pytest.fixture(scope="module")
+def trained():
+    recs = _records()
+    x = FeatureBuilder.of("x", Real).extract(
+        lambda r: r.get("x")).as_predictor()
+    z = FeatureBuilder.of("z", RealNN).extract(
+        lambda r: r.get("z")).as_predictor()
+    cat = FeatureBuilder.of("cat", PickList).extract(
+        lambda r: r.get("cat")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        label, transmogrify([x, z, cat])).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(recs).train(validate="off"))
+    return model, recs, pred.name
+
+
+def _drill_config(**overrides):
+    """Aggressive thresholds + small batches so a drill converges in
+    tier-1 time: degrade after ~24 drifted rows, short watch window,
+    no cooldown interference inside one phase."""
+    lc = LifecycleConfig(
+        retrain_budget_seconds=90.0, canary_rows=48,
+        metric_slack=0.30, watch_batches=2, cooldown_seconds=300.0,
+        **overrides)
+    # degrade=0.5: the injected covariate shift (x += 5) lands at
+    # JS ~= 1.0, while small-sample noise between two windows of the
+    # SAME distribution stays ~0.15 — so the post-swap watch does not
+    # false-trigger a rollback on its own fresh sentinel
+    return ServeConfig(
+        max_wait_ms=5.0, max_batch=32, sentinel=True,
+        drift_thresholds=DriftThresholds(warn=0.2, degrade=0.5,
+                                         min_rows=24),
+        lifecycle=lc)
+
+
+def _pump(client, recs, tenant="a", n=16):
+    rows = client.score_many([dict(r) for r in recs[:n]], tenant=tenant)
+    return rows
+
+
+def _wait_counter(name, minimum=1, deadline=120.0, tick=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        if telemetry.counters().get(name, 0) >= minimum:
+            return True
+        if tick is not None:
+            tick()
+        time.sleep(0.05)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# refit bridge (runtime/refit.py)
+# ---------------------------------------------------------------------------
+
+class TestRefit:
+    def test_rebuild_and_retrain_generically(self, trained):
+        model, recs, pred = trained
+        wf = rebuild_training_workflow(model)
+        fresh = wf.set_input_records(
+            [dict(r) for r in recs]).train(validate="off")
+        scored = fresh.score([dict(r) for r in recs[:16]])
+        assert pred in scored and scored.n_rows == 16
+
+    def test_labeled_rows_filters_unlabeled(self, trained):
+        model, recs, _ = trained
+        half = [dict(r) for r in recs[:8]]
+        for r in half[:4]:
+            r.pop("label")
+        assert len(labeled_rows(model, half)) == 4
+
+    def test_no_labeled_rows_is_refit_unavailable(self, trained):
+        model, recs, _ = trained
+        bare = [{k: v for k, v in r.items() if k != "label"}
+                for r in recs[:8]]
+        with pytest.raises(RefitUnavailableError, match="no labeled"):
+            run_refit(model, bare, name="m")
+
+    def test_run_refit_stamps_generation(self, trained):
+        model, recs, _ = trained
+        result = run_refit(model, [dict(r) for r in recs[:64]],
+                           name="m", generation=7,
+                           retry=RetryPolicy(max_attempts=1))
+        assert result.model.trained_generation == 7
+        assert result.rows == 64 and result.seconds > 0.0
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 drill: detect -> retrain -> canary -> swap -> commit,
+# then a second cycle rolled back by an injected post-swap fault
+# ---------------------------------------------------------------------------
+
+class TestSelfHealDrill:
+    def test_end_to_end_heal_then_fault_rollback(self, trained):
+        model, recs, pred = trained
+        drifted = _records(n=96, seed=11, shift=5.0)
+        server, client = serve_in_process({"m": model}, _drill_config())
+        trace.configure(True)
+        answered = [0]
+
+        def score(batch, tenant="a"):
+            rows = client.score_many(batch, tenant=tenant)
+            for row in rows:
+                assert pred in row, f"dropped/failed request: {row}"
+            answered[0] += len(rows)
+            return rows
+
+        try:
+            entry0 = server.plans.get("m")
+            warm = [dict(r) for r in recs[:32]]
+            for size in (8, 16, 32):
+                entry0.plan.score(warm[:size])
+            baseline_b = score([dict(r) for r in recs[:16]],
+                               tenant="b")
+
+            # phase 1: drifted stream for tenant a -> degrade -> heal
+            i = [0]
+
+            def drift_tick():
+                batch = [dict(r) for r in
+                         (drifted * 4)[i[0]:i[0] + 16]]
+                i[0] += 16
+                if i[0] >= len(drifted) * 4 - 16:
+                    i[0] = 0
+                score(batch)
+
+            drift_tick()
+            drift_tick()
+            assert _wait_counter("lifecycle_detect", tick=drift_tick), \
+                "sentinel never armed the lifecycle"
+            assert _wait_counter("lifecycle_swaps", tick=drift_tick), \
+                "heal cycle never swapped"
+            c_after_swap = plan_compiles()
+            assert _wait_counter("lifecycle_commits", tick=drift_tick), \
+                "post-swap watch never committed"
+
+            # zero steady-state recompiles after the pre-warm
+            drift_tick()
+            drift_tick()
+            assert plan_compiles() == c_after_swap
+
+            counters = telemetry.counters()
+            for c in ("lifecycle_detect", "lifecycle_retrain_started",
+                      "lifecycle_retrain_completed",
+                      "lifecycle_canary_pass", "lifecycle_swaps",
+                      "lifecycle_commits"):
+                assert counters.get(c, 0) >= 1, c
+            assert counters.get("lifecycle_rollbacks", 0) == 0
+            span_names = {s["name"] for s in trace.spans()}
+            assert {"lifecycle.retrain", "lifecycle.canary",
+                    "lifecycle.swap"} <= span_names
+
+            # the drifted tenant serves the swapped entry; tenant b
+            # (and the shared cache) keep the ORIGINAL object
+            assert server.plans.entry_for("m", "a") is not entry0
+            assert server.plans.entry_for("m", "b") is entry0
+            assert server.plans.get("m") is entry0
+            new_model = server.plans.entry_for("m", "a").model
+            assert new_model.trained_generation >= 1
+            rows_b = score([dict(r) for r in recs[:16]], tenant="b")
+            for row0, row1 in zip(baseline_b, rows_b):
+                assert row0[pred] == row1[pred]
+
+            # the metrics endpoint surfaces sentinel + lifecycle state
+            snap = server.metrics_snapshot()
+            assert snap["lifecycle"]["states"].get("m/a") == ST_IDLE
+            assert "m/a" in snap["sentinels"]
+            assert snap["sentinels"]["m/a"]["rowsSeen"] > 0
+
+            # phase 2: drift AGAIN (the fresh sentinel fingerprinted
+            # the shifted window, so the ORIGINAL distribution now
+            # reads as drift) with a post-swap fault armed -> rollback
+            healed = server.plans.entry_for("m", "a")
+            server.lifecycle._cooldown_until.clear()
+            mark = telemetry.events_mark()
+            j = [0]
+
+            def revert_tick():
+                batch = [dict(r) for r in (recs * 4)[j[0]:j[0] + 16]]
+                j[0] += 16
+                if j[0] >= len(recs) * 4 - 16:
+                    j[0] = 0
+                score(batch)
+
+            with FaultInjector.plan("lifecycle:m:postswap:1=bug"):
+                assert _wait_counter("lifecycle_swaps", minimum=2,
+                                     tick=revert_tick), \
+                    "second heal cycle never swapped"
+                assert _wait_counter("lifecycle_rollbacks",
+                                     tick=revert_tick), \
+                    "post-swap fault never rolled back"
+            # the pinned previous entry came back, instantly
+            assert server.plans.entry_for("m", "a") is healed
+            ev = [e for e in telemetry.events_since(mark)
+                  if e["event"] == "lifecycle"
+                  and e.get("phase") == "rollback"]
+            assert ev and "InjectedFamilyBug" in ev[0]["reason"]
+            assert ev[0]["restored"] is True
+            assert any(s["name"] == "lifecycle.rollback"
+                       for s in trace.spans())
+            # traffic kept flowing through the whole double episode
+            score([dict(r) for r in recs[:16]])
+            assert answered[0] >= 100
+            assert server.describe()["requests"] == answered[0]
+        finally:
+            trace.configure(False)
+            trace.reset()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# failure-path drills (driven through the worker entry point directly —
+# no serving traffic needed to prove the classification)
+# ---------------------------------------------------------------------------
+
+class TestFailurePaths:
+    def _armed(self, trained, **overrides):
+        model, recs, _ = trained
+        server, client = serve_in_process({"m": model},
+                                          _drill_config(**overrides))
+        lc = server.lifecycle
+        lc.runtime = RuntimeContext(
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01,
+                              max_delay=0.02))
+        key = ("m", "a")
+        lc._rings[key] = collections.deque(
+            [dict(r) for r in recs[:32]], maxlen=48)
+        return server, lc, key
+
+    def test_retrain_oom_quarantines_and_keeps_old_model(
+            self, trained):
+        server, lc, key = self._armed(trained)
+        entry0 = server.plans.get("m")
+        try:
+            with FaultInjector.plan("lifecycle:m:retrain:*=oom"):
+                lc._heal(key, entry0, gen=1)
+            counters = telemetry.counters()
+            assert counters.get("lifecycle_retrain_failures", 0) == 1
+            assert counters.get("lifecycle_swaps", 0) == 0
+            assert "m/a" in lc.runtime.quarantined_families()
+            assert server.plans.entry_for("m", "a") is entry0
+            assert lc._states[key] == ST_IDLE
+            snap = server.metrics_snapshot()
+            assert "m/a" in snap["lifecycle"]["quarantined"]
+        finally:
+            server.stop()
+
+    def test_canary_fault_rejects_candidate(self, trained):
+        server, lc, key = self._armed(trained)
+        entry0 = server.plans.get("m")
+        try:
+            with FaultInjector.plan("lifecycle:m:canary:1=bug"):
+                lc._heal(key, entry0, gen=1)
+            counters = telemetry.counters()
+            assert counters.get("lifecycle_retrain_completed", 0) == 1
+            assert counters.get("lifecycle_canary_fail", 0) == 1
+            assert counters.get("lifecycle_swaps", 0) == 0
+            assert server.plans.entry_for("m", "a") is entry0
+            assert lc._states[key] == ST_IDLE
+        finally:
+            server.stop()
+
+    def test_canary_rejects_empty_ring(self, trained):
+        server, lc, key = self._armed(trained)
+        entry0 = server.plans.get("m")
+        verdict = lc._canary("m", entry0, entry0.model, [])
+        server.stop()
+        assert verdict["pass"] is False
+        assert "empty" in verdict["reason"]
+
+    def test_canary_passes_identical_model(self, trained):
+        model, recs, _ = trained
+        server, lc, key = self._armed(trained)
+        entry0 = server.plans.get("m")
+        verdict = lc._canary("m", entry0, model,
+                             [dict(r) for r in recs[:24]])
+        server.stop()
+        assert verdict["pass"] is True
+        assert verdict["new_metric"] == verdict["old_metric"]
+
+
+# ---------------------------------------------------------------------------
+# off-by-default + config validation
+# ---------------------------------------------------------------------------
+
+class TestOffByDefault:
+    def test_no_lifecycle_config_means_no_manager(self, trained):
+        model, recs, _ = trained
+        server, client = serve_in_process(
+            {"m": model}, ServeConfig(max_wait_ms=5.0, sentinel=False))
+        try:
+            assert server.lifecycle is None
+            assert server.metrics_snapshot()["lifecycle"] is None
+            with pytest.raises(ValueError, match="lifecycle"):
+                server.register_refit("m")
+        finally:
+            server.stop()
+
+    def test_swap_policy_validated(self):
+        with pytest.raises(ValueError, match="swap_policy"):
+            LifecycleConfig(swap_policy="global")
+
+    def test_register_refit_round_trip(self, trained):
+        model, recs, _ = trained
+        server, _client = serve_in_process({"m": model},
+                                           _drill_config())
+        try:
+            server.register_refit("m", base_records=recs[:8])
+            spec = server.lifecycle.spec_for("m")
+            assert len(spec.base_records) == 8
+            # unregistered models fall back to the config defaults
+            assert server.lifecycle.spec_for("other").base_records \
+                is None
+        finally:
+            server.stop()

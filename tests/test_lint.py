@@ -1060,6 +1060,89 @@ class TestR02SilentRecordDrop:
         assert "TX-R02" not in _rules(findings)
 
 
+class TestR03LiveSwapMutation:
+    """TX-R03: serving-path code must not mutate a live PlanCache entry
+    or plan registry in place — hot model changes go through the atomic
+    swap_entry/rollback/commit helpers (docs/self_healing.md)."""
+
+    SRV = "transmogrifai_tpu/serving/mylifecycle.py"
+
+    def _lint(self, code, path=None):
+        return lint_source(textwrap.dedent(code), path or self.SRV)
+
+    def test_entry_attribute_store_flagged(self):
+        findings = self._lint("""
+            def hot_patch(cache, name, new_plan):
+                entry = cache.get(name)
+                entry.plan = new_plan
+        """)
+        assert "TX-R03" in _rules(findings)
+        f = [x for x in findings if x.rule_id == "TX-R03"][0]
+        assert f.severity == "error"
+        assert "swap_entry" in (f.hint or "")
+
+    def test_entry_model_store_flagged(self):
+        findings = self._lint("""
+            def hot_patch(entry, candidate):
+                entry.model = candidate
+        """)
+        assert "TX-R03" in _rules(findings)
+
+    def test_registry_subscript_store_flagged(self):
+        findings = self._lint("""
+            def hot_patch(cache, key, entry):
+                cache._entries[key] = entry
+        """)
+        assert "TX-R03" in _rules(findings)
+
+    def test_registry_subscript_delete_flagged(self):
+        findings = self._lint("""
+            def evict(cache, key):
+                del cache._overrides[key]
+        """)
+        assert "TX-R03" in _rules(findings)
+
+    def test_self_stores_are_legal(self):
+        # the owning object's own methods (PlanCache itself, entry
+        # construction) are the blessed implementation
+        findings = self._lint("""
+            class PlanCache:
+                def swap_entry(self, key, entry):
+                    self._entries[key] = entry
+
+                def _set(self, plan):
+                    self.plan = plan
+        """)
+        assert "TX-R03" not in _rules(findings)
+
+    def test_atomic_helper_call_is_legal(self):
+        findings = self._lint("""
+            def heal(server, name, entry, tenant):
+                server.plans.swap_entry(name, entry, tenant=tenant)
+        """)
+        assert "TX-R03" not in _rules(findings)
+
+    def test_outside_serving_is_silent(self):
+        findings = self._lint("""
+            def rebuild(cache, key, entry):
+                cache._entries[key] = entry
+                entry.plan = None
+        """, path="transmogrifai_tpu/selector/journal.py")
+        assert "TX-R03" not in _rules(findings)
+
+    def test_inline_suppression(self, tmp_path):
+        # suppression is applied by the engine on real files; the path
+        # must have a "serving" segment for the rule to arm at all
+        d = tmp_path / "serving"
+        d.mkdir()
+        p = d / "patch.py"
+        p.write_text("def hot_patch(entry, new_plan):\n"
+                     "    entry.plan = new_plan"
+                     "  # tx-lint: disable=TX-R03\n")
+        findings, _ = lint_paths([str(p)])
+        assert findings == []
+
+
 class TestJ08ShardClosure:
     """TX-J08: a shard_map/pjit body closing over an array-like value
     gets implicit full replication — arrays must enter through

@@ -40,7 +40,11 @@ from transmogrifai_tpu.serving.guard import (REASON_EXTRA_FIELD,
                                              REASON_PROBABILITY_RANGE,
                                              REASON_WRONG_TYPE)
 from transmogrifai_tpu.serving.sentinel import (DRIFT_FINGERPRINTS_FILE,
-                                                load_fingerprints)
+                                                FINGERPRINT_SCHEMA,
+                                                FingerprintSchemaError,
+                                                load_fingerprint_doc,
+                                                load_fingerprints,
+                                                save_fingerprints)
 from transmogrifai_tpu.types import PickList, Real, RealNN
 from transmogrifai_tpu.workflow import Workflow
 
@@ -400,6 +404,79 @@ class TestCircuitBreaker:
 
 def _shifted(recs, dx):
     return [{**r, "x": (r["x"] or 0.0) + dx} for r in recs]
+
+
+class TestFingerprintSchema:
+    """Versioned fingerprints: ``drift-fingerprints.json`` carries a
+    schema id + the ``trained_at`` generation; a mismatched schema is a
+    LOUD error, never a silent fallback to stale comparisons."""
+
+    def _saved(self, trained, tmp_path):
+        model, recs, _ = trained
+        mdir = str(tmp_path / "m")
+        model.save(mdir)
+        return model, mdir, os.path.join(mdir, DRIFT_FINGERPRINTS_FILE)
+
+    def test_save_stamps_schema_and_generation(self, trained,
+                                               tmp_path):
+        _model, mdir, path = self._saved(trained, tmp_path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["schema"] == FINGERPRINT_SCHEMA
+        assert doc["trainedAt"] == 0
+        fps, meta = load_fingerprint_doc(mdir)
+        assert meta == {"schema": FINGERPRINT_SCHEMA, "trainedAt": 0}
+        assert {fp.name for fp in fps} == {"x", "z", "cat"}
+
+    def test_trained_at_round_trips(self, trained, tmp_path):
+        _model, mdir, _path = self._saved(trained, tmp_path)
+        fps, _meta = load_fingerprint_doc(mdir)
+        save_fingerprints(fps, mdir, trained_at=3)
+        _fps, meta = load_fingerprint_doc(mdir)
+        assert meta["trainedAt"] == 3
+        sentinel = DriftSentinel.for_model(
+            type("M", (), {"model_dir": mdir})())
+        assert sentinel.generation == 3
+
+    def test_mismatched_schema_is_a_clear_error(self, trained,
+                                                tmp_path):
+        _model, mdir, path = self._saved(trained, tmp_path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        doc["schema"] = "tx-drift-fingerprints/999"
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        with pytest.raises(FingerprintSchemaError,
+                           match="refusing to compare"):
+            load_fingerprints(mdir)
+
+    def test_for_model_does_not_swallow_schema_error(self, trained,
+                                                     tmp_path):
+        model, mdir, path = self._saved(trained, tmp_path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        doc["schema"] = "somebody-elses-format/7"
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        from transmogrifai_tpu.workflow import WorkflowModel
+        loaded = WorkflowModel.load(mdir)
+        # a missing file falls back quietly; an INCOMPATIBLE file must
+        # not — the operator gets the error, not a stale comparison
+        with pytest.raises(FingerprintSchemaError):
+            DriftSentinel.for_model(loaded)
+
+    def test_legacy_document_without_schema_loads(self, trained,
+                                                  tmp_path):
+        _model, mdir, path = self._saved(trained, tmp_path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        del doc["schema"]
+        del doc["trainedAt"]
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        fps, meta = load_fingerprint_doc(mdir)
+        assert meta["trainedAt"] == 0
+        assert {fp.name for fp in fps} == {"x", "z", "cat"}
 
 
 class TestDriftSentinel:

@@ -202,6 +202,44 @@ class TestServerSmoke:
         finally:
             server.stop()
 
+    def test_multi_tenant_sentinel_isolation(self, trained):
+        """One drifted tenant escalates; a second tenant on the SAME
+        model keeps its own healthy sentinel and bitwise-stable
+        results (docs/self_healing.md — the detection contract the
+        lifecycle manager arms on)."""
+        from transmogrifai_tpu.serving import DriftThresholds
+        model, recs, pred = trained
+        server, client = serve_in_process(
+            {"m": model},
+            ServeConfig(max_wait_ms=10.0,
+                        drift_thresholds=DriftThresholds(
+                            warn=0.3, degrade=0.5, min_rows=24)))
+        try:
+            _warm_buckets(server, "m", recs, up_to=64)
+            normal = [dict(r) for r in recs[:32]]
+            rng = np.random.default_rng(11)
+            drifted = [{"x": float(rng.normal() + 5.0),
+                        "z": float(rng.uniform(0, 4)),
+                        "cat": "a", "label": 1.0} for _ in range(64)]
+            base_b = client.score_many(normal, tenant="b")
+            client.score_many(drifted, tenant="a")
+            again_b = client.score_many(normal, tenant="b")
+            guards = server.plans.get("m").guards
+            assert guards["a"].sentinel.drift_report()["status"] \
+                == "degrade"
+            assert guards["b"].sentinel.drift_report()["status"] == "ok"
+            # the healthy tenant's results never moved
+            for r0, r1 in zip(base_b, again_b):
+                assert r0[pred] == r1[pred]
+            # the metrics endpoint splits the two lanes
+            snap = server.metrics_snapshot()
+            assert snap["sentinels"]["m/a"]["status"] == "degrade"
+            assert snap["sentinels"]["m/b"]["status"] == "ok"
+            assert snap["sentinels"]["m/a"]["features"]["x"][
+                "status"] == "degrade"
+        finally:
+            server.stop()
+
     def test_unknown_model_rejected(self, trained):
         model, recs, _ = trained
         server, client = serve_in_process({"m": model}, ServeConfig())
@@ -441,3 +479,101 @@ class TestServeTcp:
         assert outs[0]["ok"] and outs[1]["ok"]
         assert "prediction" in outs[0]["result"][pred]
         assert not outs[2]["ok"] and "unknown model" in outs[2]["error"]
+
+
+class TestTcpClient:
+    """serving/client.py: the reconnecting line-JSON client — bounded
+    exponential backoff via runtime RetryPolicy, resend on transport
+    failure, no retry of application errors."""
+
+    RETRY = None  # set in _retry() to avoid import-time work
+
+    def _retry(self):
+        from transmogrifai_tpu.runtime.retry import RetryPolicy
+        return RetryPolicy(max_attempts=3, base_delay=0.01,
+                           max_delay=0.02)
+
+    def test_unreachable_raises_serving_unavailable(self):
+        import socket
+        from transmogrifai_tpu.serving import (ServingUnavailable,
+                                               TcpServingClient)
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()                      # nothing listens here now
+        client = TcpServingClient("127.0.0.1", port,
+                                  retry=self._retry(), timeout=0.5)
+        with pytest.raises(ServingUnavailable, match="unreachable"):
+            client.connect()
+
+    def test_reconnects_and_resends_after_server_drop(self):
+        import socket
+        import threading
+        from transmogrifai_tpu.serving import TcpServingClient
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        port = srv.getsockname()[1]
+        seen = []
+
+        def run():
+            # connection 1: read the request, then DROP it (restart)
+            conn, _ = srv.accept()
+            seen.append(conn.makefile("r").readline())
+            conn.close()
+            # connection 2: answer properly
+            conn, _ = srv.accept()
+            fh = conn.makefile("rw")
+            seen.append(fh.readline())
+            fh.write(json.dumps({"ok": True, "result": {"y": 1}})
+                     + "\n")
+            fh.flush()
+            conn.close()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        try:
+            with TcpServingClient("127.0.0.1", port,
+                                  retry=self._retry()) as client:
+                out = client.request({"record": {"x": 1.0}})
+            assert out == {"ok": True, "result": {"y": 1}}
+            t.join(timeout=5)
+            # the SAME payload was resent on the fresh connection
+            assert len(seen) == 2 and seen[0] == seen[1]
+            assert telemetry.counters()[
+                "serve_client_reconnects"] >= 1
+        finally:
+            srv.close()
+
+    def test_scores_against_the_real_loop(self, trained):
+        import threading
+        from transmogrifai_tpu.cli.serve import serve_forever
+        from transmogrifai_tpu.serving import TcpServingClient
+        model, recs, pred = trained
+        server = ServingServer(
+            ServeConfig(max_wait_ms=5.0, sentinel=False))
+        server.add_model("m", model)
+        port_box = {}
+
+        def run():
+            asyncio.run(serve_forever(
+                server, "127.0.0.1", 0, max_requests=3,
+                ready_cb=lambda p: port_box.setdefault("p", p)))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        while "p" not in port_box:
+            time.sleep(0.005)
+        with TcpServingClient("127.0.0.1", port_box["p"],
+                              retry=self._retry()) as client:
+            out = client.score(dict(recs[0]), model="m",
+                               request_id="r-1")
+            assert out["ok"] and out["request_id"] == "r-1"
+            assert "prediction" in out["result"][pred]
+            bad = client.score(dict(recs[1]), model="nope")
+            # an ANSWERED error is returned, not retried
+            assert bad["ok"] is False
+            snap = client.metrics()
+            assert snap["schema"] >= 2 and snap["answered"] >= 1
+        t.join(timeout=10)

@@ -17,6 +17,12 @@ request: it answers the live metrics snapshot instead of scoring, and
 ``--metrics-port`` serves the same JSON over HTTP (``GET /``) for
 scrapers that should not touch the scoring socket.
 
+``--auto-retrain`` (off by default) arms the self-healing lifecycle:
+drift-triggered background retraining with canary validation, atomic
+hot-swap between batches, and instant rollback on a post-swap breaker
+trip or drift regression (docs/self_healing.md). ``--retrain-budget``,
+``--canary-rows`` and ``--swap-policy`` tune it.
+
 Start one process serving a model zoo::
 
     python -m transmogrifai_tpu.cli serve \\
@@ -70,6 +76,27 @@ def add_serve_parser(sub) -> None:
                     help="disable the per-tenant drift sentinel")
     sv.add_argument("--max-requests", type=int, default=None,
                     help="exit after answering N requests (smoke/CI)")
+    sv.add_argument("--auto-retrain", action="store_true",
+                    help="enable the self-healing lifecycle: on a "
+                         "tenant's drift sentinel reaching DEGRADE, "
+                         "retrain in the background, canary-validate, "
+                         "and atomically hot-swap the compiled plan "
+                         "(docs/self_healing.md). OFF by default — "
+                         "without it serving behavior is unchanged")
+    sv.add_argument("--retrain-budget", type=float, default=120.0,
+                    help="wall-clock seconds a background retrain may "
+                         "take before it is abandoned (with "
+                         "--auto-retrain)")
+    sv.add_argument("--canary-rows", type=int, default=64,
+                    help="retained ring of recent admitted requests "
+                         "used to shadow-score candidates (with "
+                         "--auto-retrain)")
+    sv.add_argument("--swap-policy", choices=["tenant", "model"],
+                    default="tenant",
+                    help="hot-swap scope: 'tenant' replaces the plan "
+                         "only for the drifted tenant (others keep the "
+                         "original entry, bitwise unaffected); 'model' "
+                         "replaces the shared cache entry")
     sv.add_argument("--metrics-port", type=int, default=None,
                     help="also serve the live metrics JSON over HTTP "
                          "on this port (GET /; 0 = ephemeral, printed "
@@ -201,6 +228,15 @@ def run_serve(args) -> int:
     from ..utils.jax_setup import pin_platform_from_env
     pin_platform_from_env()
     trace.configure_from_env()
+    lifecycle = None
+    if getattr(args, "auto_retrain", False):
+        # the lifecycle is opt-in: without --auto-retrain the config
+        # stays None and the loop behaves exactly as before
+        from ..serving.lifecycle import LifecycleConfig
+        lifecycle = LifecycleConfig(
+            retrain_budget_seconds=args.retrain_budget,
+            canary_rows=args.canary_rows,
+            swap_policy=args.swap_policy)
     config = ServeConfig(
         max_wait_ms=args.max_wait_ms,
         target_batch=args.target_batch,
@@ -208,7 +244,8 @@ def run_serve(args) -> int:
         plan_budget=args.plan_cache,
         deadline_seconds=args.deadline_seconds,
         guardrails=not args.no_guardrails,
-        sentinel=not args.no_sentinel)
+        sentinel=not args.no_sentinel,
+        lifecycle=lifecycle)
     server = ServingServer(config)
     for name, path in _parse_models(args.model):
         server.add_model(name, path)

@@ -83,6 +83,11 @@ RULES: Dict[str, tuple] = {
                       "reason: a silent continue / pass-only handler "
                       "on exception in serving/ or local/scoring.py "
                       "discards rows invisibly"),
+    "TX-R03": (ERROR, "in-place mutation of a live serving cache entry "
+                      "or model registry in serving/ — hot model "
+                      "changes must go through PlanCache.swap_entry / "
+                      "rollback / commit so in-flight batches keep a "
+                      "consistent entry and rollback stays possible"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

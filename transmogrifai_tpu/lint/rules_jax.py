@@ -337,6 +337,23 @@ _RECOVERY_NAME_PARTS = ("quarantine", "classify", "fallback",
 _DROP_RECORD_NAME_PARTS = _RECOVERY_NAME_PARTS + (
     "record", "note", "count", "event", "warn", "log", "error")
 
+#: TX-R03: the load-bearing attributes of a live cache entry — writing
+#: one IN PLACE on an entry you did not just build races every
+#: in-flight batch holding a reference to it (and forfeits rollback:
+#: there is no previous value to pin). ``self.<attr> = ...`` inside the
+#: owning class (entry construction, the PlanCache helpers themselves)
+#: stays legal.
+_R03_ENTRY_ATTRS = frozenset({"plan", "model", "result_names"})
+#: the registries TX-R03 guards against out-of-band subscript writes:
+#: mutating another object's ``_entries``/``_overrides``/``_pinned``/
+#: ``_loaders`` bypasses swap_entry/rollback/commit's pin bookkeeping
+_R03_REGISTRY_ATTRS = frozenset({"_entries", "_loaders",
+                                 "_overrides", "_pinned"})
+
+
+def _is_self_name(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
 
 def _is_record_drop_path(path: str) -> bool:
     """serving/ files + local/scoring.py get the TX-R02 silent-record-
@@ -1096,7 +1113,62 @@ class _Visitor(ast.NodeVisitor):
                 and self._is_grid_alias(node.value):
             for target in node.targets:
                 self._taint_targets(target)
+        if self.serving:
+            for target in node.targets:
+                self._check_live_mutation(target)
         self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.serving:
+            self._check_live_mutation(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        if self.serving:
+            for target in node.targets:
+                self._check_live_mutation(target, deleting=True)
+        self.generic_visit(node)
+
+    def _check_live_mutation(self, target: ast.AST,
+                             deleting: bool = False) -> None:
+        """TX-R03: a store (or del) that rewrites a live serving cache
+        entry or a plan registry in place, outside the owning object's
+        own methods. Legal hot changes go through the atomic helpers
+        (``PlanCache.swap_entry`` pins the previous entry and replaces
+        the reference in ONE assignment; ``rollback``/``commit``
+        resolve the pin) so concurrent readers only ever see a
+        complete entry."""
+        verb = "del of" if deleting else "write to"
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_live_mutation(elt, deleting)
+            return
+        if isinstance(target, ast.Attribute) \
+                and target.attr in _R03_ENTRY_ATTRS \
+                and not _is_self_name(target.value):
+            self.add(
+                "TX-R03", target,
+                f"in-place {verb} '.{target.attr}' on a live serving "
+                f"cache entry — in-flight batches hold a reference to "
+                f"this object and there is no pinned previous value "
+                f"to roll back to",
+                ERROR,
+                hint="build a fresh entry and replace it atomically "
+                     "with PlanCache.swap_entry(...); rollback()/"
+                     "commit() resolve the pinned predecessor")
+            return
+        if isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Attribute) \
+                and target.value.attr in _R03_REGISTRY_ATTRS \
+                and not _is_self_name(target.value.value):
+            self.add(
+                "TX-R03", target,
+                f"direct {verb} '.{target.value.attr}[...]' on another "
+                f"object's plan registry bypasses the swap/rollback "
+                f"pin bookkeeping",
+                ERROR,
+                hint="use PlanCache.swap_entry(name, entry, "
+                     "tenant=...) / rollback(...) / commit(...)")
 
 
 # ---------------------------------------------------------------------------

@@ -21,7 +21,8 @@ from ..utils.histogram import StreamingHistogram
 __all__ = ["METRICS_SCHEMA_VERSION", "LatencyHistogram", "ServeMetrics"]
 
 #: bump when the snapshot shape changes (the endpoint's contract)
-METRICS_SCHEMA_VERSION = 1
+#: v2: per-tenant "sentinels" drift state + the "lifecycle" slice
+METRICS_SCHEMA_VERSION = 2
 
 
 class LatencyHistogram:

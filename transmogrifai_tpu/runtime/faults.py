@@ -18,7 +18,9 @@ with the grammar ``scope:name:site:n=fault``:
   (name = rung index), ``workflow`` (save/load path), ``plan``
   (serving ScoringPlan; name = stage class, or ``device`` for the
   fused-program dispatch), ``serving`` (the guardrail layer,
-  docs/serving_guardrails.md).
+  docs/serving_guardrails.md), ``lifecycle`` (the self-healing
+  retrain/swap loop, docs/self_healing.md; name = the registered model
+  name).
 - ``name``   — exact match or ``*``.
 - ``site``   — where the probe sits: ``dispatch`` (per-family device
   eval or the serving plan's fused-program dispatch, once per retry
@@ -26,7 +28,12 @@ with the grammar ``scope:name:site:n=fault``:
   family's metric matrix lands), ``boundary`` (between racing rungs),
   ``save``, ``compile``, ``guard`` (``serving:output:guard`` — a
   ``nan`` fault poisons one scored row so the output guard's
-  invalidate path is provable).
+  invalidate path is provable), and the lifecycle trio ``retrain``
+  (top of every background training attempt — an ``oom`` there drills
+  retry-then-quarantine with the old model still serving), ``canary``
+  (candidate shadow-scoring — any fault rejects the candidate), and
+  ``postswap`` (probed on each watched batch after a hot-swap — a
+  fault there triggers the instant rollback drill).
 - ``n``      — fire at the Nth matching probe (1-based), or ``*`` for
   every one.
 - ``fault``  — ``oom`` (RESOURCE_EXHAUSTED-shaped — transient, then

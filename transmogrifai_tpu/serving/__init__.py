@@ -4,14 +4,20 @@ serving guardrails — schema admission, per-row quarantine, output
 guards, a scoring circuit breaker and an online drift sentinel
 (docs/serving_guardrails.md) — and an async micro-batching serving
 loop that coalesces live requests into compiled bucket dispatches
-under latency SLOs (docs/serving_loop.md)."""
+under latency SLOs (docs/serving_loop.md), a reconnecting TCP client,
+and a self-healing model lifecycle — drift-triggered background
+retraining with canary validation, atomic hot-swap, and instant
+rollback (docs/self_healing.md)."""
+from .client import ServingUnavailable, TcpServingClient
 from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
                     GuardedScoreResult, GuardReason, OutputGuard,
                     SchemaGuard, ServingGuard)
+from .lifecycle import LifecycleConfig, ModelLifecycle
 from .plan import (EncodedScoreBatch, PlanCompileError, PlanCoverage,
                    ScoringPlan, bucket_for, plan_compiles)
 from .sentinel import (DriftSentinel, DriftThresholds,
-                       FeatureFingerprint, compute_fingerprints,
+                       FeatureFingerprint, FingerprintSchemaError,
+                       compute_fingerprints, load_fingerprint_doc,
                        load_fingerprints, save_fingerprints)
 from .server import (PlanCache, ServeConfig, ServeRejected,
                      ServingClient, ServingServer, serve_in_process)
@@ -22,7 +28,10 @@ __all__ = ["ScoringPlan", "EncodedScoreBatch", "PlanCoverage",
            "CircuitBreaker", "BreakerOpenError", "ServingGuard",
            "GuardReason", "GuardedScoreResult",
            "DriftSentinel", "DriftThresholds", "FeatureFingerprint",
-           "compute_fingerprints", "save_fingerprints",
-           "load_fingerprints",
+           "FingerprintSchemaError", "compute_fingerprints",
+           "save_fingerprints", "load_fingerprints",
+           "load_fingerprint_doc",
            "ServeConfig", "ServingServer", "ServingClient", "PlanCache",
-           "ServeRejected", "serve_in_process"]
+           "ServeRejected", "serve_in_process",
+           "LifecycleConfig", "ModelLifecycle",
+           "TcpServingClient", "ServingUnavailable"]

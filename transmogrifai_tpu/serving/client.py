@@ -1,0 +1,155 @@
+"""Reconnecting TCP client for the serving loop's line-JSON protocol.
+
+``tx serve`` (cli/serve.py) speaks newline-delimited JSON over TCP.
+A naive client dies the moment the server restarts — which is exactly
+when a self-healing deployment is MOST interesting (hot-swap drills,
+rolling restarts, breaker trips). :class:`TcpServingClient` survives
+them: every connect and every request retries under the same bounded
+exponential backoff policy the rest of the runtime uses
+(:class:`~..runtime.retry.RetryPolicy` — deterministic jitter, capped
+delays), reconnecting on any socket-level failure and counting each
+reconnect in telemetry (``serve_client_reconnects``).
+
+What it does NOT do: retry a request the server ANSWERED with an
+error. An ``{"ok": false}`` response is an application verdict
+(schema rejection, breaker open, ...) and is returned to the caller —
+only transport failures (connection refused/reset, truncated stream)
+trigger reconnect + resend.
+
+>>> with TcpServingClient("127.0.0.1", 8190) as client:
+...     row = client.score({"x": 1.0}, model="m")
+...     snap = client.metrics()
+"""
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Any, Dict, Optional
+
+from ..runtime import telemetry as _telemetry
+from ..runtime.retry import RetryPolicy
+
+__all__ = ["TcpServingClient", "ServingUnavailable"]
+
+
+class ServingUnavailable(ConnectionError):
+    """The serving endpoint stayed unreachable through every backoff
+    attempt the retry policy allows."""
+
+
+class TcpServingClient:
+    """Line-JSON serving client with transparent reconnect.
+
+    ``retry`` bounds BOTH the initial connect and per-request resend
+    attempts; delays come from ``RetryPolicy.delay_for`` so tests can
+    pin them with ``TX_RETRY_*`` env knobs.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8190,
+                 retry: Optional[RetryPolicy] = None,
+                 timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.retry = retry or RetryPolicy.from_env()
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._reader = None
+
+    # -- connection management ---------------------------------------------
+    def connect(self) -> "TcpServingClient":
+        """Ensure a live connection, retrying with bounded exponential
+        backoff. Raises :class:`ServingUnavailable` when every attempt
+        fails."""
+        if self._sock is not None:
+            return self
+        last: Optional[Exception] = None
+        for attempt in range(1, self.retry.max_attempts + 1):
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout)
+                sock.settimeout(self.timeout)
+                self._sock = sock
+                self._reader = sock.makefile("r", encoding="utf-8")
+                return self
+            except (OSError, ConnectionError) as e:
+                last = e
+                self._close()
+                if attempt < self.retry.max_attempts:
+                    time.sleep(self.retry.delay_for(
+                        attempt, f"connect:{self.host}:{self.port}"))
+        raise ServingUnavailable(
+            f"serving endpoint {self.host}:{self.port} unreachable "
+            f"after {self.retry.max_attempts} attempts: {last}"
+        ) from last
+
+    def _close(self) -> None:
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except OSError:
+                pass
+            self._reader = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        self._close()
+
+    def __enter__(self) -> "TcpServingClient":
+        return self.connect()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- requests ----------------------------------------------------------
+    def request(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """One request/response round trip. A transport failure closes
+        the socket, reconnects under backoff, and RESENDS; an answered
+        ``{"ok": false}`` is returned as-is (application errors are not
+        transport errors)."""
+        line = json.dumps(payload, default=float) + "\n"
+        last: Optional[Exception] = None
+        for attempt in range(1, self.retry.max_attempts + 1):
+            try:
+                self.connect()
+                self._sock.sendall(line.encode())
+                answer = self._reader.readline()
+                if not answer:
+                    raise ConnectionError(
+                        "server closed the connection mid-request")
+                return json.loads(answer)
+            except (OSError, ConnectionError, json.JSONDecodeError) as e:
+                last = e
+                self._close()
+                _telemetry.count("serve_client_reconnects")
+                if attempt < self.retry.max_attempts:
+                    time.sleep(self.retry.delay_for(
+                        attempt, f"request:{self.host}:{self.port}"))
+        raise ServingUnavailable(
+            f"request to {self.host}:{self.port} failed after "
+            f"{self.retry.max_attempts} attempts: {last}") from last
+
+    def score(self, record: Dict[str, Any],
+              model: Optional[str] = None,
+              tenant: Optional[str] = None,
+              request_id: Optional[str] = None) -> Dict[str, Any]:
+        """Score one record; returns the full response envelope
+        (``{"ok": true, "result": row}`` or ``{"ok": false, ...}``)."""
+        payload: Dict[str, Any] = {"record": record}
+        if model is not None:
+            payload["model"] = model
+        if tenant is not None:
+            payload["tenant"] = tenant
+        if request_id is not None:
+            payload["id"] = request_id
+        return self.request(payload)
+
+    def metrics(self) -> Dict[str, Any]:
+        """The live metrics snapshot (schema: observability/metrics)."""
+        answer = self.request({"metrics": True})
+        return answer.get("metrics", answer)

@@ -27,7 +27,7 @@ from __future__ import annotations
 import json
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -40,12 +40,26 @@ from ..types import OPNumeric
 from ..utils.histogram import StreamingHistogram
 
 __all__ = ["DriftSentinel", "FeatureFingerprint", "DriftThresholds",
-           "compute_fingerprints", "save_fingerprints",
-           "load_fingerprints", "DRIFT_FINGERPRINTS_FILE",
+           "FingerprintSchemaError", "compute_fingerprints",
+           "save_fingerprints", "load_fingerprints",
+           "load_fingerprint_doc", "DRIFT_FINGERPRINTS_FILE",
+           "FINGERPRINT_SCHEMA",
            "STATUS_OK", "STATUS_WARN", "STATUS_DEGRADE"]
 
 DRIFT_FINGERPRINTS_FILE = "drift-fingerprints.json"
 FINGERPRINT_FORMAT_VERSION = 1
+#: schema identity of the fingerprint document. A hot-swapped model
+#: MUST NOT be compared against fingerprints written under a different
+#: schema — the comparison would be silently meaningless — so load
+#: rejects a mismatch loudly (FingerprintSchemaError) instead of
+#: falling back to stale data.
+FINGERPRINT_SCHEMA = "tx-drift-fingerprints/1"
+
+
+class FingerprintSchemaError(ValueError):
+    """drift-fingerprints.json was written under an incompatible
+    schema; deliberately NOT swallowed by ``DriftSentinel.for_model``'s
+    best-effort fallbacks."""
 
 STATUS_OK = "ok"
 STATUS_WARN = "warn"
@@ -212,10 +226,16 @@ def compute_fingerprints(raw_features: Sequence, ds: Dataset,
 
 
 def save_fingerprints(fingerprints: Sequence[FeatureFingerprint],
-                      model_dir: str) -> str:
+                      model_dir: str, trained_at: int = 0) -> str:
+    """``trained_at`` is the model GENERATION the fingerprints belong
+    to (0 = the original offline train; each lifecycle hot-swap bumps
+    it) — a loaded sentinel carries it so operators can tell which
+    model generation the drift numbers compare against."""
     path = os.path.join(model_dir, DRIFT_FINGERPRINTS_FILE)
     with open(path, "w") as fh:
         json.dump({"formatVersion": FINGERPRINT_FORMAT_VERSION,
+                   "schema": FINGERPRINT_SCHEMA,
+                   "trainedAt": int(trained_at),
                    "features": [fp.to_json() for fp in fingerprints]},
                   fh)
         fh.flush()
@@ -223,20 +243,41 @@ def save_fingerprints(fingerprints: Sequence[FeatureFingerprint],
     return path
 
 
-def load_fingerprints(model_dir: str
-                      ) -> Optional[List[FeatureFingerprint]]:
+def load_fingerprint_doc(model_dir: str
+                         ) -> Optional[Tuple[List[FeatureFingerprint],
+                                             dict]]:
+    """(fingerprints, metadata) from a model dir, or None when the
+    file does not exist. Metadata carries ``schema`` and ``trainedAt``.
+    Raises :class:`FingerprintSchemaError` on a schema mismatch — a
+    document with no ``schema`` field predates versioning and is read
+    as the v1 schema."""
     path = os.path.join(model_dir, DRIFT_FINGERPRINTS_FILE)
     if not os.path.exists(path):
         return None
     with open(path) as fh:
         doc = json.load(fh)
+    schema = doc.get("schema", FINGERPRINT_SCHEMA)
+    if schema != FINGERPRINT_SCHEMA:
+        raise FingerprintSchemaError(
+            f"{path} was written under fingerprint schema {schema!r}; "
+            f"this build reads {FINGERPRINT_SCHEMA!r} — refusing to "
+            f"compare live traffic against incompatible fingerprints "
+            f"(re-save the model to regenerate them)")
     if doc.get("formatVersion", 1) > FINGERPRINT_FORMAT_VERSION:
-        raise ValueError(
+        raise FingerprintSchemaError(
             f"{path} uses fingerprint format "
             f"{doc['formatVersion']}; this build reads up to "
             f"{FINGERPRINT_FORMAT_VERSION}")
-    return [FeatureFingerprint.from_json(d)
-            for d in doc.get("features", [])]
+    fps = [FeatureFingerprint.from_json(d)
+           for d in doc.get("features", [])]
+    return fps, {"schema": schema,
+                 "trainedAt": int(doc.get("trainedAt", 0))}
+
+
+def load_fingerprints(model_dir: str
+                      ) -> Optional[List[FeatureFingerprint]]:
+    loaded = load_fingerprint_doc(model_dir)
+    return None if loaded is None else loaded[0]
 
 
 # ---------------------------------------------------------------------------
@@ -261,6 +302,9 @@ class DriftSentinel:
         #: features already warned about (one telemetry event per
         #: feature per status escalation, not per batch)
         self._reported: Dict[str, str] = {}
+        #: model generation the fingerprints were computed against
+        #: (0 = offline train; lifecycle hot-swaps bump it)
+        self.generation = 0
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -274,12 +318,20 @@ class DriftSentinel:
         exists (the caller serves unguarded, loudly)."""
         model_dir = getattr(model, "model_dir", None)
         if model_dir:
+            loaded = None
             try:
-                fps = load_fingerprints(model_dir)
+                loaded = load_fingerprint_doc(model_dir)
+            except FingerprintSchemaError:
+                # an incompatible schema is a configuration error, not
+                # a missing file — falling back to in-memory data would
+                # hide it, so it propagates to the caller
+                raise
             except (OSError, ValueError, KeyError):
-                fps = None
-            if fps:
-                return cls(fps, thresholds)
+                loaded = None
+            if loaded and loaded[0]:
+                sentinel = cls(loaded[0], thresholds)
+                sentinel.generation = loaded[1].get("trainedAt", 0)
+                return sentinel
         train_ds = getattr(model, "train_dataset", None)
         if train_ds is not None:
             return cls(compute_fingerprints(model.raw_features(),

@@ -118,6 +118,10 @@ class ServeConfig:
     breaker_failures: int = 3
     breaker_cooldown_seconds: float = 30.0
     breaker_factory: Optional[Callable[[], CircuitBreaker]] = None
+    #: self-healing lifecycle (serving/lifecycle.LifecycleConfig);
+    #: None (the default) disables drift-triggered retraining entirely
+    #: — the loop behaves byte-identically to a build without it
+    lifecycle: Any = None
 
 
 @dataclass
@@ -166,12 +170,24 @@ class _TenantGuards:
                 model, thresholds=config.drift_thresholds)
 
 
+#: marker pinned when a tenant swap had no previous override (rollback
+#: must REMOVE the override, not restore a None entry)
+_NO_OVERRIDE = object()
+
+
 class PlanCache:
     """LRU of compiled ScoringPlans keyed by (model dir, bucket range)
     — the compile-cache budget that turns one process into a model-zoo
     server. Eviction drops the plan (and its jitted programs) but
     keeps the loader, so an evicted model transparently reloads +
-    recompiles on next use; hits/misses/evictions are counted."""
+    recompiles on next use; hits/misses/evictions are counted.
+
+    Hot-swaps go through :meth:`swap_entry`/:meth:`rollback` ONLY (lint
+    rule TX-R03 bans in-place mutation of a live entry): the replace is
+    one dict assignment, atomic between batches — a prepare that
+    already captured the old entry finishes on it, the next prepare
+    resolves the new one, and the previous entry stays PINNED for one
+    generation so a post-swap fault rolls back instantly."""
 
     def __init__(self, budget: int = 4):
         if budget < 1:
@@ -181,6 +197,11 @@ class PlanCache:
         self._loaders: Dict[str, Any] = {}
         self._entries: "collections.OrderedDict[Tuple, _CacheEntry]" = \
             collections.OrderedDict()
+        #: (name, tenant) -> swapped-in entry (tenant-scoped hot-swaps;
+        #: resolution order: override, then the shared LRU entry)
+        self._overrides: Dict[Tuple[str, str], _CacheEntry] = {}
+        #: previous entry pinned per swap scope until commit/rollback
+        self._pinned: Dict[Tuple[str, Optional[str]], Any] = {}
         self.evictions = 0
         self.hits = 0
         self.misses = 0
@@ -230,6 +251,76 @@ class PlanCache:
             _telemetry.count("serve_plan_cache_evictions")
             _telemetry.event("serve_plan_evicted", model=old_key[0])
         return entry
+
+    # -- hot-swap (the ONLY sanctioned live replacement, TX-R03) -----------
+    def entry_for(self, name: str, tenant: str,
+                  buckets: Tuple[int, int] = (None, None)
+                  ) -> _CacheEntry:
+        """Tenant-aware resolution: a tenant-scoped swapped-in entry
+        wins; every other tenant resolves the shared LRU entry —
+        untouched by a 'tenant'-policy swap, hence bitwise
+        unaffected."""
+        override = self._overrides.get((name, tenant))
+        if override is not None:
+            self.hits += 1
+            _telemetry.count("serve_plan_cache_hits")
+            return override
+        return self.get(name, buckets)
+
+    def swap_entry(self, name: str, new_entry: _CacheEntry,
+                   tenant: Optional[str] = None,
+                   buckets: Tuple[int, int] = (None, None)) -> None:
+        """Atomically replace the live entry for ``name`` (one dict
+        assignment — batches already holding the old entry finish on
+        it; the next ``entry_for`` resolves ``new_entry``). The
+        previous entry is pinned until :meth:`commit` or
+        :meth:`rollback`. ``tenant=None`` swaps the shared entry for
+        every tenant; a tenant name swaps only that tenant's
+        resolution."""
+        if name not in self._loaders:
+            raise ServeRejected(f"unknown model {name!r}; registered: "
+                                f"{self.names()}")
+        if tenant is not None:
+            self._pinned[(name, tenant)] = self._overrides.get(
+                (name, tenant), _NO_OVERRIDE)
+            self._overrides[(name, tenant)] = new_entry
+        else:
+            key = (name, buckets)
+            self._pinned[(name, None)] = self._entries.get(key)
+            self._entries[key] = new_entry
+        _telemetry.count("serve_plan_swaps")
+        _telemetry.event("serve_plan_swapped", model=name,
+                         tenant=tenant or "*")
+
+    def rollback(self, name: str, tenant: Optional[str] = None,
+                 buckets: Tuple[int, int] = (None, None)) -> bool:
+        """Instantly restore the entry pinned by the last
+        :meth:`swap_entry` for this scope. Returns False when nothing
+        is pinned (already committed or never swapped)."""
+        pin = (name, tenant)
+        if pin not in self._pinned:
+            return False
+        prev = self._pinned.pop(pin)
+        if tenant is not None:
+            if prev is _NO_OVERRIDE:
+                self._overrides.pop((name, tenant), None)
+            else:
+                self._overrides[(name, tenant)] = prev
+        elif prev is not None:
+            self._entries[(name, buckets)] = prev
+        else:
+            self._entries.pop((name, buckets), None)
+        return True
+
+    def commit(self, name: str, tenant: Optional[str] = None) -> None:
+        """Unpin the previous entry after a healthy post-swap watch
+        window — the swap becomes permanent and the old plan (and its
+        compiled programs) may be released."""
+        self._pinned.pop((name, tenant), None)
+
+    def swapped_entries(self) -> Dict[Tuple[str, str], _CacheEntry]:
+        """Live tenant-scoped overrides (metrics/introspection)."""
+        return dict(self._overrides)
 
 
 class _Lane:
@@ -315,6 +406,13 @@ class ServingServer:
         }
         self._first_dispatch_at: Optional[float] = None
         self._last_dispatch_at: Optional[float] = None
+        #: self-healing lifecycle manager — None unless
+        #: ``config.lifecycle`` is an enabled LifecycleConfig
+        self.lifecycle = None
+        lc = self.config.lifecycle
+        if lc is not None and getattr(lc, "enabled", False):
+            from .lifecycle import ModelLifecycle
+            self.lifecycle = ModelLifecycle(self, lc)
 
     # -- registry ----------------------------------------------------------
     def add_model(self, name: str, model_or_dir: Any,
@@ -325,6 +423,28 @@ class ServingServer:
         self.plans.register(name, model_or_dir)
         if default or self._default_model is None:
             self._default_model = name
+        return self
+
+    def register_refit(self, name: str, workflow_factory=None,
+                       base_records: Optional[List[dict]] = None,
+                       checkpoint_dir: Optional[str] = None,
+                       save_dir: Optional[str] = None) -> "ServingServer":
+        """In-process half of ``tx serve --auto-retrain``: how to
+        retrain ``name`` when its sentinel degrades.
+        ``workflow_factory`` returns a fresh unfitted workflow (exact
+        estimators/hyperparameters); without one the workflow is
+        reconstructed generically from the fitted model
+        (runtime/refit.py). Requires ``ServeConfig.lifecycle``."""
+        if self.lifecycle is None:
+            raise ValueError(
+                "register_refit requires an enabled "
+                "ServeConfig.lifecycle (serving/lifecycle."
+                "LifecycleConfig)")
+        from ..runtime.refit import RefitSpec
+        self.lifecycle.register(name, RefitSpec(
+            workflow_factory=workflow_factory,
+            base_records=base_records, checkpoint_dir=checkpoint_dir,
+            save_dir=save_dir))
         return self
 
     # -- async request edge ------------------------------------------------
@@ -476,7 +596,7 @@ class ServingServer:
         an evicted model), schema admission with per-row quarantine
         reasons, raw-Dataset boxing, and bucket encode/padding."""
         marks = {"encode_t0": time.monotonic()}
-        entry = self.plans.get(lane.model_name)
+        entry = self.plans.entry_for(lane.model_name, lane.tenant)
         guards = entry.guards.get(lane.tenant)
         if guards is None:
             guards = entry.guards[lane.tenant] = _TenantGuards(
@@ -689,6 +809,10 @@ class ServingServer:
             obs = (prep.ds.take(np.flatnonzero(~qmask)) if qmask.any()
                    else prep.ds)
             guards.sentinel.observe_dataset(obs)
+        if self.lifecycle is not None:
+            # ring feed + drift poll + post-swap watch
+            # (serving/lifecycle.py); a dict lookup when idle
+            self.lifecycle.note_batch(prep)
         n_bad = int(qmask.sum())
         _telemetry.count("serving_rows_scored", n - n_bad)
         if n_bad:
@@ -785,6 +909,8 @@ class ServingServer:
         self._encode_pool.shutdown(wait=False)
         self._device_pool.shutdown(wait=False)
         self._fallback_pool.shutdown(wait=False)
+        if self.lifecycle is not None:
+            self.lifecycle.shutdown()
 
     # -- introspection -----------------------------------------------------
     def describe(self) -> dict:
@@ -827,14 +953,40 @@ class ServingServer:
         no I/O."""
         from ..observability.metrics import METRICS_SCHEMA_VERSION
         breakers = {}
-        for (name, _buckets), entry in list(self.plans._entries.items()):
+        sentinels = {}
+        live = [(name, entry) for (name, _buckets), entry
+                in list(self.plans._entries.items())]
+        live += [(name, entry) for (name, _tenant), entry
+                 in self.plans.swapped_entries().items()]
+        for name, entry in live:
             for tenant, guards in list(entry.guards.items()):
+                lane = f"{name}/{tenant}"
                 if guards.breaker is not None:
-                    breakers[f"{name}/{tenant}"] = guards.breaker.state
+                    breakers[lane] = guards.breaker.state
+                if guards.sentinel is not None:
+                    # per-tenant drift state: per-feature JS vs the
+                    # warn/degrade thresholds + rows observed — the
+                    # condition that triggers the self-healing loop,
+                    # visible BEFORE it fires (docs/self_healing.md)
+                    report = guards.sentinel.drift_report()
+                    sentinels[lane] = {
+                        "status": report["status"],
+                        "rowsSeen": report["rowsSeen"],
+                        "warnThreshold": report["warnThreshold"],
+                        "degradeThreshold": report["degradeThreshold"],
+                        "generation": getattr(guards.sentinel,
+                                              "generation", 0),
+                        "features": {
+                            f["feature"]: {
+                                "jsDivergence": f["jsDivergence"],
+                                "status": f["status"],
+                                "rowsObserved": f["rowsObserved"],
+                            } for f in report["features"]},
+                    }
         serving_counters = {
             k: v for k, v in _telemetry.counters().items()
             if k.startswith(("serve_", "serving_", "breaker_",
-                             "drift_"))}
+                             "drift_", "lifecycle_"))}
         return {
             "schema": METRICS_SCHEMA_VERSION,
             "uptime_seconds": round(self.metrics.uptime_seconds(), 3),
@@ -860,6 +1012,9 @@ class ServingServer:
                            "misses": self.plans.misses,
                            "evictions": self.plans.evictions},
             "breakers": breakers,
+            "sentinels": sentinels,
+            "lifecycle": (self.lifecycle.snapshot()
+                          if self.lifecycle is not None else None),
             "counters": serving_counters,
             "trace": {"enabled": _trace.enabled(),
                       "path": _trace.trace_path()},

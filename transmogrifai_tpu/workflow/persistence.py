@@ -358,7 +358,9 @@ def _save_drift_fingerprints(model, staging_dir: str) -> None:
     try:
         fps = compute_fingerprints(model.raw_features(), train_ds)
         if fps:
-            save_fingerprints(fps, staging_dir)
+            save_fingerprints(
+                fps, staging_dir,
+                trained_at=getattr(model, "trained_generation", 0))
     except Exception as e:   # never let fingerprinting break a save
         import logging
         logging.getLogger(__name__).warning(
