@@ -818,6 +818,192 @@ def _measure_serve_loop() -> dict:
     }
 
 
+def _measure_restart() -> dict:
+    """TX_BENCH_MODE=restart: the preemption-tolerance drill
+    (docs/serving_restart.md) on the synthetic-Titanic model (CPU).
+    Incarnation 1 (``tx serve --state-dir``) takes an OPEN-LOOP
+    arrival stream through the reconnecting TCP client and is
+    SIGTERM-killed mid-stream; incarnation 2 resumes from the snapshot
+    (``--resume-state``) on the same port while the stream keeps
+    flowing. Measured: the first-answer latency of a COLD boot (the
+    client-visible compile stall) vs the WARM resume (recorded buckets
+    pre-compiled behind the readiness gate), spawn-to-ready seconds
+    for both incarnations, post-restart steady-state compiles (target
+    0), the drain summary of the killed incarnation (in-flight
+    completion), and client-observed failures across the kill +
+    rolling restart (target 0). Headline ``restart_warm_first_answer_
+    ms`` with ``vs_baseline`` the cold/warm first-answer ratio."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import signal
+    import socket
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from examples.titanic import build_features, synthetic_titanic, \
+        stratified_split
+    from transmogrifai_tpu.models import LogisticRegression
+    from transmogrifai_tpu.runtime.retry import RetryPolicy
+    from transmogrifai_tpu.serving import TcpServingClient
+    from transmogrifai_tpu.workflow import Workflow
+
+    records = synthetic_titanic(1309)
+    train, test = stratified_split(records)
+    survived, features = build_features()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        survived, features).get_output()
+    model = (Workflow().set_result_features(survived, pred)
+             .set_input_records(train).train(validate="off"))
+    work = tempfile.mkdtemp(prefix="tx_restart_bench_")
+    model_dir = os.path.join(work, "model")
+    model.save(model_dir)
+    state_dir = os.path.join(work, "state")
+    reqs = [dict(r) for r in test]
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    def spawn(extra, generation):
+        cmd = [sys.executable, "-m", "transmogrifai_tpu.cli", "serve",
+               "--model", f"titanic={model_dir}", "--host",
+               "127.0.0.1", "--port", str(port), "--max-wait-ms", "5",
+               "--snapshot-interval", "2", *extra]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TX_SERVE_GENERATION=str(generation))
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=env)
+
+    patient = RetryPolicy(max_attempts=120, base_delay=0.2,
+                          max_delay=0.5)
+
+    def wait_ready(timeout=180.0):
+        quick = RetryPolicy(max_attempts=2, base_delay=0.05,
+                            max_delay=0.1)
+        deadline = time.monotonic() + timeout
+        c = TcpServingClient("127.0.0.1", port, retry=quick,
+                             timeout=2.0)
+        while time.monotonic() < deadline:
+            try:
+                if c.request({"ready": True}).get("ready"):
+                    c.close()
+                    return
+            except Exception:
+                time.sleep(0.2)
+        raise RuntimeError("serving child never became ready")
+
+    def first_answer_ms():
+        # ONE fresh-connection score against a just-ready server: on a
+        # cold boot this pays the bucket compile inline; on a warm
+        # resume the bucket was pre-compiled behind the readiness gate
+        with TcpServingClient("127.0.0.1", port, retry=patient,
+                              timeout=120.0) as c:
+            t0 = time.perf_counter()
+            out = c.score(dict(reqs[0]), model="titanic")
+            dt = (time.perf_counter() - t0) * 1000.0
+        if not out.get("ok"):
+            raise RuntimeError(f"first answer failed: {out}")
+        return dt
+
+    rate_rps = float(os.environ.get("TX_BENCH_RESTART_RATE", "40"))
+    rng = np.random.default_rng(17)
+    failures, answered = [], {"n": 0}
+    stop_flag = threading.Event()
+
+    def pump():
+        # open-loop arrivals: seeded exponential inter-arrival gaps,
+        # NOT closed-loop send-after-answer — the kill lands while
+        # requests are genuinely in flight
+        c = TcpServingClient("127.0.0.1", port, retry=patient,
+                             timeout=30.0)
+        i = 0
+        while not stop_flag.is_set():
+            gap = float(rng.exponential(1.0 / rate_rps))
+            if stop_flag.wait(min(gap, 0.25)):
+                break
+            try:
+                out = c.score(dict(reqs[i % len(reqs)]),
+                              model="titanic")
+                if out.get("ok"):
+                    answered["n"] += 1
+                else:
+                    failures.append(out)
+            except Exception as e:   # noqa: BLE001 - tallied
+                failures.append(repr(e))
+            i += 1
+        c.close()
+
+    # -- incarnation 1: cold boot under load, killed mid-stream --------
+    t_spawn1 = time.perf_counter()
+    proc1 = spawn(("--state-dir", state_dir), generation=1)
+    wait_ready()
+    cold_ready_s = time.perf_counter() - t_spawn1
+    cold_ms = first_answer_ms()
+    thread = threading.Thread(target=pump, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 60
+    while answered["n"] < 40 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    proc1.send_signal(signal.SIGTERM)
+    out1, _ = proc1.communicate(timeout=180)
+    drain = next((d["drain"] for d in
+                  (json.loads(ln) for ln in out1.splitlines()
+                   if ln.startswith("{")) if "drain" in d), None)
+
+    # -- incarnation 2: warm resume on the same port, stream flowing --
+    t_spawn2 = time.perf_counter()
+    proc2 = spawn(("--resume-state", state_dir), generation=2)
+    wait_ready()
+    warm_ready_s = time.perf_counter() - t_spawn2
+    warm_ms = first_answer_ms()
+    n_at_ready = answered["n"]
+    deadline = time.monotonic() + 60
+    while answered["n"] < n_at_ready + 40 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    with TcpServingClient("127.0.0.1", port, retry=patient) as c:
+        compiles_a = c.metrics()["plan_compiles"]
+        time.sleep(1.0)
+        snap = c.metrics()
+    stop_flag.set()
+    thread.join(timeout=60)
+    proc2.send_signal(signal.SIGTERM)
+    out2, _ = proc2.communicate(timeout=180)
+    resume = next((d["resume"] for d in
+                   (json.loads(ln) for ln in out2.splitlines()
+                    if ln.startswith("{")) if "resume" in d), {})
+
+    post_restart_compiles = snap["plan_compiles"] - compiles_a
+    return {
+        "metric": "restart_warm_first_answer_ms",
+        "value": round(warm_ms, 2),
+        "unit": "ms",
+        # cold/warm first-answer ratio: what the readiness gate +
+        # prewarm saves the FIRST caller after a restart
+        "vs_baseline": round(cold_ms / max(warm_ms, 1e-6), 2),
+        "cold_first_answer_ms": round(cold_ms, 2),
+        "warm_first_answer_ms": round(warm_ms, 2),
+        "cold_ready_seconds": round(cold_ready_s, 2),
+        "warm_ready_seconds": round(warm_ready_s, 2),
+        "resume_mode": resume.get("mode"),
+        "resume_warm_buckets": resume.get("warm_buckets"),
+        "resume_prewarm_compiles": resume.get("compiles"),
+        "post_restart_steady_state_compiles": int(
+            post_restart_compiles),
+        "drain": drain,
+        "client_observed_failures": len(failures),
+        "failure_samples": [str(f)[:200] for f in failures[:5]],
+        "answered_across_restart": answered["n"],
+        "exit_codes": [proc1.returncode, proc2.returncode],
+        "restart_generation_live": snap["process"][
+            "restart_generation"],
+        "platform": "cpu",
+    }
+
+
 def _measure_self_heal() -> dict:
     """TX_BENCH_MODE=self_heal: the drift-triggered self-healing loop
     (ISSUE 11, docs/self_healing.md) measured end to end on the
@@ -1467,6 +1653,8 @@ def _measure() -> dict:
         return _measure_serve_loop()
     if os.environ.get("TX_BENCH_MODE") == "self_heal":
         return _measure_self_heal()
+    if os.environ.get("TX_BENCH_MODE") == "restart":
+        return _measure_restart()
     from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
                                                    pin_platform_from_env)
     pin_platform_from_env()
@@ -1648,7 +1836,8 @@ def _probe_ambient() -> tuple[bool, str, list]:
 
 def main() -> None:
     if os.environ.get("TX_BENCH_MODE") in ("sharded_search", "prepare",
-                                           "serve_loop", "self_heal"):
+                                           "serve_loop", "self_heal",
+                                           "restart"):
         # these modes are DEFINED on the forced-CPU backend (the
         # sharded sweep on a virtual device pool, the prepare
         # comparison on the x64 CPU path, the serve-loop latency SLO
@@ -1718,6 +1907,8 @@ def _headline_metric() -> tuple:
         return "serve_rows_per_s", "rows/s"
     if os.environ.get("TX_BENCH_MODE") == "self_heal":
         return "self_heal_seconds", "s"
+    if os.environ.get("TX_BENCH_MODE") == "restart":
+        return "restart_warm_first_answer_ms", "ms"
     return "titanic_holdout_aupr", "AuPR"
 
 

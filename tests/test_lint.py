@@ -1143,6 +1143,111 @@ class TestR03LiveSwapMutation:
         assert findings == []
 
 
+class TestR04TornStateWrite:
+    """TX-R04: serving-path state files must be written through the
+    shared atomic tmp+os.replace writer (atomic_write_json) — a bare
+    write-mode open() to a live path tears the document when the
+    process dies mid-write (docs/serving_restart.md)."""
+
+    SRV = "transmogrifai_tpu/serving/mystate.py"
+
+    def _lint(self, code, path=None):
+        return lint_source(textwrap.dedent(code), path or self.SRV)
+
+    def test_live_path_write_flagged(self):
+        findings = self._lint("""
+            import json
+
+            def save(path, doc):
+                with open(path, "w") as fh:
+                    json.dump(doc, fh)
+        """)
+        assert "TX-R04" in _rules(findings)
+        f = [x for x in findings if x.rule_id == "TX-R04"][0]
+        assert f.severity == "error"
+        assert "atomic_write_json" in (f.hint or "")
+
+    def test_mode_keyword_flagged(self):
+        findings = self._lint("""
+            def save(path, text):
+                fh = open(path, mode="a")
+                fh.write(text)
+        """)
+        assert "TX-R04" in _rules(findings)
+
+    def test_exclusive_create_flagged(self):
+        findings = self._lint("""
+            def save(path, text):
+                with open(path, "x") as fh:
+                    fh.write(text)
+        """)
+        assert "TX-R04" in _rules(findings)
+
+    def test_tmp_suffix_concat_is_legal(self):
+        # the atomic-writer idiom itself: stage to *.tmp, os.replace
+        findings = self._lint("""
+            import json, os
+
+            def save(path, doc):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(doc, fh)
+                os.replace(tmp, path)
+        """)
+        assert "TX-R04" not in _rules(findings)
+
+    def test_tmp_string_expression_is_legal(self):
+        findings = self._lint("""
+            def save(path, text):
+                with open(path + ".tmp", "w") as fh:
+                    fh.write(text)
+        """)
+        assert "TX-R04" not in _rules(findings)
+
+    def test_read_mode_is_legal(self):
+        findings = self._lint("""
+            import json
+
+            def load(path):
+                with open(path) as fh:
+                    return json.load(fh)
+
+            def load_binary(path):
+                with open(path, "rb") as fh:
+                    return fh.read()
+        """)
+        assert "TX-R04" not in _rules(findings)
+
+    def test_outside_serving_is_silent(self):
+        findings = self._lint("""
+            def save(path, text):
+                with open(path, "w") as fh:
+                    fh.write(text)
+        """, path="transmogrifai_tpu/observability/mystore.py")
+        assert "TX-R04" not in _rules(findings)
+
+    def test_async_write_reports_both_rules(self):
+        # in an async handler the same open() is also a blocking call
+        # (TX-J10); the two findings are different defects
+        findings = self._lint("""
+            async def flush(path, text):
+                with open(path, "w") as fh:
+                    fh.write(text)
+        """)
+        assert {"TX-R04", "TX-J10"} <= _rules(findings)
+
+    def test_inline_suppression(self, tmp_path):
+        d = tmp_path / "serving"
+        d.mkdir()
+        p = d / "writer.py"
+        p.write_text("def save(path, text):\n"
+                     "    fh = open(path, 'w')"
+                     "  # tx-lint: disable=TX-R04\n"
+                     "    fh.write(text)\n")
+        findings, _ = lint_paths([str(p)])
+        assert findings == []
+
+
 class TestJ08ShardClosure:
     """TX-J08: a shard_map/pjit body closing over an array-like value
     gets implicit full replication — arrays must enter through

@@ -33,7 +33,20 @@ The hot path is the :class:`~transmogrifai_tpu.serving.ServingServer`
 coalescing loop: deadline-or-full bucket batching, double-buffered
 encode vs dispatch, per-tenant guardrails + breaker + sentinel, LRU
 plan cache. ``--max-requests`` exits after N answered requests (CI
-smoke); ``--port 0`` binds an ephemeral port (printed on stdout)."""
+smoke); ``--port 0`` binds an ephemeral port (printed on stdout).
+
+Preemption tolerance (docs/serving_restart.md): SIGTERM/SIGINT flips
+the loop to DRAINING — new requests get a machine-readable
+``{"ok": false, "draining": true}`` answer (the reconnecting client
+retries against the next incarnation), queued + in-flight requests
+finish under ``--drain-timeout``, traces/metrics/profiles flush, the
+warm-state snapshot is written, and the process exits 0.
+``--resume-state DIR`` restores that snapshot on boot — recompiling +
+prewarming exactly the recorded buckets BEHIND the readiness gate
+(``{"ready": true}`` control request + the metrics ``process`` block)
+before the port binds. ``--supervise`` runs a parent that restarts a
+crashed loop under ``RetryPolicy`` backoff with a crash-loop breaker,
+handing the snapshot dir to each incarnation."""
 from __future__ import annotations
 
 import asyncio
@@ -41,7 +54,8 @@ import json
 import os
 from typing import List, Optional
 
-__all__ = ["add_serve_parser", "run_serve", "serve_forever"]
+__all__ = ["add_serve_parser", "run_serve", "run_supervised",
+           "serve_forever"]
 
 
 def add_serve_parser(sub) -> None:
@@ -101,6 +115,34 @@ def add_serve_parser(sub) -> None:
                     help="also serve the live metrics JSON over HTTP "
                          "on this port (GET /; 0 = ephemeral, printed "
                          "on stdout; docs/observability.md)")
+    sv.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="seconds a SIGTERM/SIGINT drain waits for "
+                         "queued + in-flight requests before shutdown "
+                         "(docs/serving_restart.md)")
+    sv.add_argument("--state-dir", default=None, metavar="DIR",
+                    help="write the warm-state snapshot here "
+                         "(periodically, at lifecycle commits, and on "
+                         "shutdown); defaults to --resume-state's DIR")
+    sv.add_argument("--resume-state", default=None, metavar="DIR",
+                    help="restore the warm-state snapshot from DIR on "
+                         "boot: recompile + prewarm the recorded "
+                         "buckets behind the readiness gate, restore "
+                         "sentinels/breakers/lifecycle. A torn or "
+                         "mismatched snapshot cold-starts loudly")
+    sv.add_argument("--snapshot-interval", type=float, default=30.0,
+                    help="seconds between periodic snapshot writes "
+                         "(with --state-dir/--resume-state; 0 = only "
+                         "at lifecycle commits and shutdown)")
+    sv.add_argument("--supervise", action="store_true",
+                    help="run a supervisor parent that restarts the "
+                         "serving child on crash with RetryPolicy "
+                         "backoff and a crash-loop breaker")
+    sv.add_argument("--max-restarts", type=int, default=5,
+                    help="crash-loop breaker: give up after this many "
+                         "crashes inside --restart-window seconds")
+    sv.add_argument("--restart-window", type=float, default=60.0,
+                    help="sliding window (seconds) the crash-loop "
+                         "breaker counts crashes over")
 
 
 def _parse_models(specs: List[str]) -> List[tuple]:
@@ -119,22 +161,45 @@ async def serve_forever(server, host: str, port: int,
                         max_requests: Optional[int] = None,
                         ready_cb=None,
                         metrics_port: Optional[int] = None,
-                        metrics_ready_cb=None) -> int:
+                        metrics_ready_cb=None,
+                        drain_timeout: float = 30.0,
+                        state_manager=None,
+                        snapshot_interval: Optional[float] = None,
+                        banner_extra: Optional[dict] = None) -> int:
     """Run ``server``'s loop behind a JSON-lines TCP front end until
-    cancelled (or ``max_requests`` answers). Importable so tests drive
-    the exact CLI path in-process with in-memory models.
-    ``metrics_port`` additionally serves the live
-    ``server.metrics_snapshot()`` JSON over HTTP."""
+    cancelled (or ``max_requests`` answers, or a SIGTERM/SIGINT
+    drain). Importable so tests drive the exact CLI path in-process
+    with in-memory models. ``metrics_port`` additionally serves the
+    live ``server.metrics_snapshot()`` JSON over HTTP;
+    ``state_manager`` (serving/state.StateManager) arms snapshot
+    writes — every ``snapshot_interval`` seconds and at shutdown."""
     from ..runtime.errors import classify_error
+    from ..serving.server import ServeDraining
     await server.start()
     answered = {"n": 0}
     done = asyncio.Event()
+    stop = asyncio.Event()
+
+    def _draining_answer(rid):
+        return {"ok": False, "request_id": rid, "draining": True,
+                "error": "ServeDraining: serving loop is draining "
+                         "for shutdown; retry against the next "
+                         "incarnation",
+                "kind": "transient"}
 
     async def handle(reader, writer):
         try:
             while True:
                 line = await reader.readline()
                 if not line:
+                    break
+                if server.draining:
+                    # refuse the connection with the machine-readable
+                    # answer (the reconnecting client backs off and
+                    # resends to the next incarnation), then close it
+                    writer.write((json.dumps(_draining_answer(None))
+                                  + "\n").encode())
+                    await writer.drain()
                     break
                 rid = None
                 try:
@@ -148,6 +213,15 @@ async def serve_forever(server, host: str, port: int,
                                       + "\n").encode())
                         await writer.drain()
                         continue
+                    if isinstance(msg, dict) and msg.get("ready"):
+                        # readiness-gate control request
+                        # (docs/serving_restart.md)
+                        out = {"ok": True, "ready": bool(server.ready),
+                               "draining": server.draining,
+                               "generation": server.restart_generation}
+                        writer.write((json.dumps(out) + "\n").encode())
+                        await writer.drain()
+                        continue
                     if isinstance(msg, dict) and "id" in msg:
                         rid = str(msg["id"])
                     rid, row = await server.score_with_id(
@@ -156,6 +230,11 @@ async def serve_forever(server, host: str, port: int,
                     out = {"ok": True, "request_id": rid, "result": row}
                 except asyncio.CancelledError:
                     raise
+                except ServeDraining:
+                    writer.write((json.dumps(_draining_answer(rid))
+                                  + "\n").encode())
+                    await writer.drain()
+                    break
                 except Exception as e:
                     # a bad request/record answers with the classified
                     # error instead of dropping the connection
@@ -194,6 +273,8 @@ async def serve_forever(server, host: str, port: int,
     http = None
     banner = {"serving": True, "host": host, "port": bound,
               "models": server.plans.names()}
+    if banner_extra:
+        banner.update(banner_extra)
     if metrics_port is not None:
         http = await asyncio.start_server(handle_metrics, host,
                                           metrics_port)
@@ -203,26 +284,75 @@ async def serve_forever(server, host: str, port: int,
     print(json.dumps(banner), flush=True)
     if ready_cb is not None:
         ready_cb(bound)
+
+    # graceful drain on SIGTERM/SIGINT (docs/serving_restart.md) —
+    # only installable on a main-thread loop; in-process test loops
+    # (background threads) skip the handlers and use cancellation
+    loop = asyncio.get_running_loop()
+    sig_installed = []
     try:
-        if max_requests:
-            await done.wait()
-        else:
-            await asyncio.Event().wait()       # until cancelled
-    except asyncio.CancelledError:
+        import signal as _signal
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+            sig_installed.append(sig)
+    except (ValueError, OSError, RuntimeError, NotImplementedError):
         pass
+
+    snap_task = None
+    if state_manager is not None and snapshot_interval:
+        async def _periodic_snapshots():
+            while True:
+                await asyncio.sleep(snapshot_interval)
+                await loop.run_in_executor(None, state_manager.write)
+        snap_task = asyncio.create_task(_periodic_snapshots())
+
+    cancelled = False
+    waiters = [asyncio.ensure_future(stop.wait())]
+    if max_requests:
+        waiters.append(asyncio.ensure_future(done.wait()))
+    try:
+        await asyncio.wait(waiters,
+                           return_when=asyncio.FIRST_COMPLETED)
+    except asyncio.CancelledError:
+        cancelled = True
     finally:
+        for w in waiters:
+            w.cancel()
+    drain_summary = None
+    if not cancelled and stop.is_set():
+        # queued + in-flight requests finish (new ones get the
+        # draining answer) before anything is torn down
+        drain_summary = await server.drain(drain_timeout)
+    try:
+        if state_manager is not None and not cancelled:
+            # final snapshot AFTER the drain: sketches, breakers and
+            # counters include every answered request
+            await loop.run_in_executor(
+                None, lambda: state_manager.write(reason="shutdown"))
+    finally:
+        for sig in sig_installed:
+            try:
+                loop.remove_signal_handler(sig)
+            except (ValueError, RuntimeError):  # pragma: no cover
+                pass
+        if snap_task is not None:
+            snap_task.cancel()
         tcp.close()
         await tcp.wait_closed()
         if http is not None:
             http.close()
             await http.wait_closed()
         await server.shutdown()
-    print(json.dumps({"served": answered["n"],
-                      **server.describe()}, default=float), flush=True)
+    final = {"served": answered["n"], **server.describe()}
+    if drain_summary is not None:
+        final["drain"] = drain_summary
+    print(json.dumps(final, default=float), flush=True)
     return 0
 
 
 def run_serve(args) -> int:
+    if getattr(args, "supervise", False):
+        return run_supervised(args)
     from ..observability import persist_process_profiles, trace
     from ..serving.server import ServeConfig, ServingServer
     from ..utils.jax_setup import pin_platform_from_env
@@ -249,14 +379,118 @@ def run_serve(args) -> int:
     server = ServingServer(config)
     for name, path in _parse_models(args.model):
         server.add_model(name, path)
+    # warm-restart wiring (docs/serving_restart.md). Both flags off =
+    # no StateManager, no snapshot task: behavior identical to before
+    resume_dir = getattr(args, "resume_state", None)
+    write_dir = getattr(args, "state_dir", None) or resume_dir
+    banner_extra = {}
+    if resume_dir:
+        from ..serving.state import StateManager
+        server.ready = False
+        summary = StateManager(server, resume_dir).restore()
+        server.ready = True
+        print(json.dumps({"resume": summary}, default=float),
+              flush=True)
+        banner_extra["resume"] = summary.get("mode", "cold")
+    state_manager = None
+    if write_dir:
+        from ..serving.state import StateManager
+        state_manager = StateManager(server, write_dir)
+        banner_extra["generation"] = server.restart_generation
     try:
         return asyncio.run(serve_forever(
             server, args.host, args.port,
             max_requests=args.max_requests,
-            metrics_port=args.metrics_port))
+            metrics_port=args.metrics_port,
+            drain_timeout=getattr(args, "drain_timeout", 30.0),
+            state_manager=state_manager,
+            snapshot_interval=getattr(args, "snapshot_interval", None),
+            banner_extra=banner_extra))
     finally:
+        # the finally (not the happy path) flushes: a SIGTERM drain,
+        # a crash, and a clean --max-requests exit all persist the
+        # session's traces and measured costs
         trace.flush()
         if os.environ.get("TX_PROFILE_PERSIST") == "1":
             # fold this session's measured section/bucket costs into
             # the persisted profile store (docs/observability.md)
             persist_process_profiles()
+
+
+def run_supervised(args) -> int:
+    """``tx serve --supervise``: a parent that keeps one serving child
+    alive across crashes. Child exit 0 (graceful drain, --max-requests)
+    ends supervision; a crash restarts the child under
+    ``RetryPolicy`` backoff, with ``TX_SERVE_GENERATION`` bumped per
+    incarnation (the metrics ``process.restart_generation``) and the
+    same argv — so ``--resume-state`` hands the snapshot to each new
+    child. A crash-loop breaker gives up after ``--max-restarts``
+    crashes inside ``--restart-window`` seconds (exit 1)."""
+    import collections
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+    from ..runtime.retry import RetryPolicy
+    cmd = [sys.executable, "-m", "transmogrifai_tpu.cli"] + \
+        [a for a in sys.argv[1:] if a != "--supervise"]
+    policy = RetryPolicy.from_env()
+    window = max(float(getattr(args, "restart_window", 60.0)), 0.001)
+    max_restarts = max(int(getattr(args, "max_restarts", 5)), 1)
+    crashes = collections.deque()
+    state = {"child": None, "stopping": False}
+
+    def _forward(signum, _frame):
+        state["stopping"] = True
+        child = state["child"]
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, _forward)
+        except ValueError:  # pragma: no cover - non-main thread
+            pass
+    generation = 0
+    try:
+        while True:
+            generation += 1
+            env = dict(os.environ,
+                       TX_SERVE_GENERATION=str(generation))
+            child = subprocess.Popen(cmd, env=env)
+            state["child"] = child
+            print(json.dumps({"supervisor": "spawned",
+                              "generation": generation,
+                              "pid": child.pid}), flush=True)
+            try:
+                rc = child.wait()
+            except KeyboardInterrupt:  # pragma: no cover
+                state["stopping"] = True
+                rc = child.wait()
+            if state["stopping"] or rc == 0:
+                print(json.dumps({"supervisor": "exit", "code": rc,
+                                  "generation": generation}),
+                      flush=True)
+                return 0 if rc == 0 else rc
+            now = _time.monotonic()
+            crashes.append(now)
+            while crashes and now - crashes[0] > window:
+                crashes.popleft()
+            print(json.dumps({"supervisor": "crashed", "code": rc,
+                              "generation": generation,
+                              "crashes_in_window": len(crashes)}),
+                  flush=True)
+            if len(crashes) >= max_restarts:
+                # crash-loop breaker: restarting is making it worse
+                print(json.dumps({"supervisor": "crash_loop_breaker",
+                                  "crashes": len(crashes),
+                                  "window_seconds": window}),
+                      flush=True)
+                return 1
+            delay = policy.delay_for(len(crashes),
+                                     f"serve-restart:{generation}")
+            _time.sleep(delay)
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)

@@ -88,6 +88,12 @@ RULES: Dict[str, tuple] = {
                       "changes must go through PlanCache.swap_entry / "
                       "rollback / commit so in-flight batches keep a "
                       "consistent entry and rollback stays possible"),
+    "TX-R04": (ERROR, "state-file write in serving/ that bypasses the "
+                      "shared atomic writer: a bare open(path, 'w') to "
+                      "a live (non-.tmp) path can leave a TORN "
+                      "document if the process dies mid-write — write "
+                      "through observability.store.atomic_write_json "
+                      "(tmp file + os.replace)"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

@@ -69,6 +69,16 @@ call) are caught here in milliseconds:
   in a coroutine wedges the coalescer for every tenant at once.
   Nested SYNC functions inside an async def are exempt — that is
   exactly the run_in_executor idiom.
+- TX-R04 torn state-file write (``serving/`` files only): an
+  ``open(path, "w"|"a"|...)`` whose target is a LIVE path — not a
+  ``*.tmp`` staging file — bypasses the repo's shared atomic writer
+  (``observability/store.atomic_write_json``: temp file +
+  ``os.replace``). A process killed mid-write (the exact event the
+  preemption-tolerance stack exists for, docs/serving_restart.md)
+  leaves a torn half-document where a snapshot/fingerprint used to
+  be. Paths that mention ``tmp`` (a ``.tmp`` suffix concatenation, a
+  ``tmp``-named variable, tempfile machinery) are the sanctioned
+  staging idiom and stay legal; reads are untouched.
 - TX-O01 telemetry/trace emission inside a jitted function body:
   ``telemetry.event(...)``/``telemetry.count(...)``, a tracer span
   enter/exit (``trace.span``/``add_span``/``add_event``), or a
@@ -867,6 +877,66 @@ class _Visitor(ast.NodeVisitor):
                     ERROR,
                     hint="await asyncio.sleep(...) instead")
 
+    # -- TX-R04: torn state-file writes in serving/ ------------------------
+    _WRITE_MODES = ("w", "a", "x")
+
+    @staticmethod
+    def _mentions_tmp(expr: ast.AST) -> bool:
+        """True when the path expression's AST carries a tmp marker —
+        a ``tmp``-named variable, a ``.tmp``/tempfile attribute, or a
+        string constant containing ``tmp``. That is the sanctioned
+        staging idiom (write ``path + ".tmp"``, then ``os.replace``):
+        a torn temp file is harmless, the live path flips atomically."""
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and "tmp" in sub.attr.lower():
+                return True
+            if isinstance(sub, ast.Constant) \
+                    and isinstance(sub.value, str) \
+                    and "tmp" in sub.value.lower():
+                return True
+        return False
+
+    def _check_state_file_write(self, node: ast.Call) -> None:
+        """A bare ``open(path, "w")`` to a live path in serving/ code
+        is a torn-state hazard: kill the process mid-write (the exact
+        event the preemption stack exists for) and the snapshot or
+        fingerprint file it was replacing is now half a JSON document.
+        The shared writer (observability/store.atomic_write_json)
+        stages to ``*.tmp`` and ``os.replace``s — the live path is
+        always either the old doc or the new one, never a torn one."""
+        fn = node.func
+        if not (isinstance(fn, ast.Name) and fn.id == "open"):
+            return
+        if not node.args:
+            return
+        mode: Optional[str] = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                mode = kw.value.value
+        if mode is None or not any(m in mode for m in self._WRITE_MODES):
+            return  # read (or unknown) mode: not a state write
+        if self._mentions_tmp(node.args[0]):
+            return  # staging file for an atomic replace — the idiom
+        where = (f" in {self.fn_stack[-1].name!r}"
+                 if self.fn_stack else "")
+        self.add(
+            "TX-R04", node,
+            f"state-file write open(..., {mode!r}){where} targets a "
+            f"live path — a process killed mid-write (preemption, "
+            f"OOM, supervisor restart) leaves a TORN document where "
+            f"a readable one used to be",
+            ERROR,
+            hint="write through observability.store.atomic_write_json "
+                 "(stages to *.tmp, then os.replace — the live path "
+                 "is never half-written)")
+
     # -- TX-O01: telemetry/trace emission inside a jitted body -------------
     _CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "time_ns",
                     "perf_counter_ns", "monotonic_ns"}
@@ -913,6 +983,9 @@ class _Visitor(ast.NodeVisitor):
         # TX-J10: blocking calls inside serving async handlers --------------
         if self.serving and self.in_async:
             self._check_async_blocking(node)
+        # TX-R04: torn state-file writes anywhere under serving/ ------------
+        if self.serving:
+            self._check_state_file_write(node)
         # TX-O01: telemetry/trace/clock inside a jitted body ----------------
         if self.jit_ctx is not None:
             self._check_traced_telemetry(node)

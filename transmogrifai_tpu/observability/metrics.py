@@ -22,7 +22,11 @@ __all__ = ["METRICS_SCHEMA_VERSION", "LatencyHistogram", "ServeMetrics"]
 
 #: bump when the snapshot shape changes (the endpoint's contract)
 #: v2: per-tenant "sentinels" drift state + the "lifecycle" slice
-METRICS_SCHEMA_VERSION = 2
+#: v3: top-level "process" block (uptime, restart generation,
+#:     draining/ready flags, in-flight count, last snapshot age) +
+#:     "plan_compiles" — the restart-drill contract
+#:     (docs/serving_restart.md)
+METRICS_SCHEMA_VERSION = 3
 
 
 class LatencyHistogram:

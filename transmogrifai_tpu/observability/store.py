@@ -32,13 +32,39 @@ import os
 import time
 from typing import Dict, Optional
 
-__all__ = ["ProfileStore", "default_store_path", "gather_process_profiles",
-           "persist_process_profiles"]
+__all__ = ["ProfileStore", "atomic_write_json", "default_store_path",
+           "gather_process_profiles", "persist_process_profiles"]
 
 #: accumulating numeric fields of one profile record; everything else
 #: (``updated``, foreign keys) overwrites on merge
 _ACCUMULATE = ("calls", "wall_seconds", "compile_seconds",
                "execute_seconds", "rows")
+
+
+def atomic_write_json(path: str, doc: dict, *, indent: int = 1,
+                      fsync: bool = False) -> bool:
+    """THE shared state-file writer (lint rule TX-R04 enforces its use
+    in ``serving/``): serialize ``doc`` to ``path + ".tmp"``, then
+    ``os.replace`` onto the live path, so a concurrent reader never
+    sees a torn document and a crashed writer leaves the previous
+    state intact. Returns False (after cleaning up the temp file)
+    instead of raising on an unwritable target."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=indent, sort_keys=True)
+            fh.write("\n")
+            if fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        return True
+    except OSError:  # pragma: no cover - read-only checkout
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
 
 
 def default_store_path() -> str:
@@ -69,19 +95,7 @@ class ProfileStore:
             return {}
 
     def _write(self, state: dict) -> bool:
-        tmp = self.path + ".tmp"
-        try:
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(state, fh, indent=1, sort_keys=True)
-                fh.write("\n")
-            os.replace(tmp, self.path)
-            return True
-        except OSError:  # pragma: no cover - read-only checkout
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return False
+        return atomic_write_json(self.path, state)
 
     # -- probe verdicts (bench ambient-backend health) ---------------------
     def record_probe(self, key: str, healthy: bool, note: str,

@@ -20,7 +20,9 @@ with the grammar ``scope:name:site:n=fault``:
   fused-program dispatch), ``serving`` (the guardrail layer,
   docs/serving_guardrails.md), ``lifecycle`` (the self-healing
   retrain/swap loop, docs/self_healing.md; name = the registered model
-  name).
+  name), ``state`` (the warm-restart snapshot path,
+  docs/serving_restart.md; name = the registered model name or
+  ``server``).
 - ``name``   — exact match or ``*``.
 - ``site``   — where the probe sits: ``dispatch`` (per-family device
   eval or the serving plan's fused-program dispatch, once per retry
@@ -33,7 +35,13 @@ with the grammar ``scope:name:site:n=fault``:
   retry-then-quarantine with the old model still serving), ``canary``
   (candidate shadow-scoring — any fault rejects the candidate), and
   ``postswap`` (probed on each watched batch after a hot-swap — a
-  fault there triggers the instant rollback drill).
+  fault there triggers the instant rollback drill), and the
+  warm-restart pair ``snapshot`` (``state:<model>:snapshot`` — probed
+  before each serving-state snapshot write; a ``torn`` fault truncates
+  the document mid-write so the restore side's torn-tail detection is
+  drillable) and ``restore`` (``state:<model>:restore`` — probed while
+  rebuilding warm state on ``--resume-state`` boot; any fault must
+  degrade to a clean cold start, never a crash).
 - ``n``      — fire at the Nth matching probe (1-based), or ``*`` for
   every one.
 - ``fault``  — ``oom`` (RESOURCE_EXHAUSTED-shaped — transient, then
@@ -41,7 +49,9 @@ with the grammar ``scope:name:site:n=fault``:
   transient), ``bug`` (non-transient InjectedFamilyBug), ``kill``
   (:class:`KillPoint` — simulated process death, a BaseException the
   quarantine layer deliberately does NOT absorb), ``nan`` (poison the
-  metric matrix), ``hang:<seconds>`` (sleep — the deadline test).
+  metric matrix), ``torn`` (the snapshot writer truncates the
+  document mid-serialization — a simulated crash between write and
+  rename), ``hang:<seconds>`` (sleep — the deadline test).
 
 Activate with the context manager (tests) or ``TX_FAULT_PLAN`` (bench,
 reproducing a field failure)::
@@ -109,7 +119,7 @@ class _Rule:
     name: str        # exact or "*"
     site: str
     nth: Optional[int]   # None = every occurrence
-    fault: str           # "oom"|"preempt"|"bug"|"kill"|"nan"|"hang:<s>"
+    fault: str   # "oom"|"preempt"|"bug"|"kill"|"nan"|"torn"|"hang:<s>"
 
 
 def _parse_plan(text: str) -> List[_Rule]:
@@ -161,8 +171,9 @@ class FaultInjector:
     # -- the probe ---------------------------------------------------------
     def check(self, scope: str, name: str, site: str) -> Optional[str]:
         """Count this probe occurrence; fire the first matching rule.
-        Raising faults raise; ``nan`` returns ``"nan"`` for the caller
-        to poison its metrics; ``hang`` sleeps then returns None."""
+        Raising faults raise; ``nan``/``torn`` return their own name
+        for the caller to poison its metrics / tear its write;
+        ``hang`` sleeps then returns None."""
         with self._lock:
             key = (scope, name, site)
             self._counts[key] = n = self._counts.get(key, 0) + 1
@@ -186,6 +197,8 @@ class FaultInjector:
             raise KillPoint(where)
         if rule.fault == "nan":
             return "nan"
+        if rule.fault == "torn":
+            return "torn"
         if rule.fault.startswith("hang"):
             _, _, secs = rule.fault.partition(":")
             time.sleep(float(secs or "60"))

@@ -7,7 +7,9 @@ loop that coalesces live requests into compiled bucket dispatches
 under latency SLOs (docs/serving_loop.md), a reconnecting TCP client,
 and a self-healing model lifecycle — drift-triggered background
 retraining with canary validation, atomic hot-swap, and instant
-rollback (docs/self_healing.md)."""
+rollback (docs/self_healing.md) — plus preemption tolerance: graceful
+drain on SIGTERM and a warm-state snapshot that a restart restores
+behind a readiness gate (docs/serving_restart.md)."""
 from .client import ServingUnavailable, TcpServingClient
 from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
                     GuardedScoreResult, GuardReason, OutputGuard,
@@ -19,8 +21,11 @@ from .sentinel import (DriftSentinel, DriftThresholds,
                        FeatureFingerprint, FingerprintSchemaError,
                        compute_fingerprints, load_fingerprint_doc,
                        load_fingerprints, save_fingerprints)
-from .server import (PlanCache, ServeConfig, ServeRejected,
-                     ServingClient, ServingServer, serve_in_process)
+from .server import (PlanCache, ServeConfig, ServeDraining,
+                     ServeRejected, ServingClient, ServingServer,
+                     serve_in_process)
+from .state import (SNAPSHOT_SCHEMA, ServingStateSnapshot,
+                    StateManager)
 
 __all__ = ["ScoringPlan", "EncodedScoreBatch", "PlanCoverage",
            "PlanCompileError", "plan_compiles", "bucket_for",
@@ -32,6 +37,7 @@ __all__ = ["ScoringPlan", "EncodedScoreBatch", "PlanCoverage",
            "save_fingerprints", "load_fingerprints",
            "load_fingerprint_doc",
            "ServeConfig", "ServingServer", "ServingClient", "PlanCache",
-           "ServeRejected", "serve_in_process",
+           "ServeRejected", "ServeDraining", "serve_in_process",
            "LifecycleConfig", "ModelLifecycle",
+           "ServingStateSnapshot", "StateManager", "SNAPSHOT_SCHEMA",
            "TcpServingClient", "ServingUnavailable"]

@@ -14,7 +14,13 @@ What it does NOT do: retry a request the server ANSWERED with an
 error. An ``{"ok": false}`` response is an application verdict
 (schema rejection, breaker open, ...) and is returned to the caller —
 only transport failures (connection refused/reset, truncated stream)
-trigger reconnect + resend.
+trigger reconnect + resend. The single exception is the
+machine-readable ``{"ok": false, "draining": true}`` answer a
+gracefully-stopping server sends (docs/serving_restart.md): that is a
+"retry against the next incarnation" instruction, not a verdict on
+the request, so the client closes, backs off, and resends — which is
+what makes a rolling restart invisible to callers
+(``serve_client_drain_retries`` counts them).
 
 >>> with TcpServingClient("127.0.0.1", 8190) as client:
 ...     row = client.score({"x": 1.0}, model="m")
@@ -111,7 +117,8 @@ class TcpServingClient:
         """One request/response round trip. A transport failure closes
         the socket, reconnects under backoff, and RESENDS; an answered
         ``{"ok": false}`` is returned as-is (application errors are not
-        transport errors)."""
+        transport errors) — EXCEPT the ``"draining"`` answer, which is
+        the server telling us to come back after its restart."""
         line = json.dumps(payload, default=float) + "\n"
         last: Optional[Exception] = None
         for attempt in range(1, self.retry.max_attempts + 1):
@@ -122,7 +129,12 @@ class TcpServingClient:
                 if not answer:
                     raise ConnectionError(
                         "server closed the connection mid-request")
-                return json.loads(answer)
+                doc = json.loads(answer)
+                if isinstance(doc, dict) and doc.get("draining"):
+                    _telemetry.count("serve_client_drain_retries")
+                    raise ConnectionError(
+                        "server is draining for restart")
+                return doc
             except (OSError, ConnectionError, json.JSONDecodeError) as e:
                 last = e
                 self._close()

@@ -127,6 +127,10 @@ class ModelLifecycle:
         self._cooldown_until: Dict[Tuple[str, str], float] = {}
         self._specs: Dict[str, RefitSpec] = {}
         self._generations = itertools.count(1)
+        #: high-water mark of issued generations — serialized by the
+        #: warm-restart snapshot so a restarted process keeps counting
+        #: where this one stopped (serving/state.py)
+        self.last_generation = 0
         #: the retry/quarantine runtime the refits run under; failed
         #: retrains land in its quarantine ledger
         self.runtime = RuntimeContext()
@@ -181,7 +185,7 @@ class ModelLifecycle:
                 return
             self._states[key] = ST_RETRAINING
         name, tenant = key
-        gen = next(self._generations)
+        gen = self.last_generation = next(self._generations)
         self._note("detect", counter="lifecycle_detect", model=name,
                    tenant=tenant, generation=gen)
         entry = self.server.plans.entry_for(name, tenant)
@@ -422,6 +426,10 @@ class ModelLifecycle:
         self._note("commit", counter="lifecycle_commits", model=name,
                    tenant=tenant, generation=watch["generation"])
         self._finish(key, "healthy")
+        # a committed swap is a durable lifecycle decision: persist it
+        # so a restart resumes with the new generation, not the old
+        if getattr(self.server, "state_manager", None) is not None:
+            self.server.state_manager.write(reason="lifecycle-commit")
 
     def _finish(self, key: Tuple[str, str], outcome: str) -> None:
         _log.info("lifecycle cycle for %s/%s finished: %s", key[0],
@@ -455,6 +463,41 @@ class ModelLifecycle:
                     self.runtime.quarantined_families()),
                 "history": list(self.history),
             }
+
+    # -- warm-restart serialization (serving/state.py) ---------------------
+    def state_dict(self) -> dict:
+        """The restartable slice of lifecycle state: the generation
+        high-water mark, per-lane cooldown time REMAINING (monotonic
+        clocks do not survive a process), and the transition history.
+        In-flight heal cycles are deliberately not serialized — a
+        retrain that dies with the process re-arms from the sentinel
+        signal, which IS restored."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "generation": self.last_generation,
+                "cooldownRemaining": {
+                    "/".join(k): round(max(until - now, 0.0), 3)
+                    for k, until in self._cooldown_until.items()
+                    if until > now},
+                "history": list(self.history),
+            }
+
+    def load_state(self, d: dict) -> None:
+        gen = int(d.get("generation", 0))
+        now = time.monotonic()
+        with self._lock:
+            if gen > 0:
+                self.last_generation = gen
+                self._generations = itertools.count(gen + 1)
+            for lane, remaining in (d.get("cooldownRemaining")
+                                    or {}).items():
+                name, _, tenant = lane.partition("/")
+                self._cooldown_until[(name, tenant)] = (
+                    now + float(remaining))
+            for rec in d.get("history") or []:
+                if isinstance(rec, dict):
+                    self.history.append(rec)
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

@@ -180,6 +180,24 @@ class _Sketch:
     def fill_rate(self) -> float:
         return 1.0 - self.nulls / self.rows if self.rows else 0.0
 
+    # -- warm-restart serialization (serving/state.py) ---------------------
+    def to_state(self) -> dict:
+        return {
+            "rows": self.rows, "nulls": self.nulls,
+            "counts": self.counts.tolist(),
+            "histogram": (self.histogram.to_json()
+                          if self.histogram is not None else None),
+        }
+
+    def load_state(self, d: dict) -> None:
+        self.rows = int(d.get("rows", 0))
+        self.nulls = int(d.get("nulls", 0))
+        counts = np.asarray(d.get("counts", []), dtype=np.float64)
+        if counts.size == self.counts.size:
+            self.counts = counts
+        if d.get("histogram") and self.fp.is_numeric:
+            self.histogram = StreamingHistogram.from_json(d["histogram"])
+
 
 # ---------------------------------------------------------------------------
 # fingerprint computation + persistence
@@ -231,15 +249,15 @@ def save_fingerprints(fingerprints: Sequence[FeatureFingerprint],
     to (0 = the original offline train; each lifecycle hot-swap bumps
     it) — a loaded sentinel carries it so operators can tell which
     model generation the drift numbers compare against."""
+    from ..observability.store import atomic_write_json
     path = os.path.join(model_dir, DRIFT_FINGERPRINTS_FILE)
-    with open(path, "w") as fh:
-        json.dump({"formatVersion": FINGERPRINT_FORMAT_VERSION,
-                   "schema": FINGERPRINT_SCHEMA,
-                   "trainedAt": int(trained_at),
-                   "features": [fp.to_json() for fp in fingerprints]},
-                  fh)
-        fh.flush()
-        os.fsync(fh.fileno())
+    atomic_write_json(
+        path,
+        {"formatVersion": FINGERPRINT_FORMAT_VERSION,
+         "schema": FINGERPRINT_SCHEMA,
+         "trainedAt": int(trained_at),
+         "features": [fp.to_json() for fp in fingerprints]},
+        indent=0, fsync=True)
     return path
 
 
@@ -392,6 +410,36 @@ class DriftSentinel:
                 _telemetry.event("drift", feature=name,
                                  status=status, js=round(js, 4),
                                  rows=sketch.rows)
+
+    # -- warm-restart serialization (serving/state.py) ---------------------
+    def state_dict(self) -> dict:
+        """Everything a restarted serving process needs to continue
+        drift detection where this one left off: the serve-side
+        sketches, the rows-seen counter, the per-feature escalation
+        high-water marks, and the fingerprint generation. The training
+        fingerprints themselves are NOT serialized — they reload from
+        the model dir, so a snapshot never overrides them."""
+        return {
+            "rowsSeen": self.rows_seen,
+            "generation": self.generation,
+            "reported": dict(self._reported),
+            "sketches": {name: sk.to_state()
+                         for name, sk in self._sketches.items()},
+        }
+
+    def load_state(self, d: dict) -> None:
+        """Restore serve-side sketches from :meth:`state_dict`.
+        Features present in the snapshot but absent from the current
+        fingerprints (the model changed between incarnations) are
+        dropped silently — the fingerprints on disk are authoritative."""
+        self.rows_seen = int(d.get("rowsSeen", 0))
+        self.generation = int(d.get("generation", self.generation))
+        self._reported = {str(k): str(v)
+                          for k, v in (d.get("reported") or {}).items()}
+        for name, state in (d.get("sketches") or {}).items():
+            sketch = self._sketches.get(name)
+            if sketch is not None:
+                sketch.load_state(state)
 
     # -- reporting ---------------------------------------------------------
     def drift_report(self) -> dict:

@@ -53,6 +53,7 @@ import asyncio
 import collections
 import concurrent.futures as _cf
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -74,15 +75,28 @@ from .plan import EncodedScoreBatch, ScoringPlan
 _log = logging.getLogger(__name__)
 
 __all__ = ["ServeConfig", "ServingServer", "ServingClient", "PlanCache",
-           "ServeRejected", "serve_in_process"]
+           "ServeRejected", "ServeDraining", "serve_in_process"]
 
 #: coalescer target when no bucket profile has been recorded yet
 _DEFAULT_TARGET = 64
+
+#: raw admitted records retained per model for the warm-restart
+#: snapshot's prewarm manifest (serving/state.py) — enough to cycle
+#: into any recorded bucket, small enough to serialize
+_SAMPLE_RING = 8
 
 
 class ServeRejected(RuntimeError):
     """A request was refused before scoring (queue over its
     backpressure limit, unknown model, or server shutdown)."""
+
+
+class ServeDraining(ServeRejected):
+    """The loop is draining toward a graceful shutdown: queued and
+    in-flight requests will still be answered, but NEW requests are
+    refused with a machine-readable ``"draining"`` answer so a
+    reconnecting client (serving/client.py) retries against the next
+    incarnation instead of counting a failure."""
 
 
 @dataclass
@@ -322,6 +336,26 @@ class PlanCache:
         """Live tenant-scoped overrides (metrics/introspection)."""
         return dict(self._overrides)
 
+    def resident_entries(self) -> List[Tuple[Tuple, _CacheEntry]]:
+        """Resident (key, entry) pairs, LRU first (introspection +
+        the warm-restart snapshot, serving/state.py)."""
+        return list(self._entries.items())
+
+    def touch(self, name: str,
+              buckets: Tuple[int, int] = (None, None)) -> bool:
+        """LRU-bump a resident entry without resolving it (no
+        hit/miss accounting) — how a warm restart replays the
+        snapshot's recorded LRU order (serving/state.py)."""
+        key = (name, buckets)
+        if key not in self._entries:
+            return False
+        self._entries.move_to_end(key)
+        return True
+
+    def lru_order(self) -> List[Tuple[str, Tuple[int, int]]]:
+        """Resident entry keys, least-recently-used first."""
+        return list(self._entries.keys())
+
 
 class _Lane:
     """One (model, tenant) coalescing queue + its collector task."""
@@ -406,6 +440,27 @@ class ServingServer:
         }
         self._first_dispatch_at: Optional[float] = None
         self._last_dispatch_at: Optional[float] = None
+        #: graceful-drain + warm-restart process state
+        #: (docs/serving_restart.md)
+        self._draining = False
+        self._inflight = 0
+        self._drain_event: Optional[asyncio.Event] = None
+        #: readiness gate: False while a --resume-state boot is still
+        #: restoring/prewarming; the TCP front end answers the
+        #: {"ready": true} control request from this flag
+        self.ready = True
+        #: which restart of this serving identity we are (the
+        #: --supervise parent bumps TX_SERVE_GENERATION per incarnation)
+        self.restart_generation = int(
+            os.environ.get("TX_SERVE_GENERATION", "0") or 0)
+        #: wall-clock time of the last successful state snapshot, and
+        #: the manager that writes them (attached by cli/serve.py when
+        #: --state-dir/--resume-state is on; None = feature off)
+        self.last_snapshot_at: Optional[float] = None
+        self.state_manager = None
+        #: per-model ring of recently admitted raw records — the
+        #: snapshot's prewarm rows (serving/state.py)
+        self._sample_records: Dict[str, "collections.deque"] = {}
         #: self-healing lifecycle manager — None unless
         #: ``config.lifecycle`` is an enabled LifecycleConfig
         self.lifecycle = None
@@ -468,6 +523,11 @@ class ServingServer:
         dispatch -> reply, so one request's wait/batch/device time is
         attributable end to end. The TCP front end echoes it in every
         response line (cli/serve.py)."""
+        if self._draining:
+            _telemetry.count("serve_draining_rejections")
+            raise ServeDraining(
+                "serving loop is draining for shutdown; retry against "
+                "the next incarnation")
         if not self._running:
             raise ServeRejected("serving loop is not running")
         name = model or self._default_model
@@ -490,7 +550,13 @@ class ServingServer:
             lane.wakeup.set()               # lane was idle: start timer
         if len(lane.queue) >= lane.target:
             lane.full.set()                 # bucket filled: fire early
-        return req.rid, await req.future
+        self._inflight += 1
+        try:
+            return req.rid, await req.future
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0 and self._drain_event is not None:
+                self._drain_event.set()
 
     def _lane(self, model_name: str, tenant: str) -> _Lane:
         key = (model_name, tenant)
@@ -616,6 +682,11 @@ class ServingServer:
                 qmask[r.row] = True
         enc = entry.plan.encode_raw_dataset(
             ds, valid_mask=(~qmask).astype(np.float64))
+        ring = self._sample_records.get(lane.model_name)
+        if ring is None:
+            ring = self._sample_records[lane.model_name] = \
+                collections.deque(maxlen=_SAMPLE_RING)
+        ring.extend(r for i, r in enumerate(records) if not qmask[i])
         marks["encode_t1"] = time.monotonic()
         return _PreparedBatch(entry=entry, guards=guards, requests=batch,
                               enc=enc, ds=ds, quarantined=quarantined,
@@ -861,6 +932,41 @@ class ServingServer:
         self._dispatch_sem = asyncio.Semaphore(1)
         self._running = True
 
+    async def drain(self, timeout: float = 30.0) -> dict:
+        """Graceful-shutdown half of preemption tolerance
+        (docs/serving_restart.md): flip the loop to DRAINING — new
+        requests refuse with :class:`ServeDraining` (the TCP front end
+        turns that into the machine-readable ``"draining"`` answer) —
+        then wait up to ``timeout`` seconds for every queued and
+        in-flight request to resolve. Returns ``{"drained", "inflight",
+        "seconds"}``; ``drained`` False means the deadline fired with
+        requests still outstanding (they fail at :meth:`shutdown`)."""
+        t0 = time.monotonic()
+        self._draining = True
+        self._drain_event = asyncio.Event()
+        _telemetry.count("serve_drains")
+        _telemetry.event("serve_draining", inflight=self._inflight)
+        if self._inflight == 0:
+            self._drain_event.set()
+        try:
+            await asyncio.wait_for(self._drain_event.wait(), timeout)
+            drained = True
+        except asyncio.TimeoutError:
+            drained = False
+            _telemetry.count("serve_drain_timeouts")
+        out = {"drained": drained, "inflight": self._inflight,
+               "seconds": round(time.monotonic() - t0, 4)}
+        _telemetry.event("serve_drained", **out)
+        return out
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
     async def shutdown(self) -> None:
         self._running = False
         for lane in self._lanes.values():
@@ -940,6 +1046,22 @@ class ServingServer:
             "lanes": sorted("/".join(k) for k in self._lanes),
         }
 
+    def process_block(self) -> dict:
+        """The ``process`` slice of :meth:`metrics_snapshot`: this
+        incarnation's identity and restart-readiness state — what a
+        supervisor, load balancer, or the bench restart drill polls.
+        Field set is pinned by tests (schema version 3)."""
+        return {
+            "uptime_seconds": round(self.metrics.uptime_seconds(), 3),
+            "restart_generation": self.restart_generation,
+            "draining": self._draining,
+            "ready": bool(self.ready),
+            "inflight": self._inflight,
+            "last_snapshot_age_seconds": (
+                round(max(time.time() - self.last_snapshot_at, 0.0), 3)
+                if self.last_snapshot_at is not None else None),
+        }
+
     def metrics_snapshot(self) -> dict:
         """The LIVE metrics document (schema versioned,
         docs/observability.md): loop counters, per-tenant latency
@@ -952,6 +1074,7 @@ class ServingServer:
         dict reads + fixed-bin quantile interpolation, no device work,
         no I/O."""
         from ..observability.metrics import METRICS_SCHEMA_VERSION
+        from .plan import plan_compiles
         breakers = {}
         sentinels = {}
         live = [(name, entry) for (name, _buckets), entry
@@ -991,6 +1114,7 @@ class ServingServer:
             "schema": METRICS_SCHEMA_VERSION,
             "uptime_seconds": round(self.metrics.uptime_seconds(), 3),
             "running": self._running,
+            "process": self.process_block(),
             "requests": int(self.stats["requests"]),
             "answered": self.metrics.answered,
             "failed_batches": self.metrics.failed,
@@ -1011,6 +1135,7 @@ class ServingServer:
                            "hits": self.plans.hits,
                            "misses": self.plans.misses,
                            "evictions": self.plans.evictions},
+            "plan_compiles": plan_compiles(),
             "breakers": breakers,
             "sentinels": sentinels,
             "lifecycle": (self.lifecycle.snapshot()

@@ -1,0 +1,355 @@
+"""Warm-restart state snapshot: preemption tolerance for the serving
+process (docs/serving_restart.md).
+
+The serving loop accumulates expensive, purely-derived state — compiled
+bucket programs, per-tenant drift sketches, breaker states, lifecycle
+generations — that a SIGTERM or preemption throws away, forcing a cold
+restart that recompiles the world before the first reply. This module
+makes that state durable without making it authoritative:
+
+- :class:`ServingStateSnapshot` captures the live server into one
+  schema-versioned JSON document: the model-zoo manifest (which saved
+  model dirs are registered), the per-model WARM-BUCKET manifest (which
+  bucket programs this incarnation actually compiled, from
+  ``bucket_profile()``, plus a small ring of admitted records to replay
+  into them), per-(model, tenant) sentinel sketches + generations,
+  breaker states, plan-cache LRU order, the lifecycle slice, and
+  telemetry high-water marks.
+- :class:`StateManager` writes the snapshot through the shared atomic
+  tmp+``os.replace`` writer (``observability/store.atomic_write_json``,
+  lint rule TX-R04) — periodically, at lifecycle commits, and at the
+  end of a graceful drain — and restores it on a ``tx serve
+  --resume-state`` boot BEFORE the TCP port binds: the recorded buckets
+  are re-compiled and pre-warmed behind the readiness gate, so steady
+  state after a warm restart pays ZERO compiles.
+
+A torn, unreadable, or schema-mismatched snapshot is a loud telemetry
+event (``serving_state_*``) followed by a clean COLD start — never a
+crash, never a silent partial restore (any mid-restore failure rolls
+the decision back to cold). Fault drills: ``TX_FAULT_PLAN``
+``state:<model>:snapshot`` / ``state:<model>:restore`` scopes, with the
+``torn`` fault truncating the written document mid-serialization
+(runtime/faults.py).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..observability.store import atomic_write_json
+from ..runtime import telemetry as _telemetry
+from ..runtime.errors import classify_error
+from ..runtime.faults import maybe_inject
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ServingStateSnapshot", "StateManager", "SNAPSHOT_SCHEMA",
+           "SNAPSHOT_FILE"]
+
+#: schema identity of the snapshot document; a restore refuses any
+#: other schema (cold start + telemetry, never a guess)
+SNAPSHOT_SCHEMA = "tx-serving-state/1"
+SNAPSHOT_FILE = "serving-state.json"
+
+#: telemetry counter prefixes worth carrying across incarnations —
+#: the serving slice metrics_snapshot() reports
+_COUNTER_PREFIXES = ("serve_", "serving_", "breaker_", "drift_",
+                     "lifecycle_")
+
+
+def _jsonable(records: List[dict]) -> List[dict]:
+    """Only records that round-trip through JSON belong in the
+    snapshot (in-process callers may enqueue exotic values; the TCP
+    path is JSON-native by construction)."""
+    out = []
+    for r in records:
+        try:
+            out.append(json.loads(json.dumps(r)))
+        except (TypeError, ValueError):
+            _telemetry.count("serving_state_sample_drops")
+            continue
+    return out
+
+
+@dataclass
+class ServingStateSnapshot:
+    """One incarnation's restorable warm state. ``capture`` reads the
+    live server; ``restore`` replays the document into a fresh one."""
+    written_at: float = 0.0
+    restart_generation: int = 0
+    #: name -> {dir, warm_buckets, bucket_range, samples, tenants}
+    models: Dict[str, dict] = field(default_factory=dict)
+    #: "model/tenant" -> DriftSentinel.state_dict()
+    sentinels: Dict[str, dict] = field(default_factory=dict)
+    #: "model/tenant" -> {state, consecutiveFailures,
+    #:                    openRemainingSeconds}
+    breakers: Dict[str, dict] = field(default_factory=dict)
+    #: resident plan-cache model names, least-recently-used first
+    lru: List[str] = field(default_factory=list)
+    #: ModelLifecycle.state_dict() (None when lifecycle is off)
+    lifecycle: Optional[dict] = None
+    #: telemetry counter high-water marks (serving slice)
+    counters: Dict[str, int] = field(default_factory=dict)
+    answered: int = 0
+
+    # -- capture -----------------------------------------------------------
+    @classmethod
+    def capture(cls, server) -> "ServingStateSnapshot":
+        snap = cls(written_at=time.time(),
+                   restart_generation=server.restart_generation)
+        for (name, _buckets), entry in server.plans.resident_entries():
+            plan = entry.plan
+            warm = sorted(b for b, rec in plan.bucket_profile().items()
+                          if rec.get("calls", 0) > 0)
+            samples = _jsonable(
+                list(server._sample_records.get(name, ())))
+            loader = server.plans._loaders.get(name)
+            snap.models[name] = {
+                "dir": loader if isinstance(loader, str) else None,
+                "warm_buckets": warm,
+                "bucket_range": [plan.min_bucket, plan.max_bucket],
+                "samples": samples,
+                "tenants": sorted(entry.guards),
+            }
+            if name not in snap.lru:
+                snap.lru.append(name)
+            for tenant, guards in list(entry.guards.items()):
+                lane = f"{name}/{tenant}"
+                if guards.sentinel is not None:
+                    snap.sentinels[lane] = guards.sentinel.state_dict()
+                br = guards.breaker
+                if br is not None:
+                    remaining = 0.0
+                    if br.state == br.OPEN and br.opened_at is not None:
+                        remaining = max(
+                            br.cooldown_seconds
+                            - (br.clock() - br.opened_at), 0.0)
+                    snap.breakers[lane] = {
+                        "state": br.state,
+                        "consecutiveFailures": br.consecutive_failures,
+                        "openRemainingSeconds": round(remaining, 3),
+                    }
+        if server.lifecycle is not None:
+            snap.lifecycle = server.lifecycle.state_dict()
+        snap.counters = {
+            k: int(v) for k, v in _telemetry.counters().items()
+            if k.startswith(_COUNTER_PREFIXES)}
+        snap.answered = int(server.metrics.answered)
+        return snap
+
+    def to_json(self) -> dict:
+        return {
+            "schema": SNAPSHOT_SCHEMA,
+            "writtenAt": self.written_at,
+            "restartGeneration": self.restart_generation,
+            "models": self.models,
+            "sentinels": self.sentinels,
+            "breakers": self.breakers,
+            "lru": self.lru,
+            "lifecycle": self.lifecycle,
+            "counters": self.counters,
+            "answered": self.answered,
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ServingStateSnapshot":
+        return cls(
+            written_at=float(doc.get("writtenAt", 0.0)),
+            restart_generation=int(doc.get("restartGeneration", 0)),
+            models={str(k): dict(v)
+                    for k, v in (doc.get("models") or {}).items()},
+            sentinels=dict(doc.get("sentinels") or {}),
+            breakers=dict(doc.get("breakers") or {}),
+            lru=[str(n) for n in doc.get("lru") or []],
+            lifecycle=doc.get("lifecycle"),
+            counters={str(k): int(v) for k, v in
+                      (doc.get("counters") or {}).items()},
+            answered=int(doc.get("answered", 0)))
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, server) -> dict:
+        """Replay this snapshot into ``server`` (blocking — call
+        BEFORE the port binds, behind the readiness gate). Raises on
+        any inconsistency; :meth:`StateManager.restore` catches and
+        degrades to cold. Returns the warm-boot summary."""
+        from .server import _TenantGuards
+        from .plan import plan_compiles
+        compiles0 = plan_compiles()
+        warmed: Dict[str, List[int]] = {}
+        for name, mdoc in self.models.items():
+            if name not in server.plans._loaders:
+                mdir = mdoc.get("dir")
+                if mdir and os.path.isdir(mdir):
+                    server.add_model(name, mdir)
+                else:
+                    _telemetry.event("serving_state_model_skipped",
+                                     model=name,
+                                     reason="unregistered in-memory "
+                                            "model")
+                    continue
+            entry = server.plans.get(name)
+            samples = list(mdoc.get("samples") or []) or [{}]
+            buckets = [int(b) for b in mdoc.get("warm_buckets") or []]
+            for bucket in sorted(buckets):
+                batch = list(itertools.islice(
+                    itertools.cycle(samples), bucket))
+                entry.plan.score(batch)
+            warmed[name] = sorted(buckets)
+            for tenant in mdoc.get("tenants") or []:
+                if tenant not in entry.guards:
+                    entry.guards[tenant] = _TenantGuards(
+                        entry.model, server.config)
+        lanes = 0
+        for lane, state in self.sentinels.items():
+            guards = self._lane_guards(server, lane)
+            if guards is not None and guards.sentinel is not None:
+                guards.sentinel.load_state(state)
+                lanes += 1
+        for lane, bstate in self.breakers.items():
+            guards = self._lane_guards(server, lane)
+            if guards is None or guards.breaker is None:
+                continue
+            br = guards.breaker
+            st = bstate.get("state", br.CLOSED)
+            if st in (br.CLOSED, br.OPEN, br.HALF_OPEN):
+                br.state = st
+            br.consecutive_failures = int(
+                bstate.get("consecutiveFailures", 0))
+            if br.state == br.OPEN:
+                remaining = float(
+                    bstate.get("openRemainingSeconds", 0.0))
+                br.opened_at = (br.clock()
+                                - max(br.cooldown_seconds - remaining,
+                                      0.0))
+        for name in self.lru:
+            server.plans.touch(name)
+        if self.lifecycle is not None and server.lifecycle is not None:
+            server.lifecycle.load_state(self.lifecycle)
+        for k, v in self.counters.items():
+            if v > 0:
+                _telemetry.count(k, v)
+        server.metrics.answered += self.answered
+        server.last_snapshot_at = self.written_at
+        return {"mode": "warm", "restored": True,
+                "models": sorted(warmed),
+                "warm_buckets": warmed,
+                "sentinel_lanes": lanes,
+                "breaker_lanes": len(self.breakers),
+                "compiles": plan_compiles() - compiles0,
+                "written_at": self.written_at}
+
+    @staticmethod
+    def _lane_guards(server, lane: str):
+        name, _, tenant = lane.partition("/")
+        key = (name, (None, None))
+        entry = server.plans._entries.get(key)
+        if entry is None:
+            return None
+        return entry.guards.get(tenant)
+
+
+class StateManager:
+    """Owns the snapshot file of one serving process: where it lives,
+    when it is written, and how a boot restores it."""
+
+    def __init__(self, server, state_dir: str):
+        self.server = server
+        self.state_dir = state_dir
+        self.path = os.path.join(state_dir, SNAPSHOT_FILE)
+        os.makedirs(state_dir, exist_ok=True)
+        server.state_manager = self
+
+    def _probe_name(self) -> str:
+        return getattr(self.server, "_default_model", None) or "server"
+
+    # -- write path --------------------------------------------------------
+    def write(self, reason: str = "periodic") -> bool:
+        """Capture + atomically persist. The ``state:<model>:snapshot``
+        probe sits between capture and write: a ``torn`` fault
+        truncates the serialized document onto the live path (the
+        crash-mid-write drill); raising faults propagate (a ``kill``
+        here dies exactly where a preemption would)."""
+        snap = ServingStateSnapshot.capture(self.server)
+        doc = snap.to_json()
+        fault = maybe_inject("state", self._probe_name(), "snapshot")
+        if fault == "torn":
+            text = json.dumps(doc)
+            self._write_torn(text[:max(len(text) // 2, 1)])
+            _telemetry.count("serving_state_torn_writes")
+            _telemetry.event("serving_state_torn_write",
+                             path=self.path, reason=reason)
+            return False
+        ok = atomic_write_json(self.path, doc)
+        if ok:
+            self.server.last_snapshot_at = snap.written_at
+            _telemetry.count("serve_state_snapshots")
+            _telemetry.event("serve_state_snapshot", reason=reason,
+                             models=len(snap.models))
+        return ok
+
+    def _write_torn(self, text: str) -> None:
+        # the torn DRILL still goes tmp -> os.replace (TX-R04): what
+        # is being simulated is a crash mid-serialization, i.e. a
+        # truncated document at the live path — not a torn rename
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        os.replace(tmp, self.path)
+
+    # -- restore path ------------------------------------------------------
+    def restore(self) -> dict:
+        """Best-effort warm boot. Every failure mode — missing file,
+        torn JSON, schema mismatch, injected restore fault, any
+        exception while replaying — lands on the same answer: a loud
+        telemetry event and ``{"mode": "cold"}``. Never raises."""
+        if not os.path.exists(self.path):
+            return {"mode": "cold", "restored": False,
+                    "reason": "no snapshot"}
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            _telemetry.count("serving_state_torn")
+            _telemetry.event("serving_state_torn", path=self.path,
+                             error=f"{type(e).__name__}: {e}")
+            _log.warning("serving state snapshot at %s is torn/"
+                         "unreadable (%s); cold start", self.path, e)
+            return {"mode": "cold", "restored": False,
+                    "reason": "torn snapshot"}
+        schema = doc.get("schema") if isinstance(doc, dict) else None
+        if schema != SNAPSHOT_SCHEMA:
+            _telemetry.count("serving_state_schema_mismatch")
+            _telemetry.event("serving_state_schema_mismatch",
+                             path=self.path, found=str(schema),
+                             expected=SNAPSHOT_SCHEMA)
+            _log.warning("serving state snapshot schema %r != %r; "
+                         "cold start", schema, SNAPSHOT_SCHEMA)
+            return {"mode": "cold", "restored": False,
+                    "reason": "schema mismatch"}
+        try:
+            fault = maybe_inject("state", self._probe_name(),
+                                 "restore")
+            if fault is not None:
+                raise RuntimeError(
+                    f"injected state-restore fault: {fault}")
+            snap = ServingStateSnapshot.from_json(doc)
+            out = snap.restore(self.server)
+        except Exception as e:
+            kind = classify_error(e)
+            _telemetry.count("serving_state_restore_failures")
+            _telemetry.event("serving_state_restore_failed",
+                             path=self.path, kind=kind,
+                             error=f"{type(e).__name__}: {e}")
+            _log.warning("serving state restore failed (%s %s: %s); "
+                         "cold start", kind, type(e).__name__, e)
+            return {"mode": "cold", "restored": False,
+                    "reason": f"restore failed: {type(e).__name__}"}
+        _telemetry.count("serve_state_restores")
+        _telemetry.event("serve_state_restored", **{
+            k: v for k, v in out.items() if k != "warm_buckets"})
+        return out
