@@ -1636,7 +1636,343 @@ def _measure_sharded_search() -> dict:
     }
 
 
+#: autotune child: the serving axis. ONE script, three roles —
+#: role=profile records score:b* dispatch costs into the store;
+#: role=measure times the cold-start request stream (TX_TUNE picks
+#: static vs tuned). Fresh subprocess per role so every measurement
+#: pays (or provably avoids) its own compiles.
+_AUTOTUNE_SERVE_CHILD = r'''
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from examples.titanic import build_features, synthetic_titanic, \
+    stratified_split
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.observability import persist_process_profiles
+from transmogrifai_tpu.serving import (ServeConfig, plan_compiles,
+                                       serve_in_process)
+from transmogrifai_tpu.workflow import Workflow
+
+records = synthetic_titanic(600)
+train, test = stratified_split(records)
+survived, features = build_features()
+pred = LogisticRegression(reg_param=0.01).set_input(
+    survived, features).get_output()
+model = (Workflow().set_result_features(survived, pred)
+         .set_input_records(train).train(validate="off"))
+n = int(os.environ.get("TX_AUTOTUNE_REQS", "96"))
+reqs = [dict(r) for r in (test * (n // len(test) + 1))[:n]]
+server, client = serve_in_process(
+    {"titanic": model}, ServeConfig(max_wait_ms=2.0, sentinel=False))
+out = {}
+try:
+    if os.environ.get("TX_AUTOTUNE_ROLE") == "profile":
+        # record warm per-dispatch cost at every bucket the stream can
+        # hit (cold + warm call each: the store keeps the compile vs
+        # execute split, the cost model subtracts the compile share)
+        entry = server.plans.get("titanic")
+        for b in (8, 16, 32, 64):
+            entry.plan.score([dict(test[0])] * b)
+            entry.plan.score([dict(test[0])] * b)
+        out["profiled"] = sorted(persist_process_profiles())
+    else:
+        t0 = time.perf_counter()
+        out["prewarmed"] = server.prewarm(
+            samples={"titanic": [dict(test[0])]})
+        out["prewarm_seconds"] = round(time.perf_counter() - t0, 3)
+        c0 = plan_compiles()
+        lat = []
+        for r in reqs:               # sequential singles: bucket 8
+            t0 = time.perf_counter()
+            client.score(r)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()     # burst: coalesces to big buckets
+        client.score_many(reqs[:64])
+        out["burst_wall_ms"] = round(
+            (time.perf_counter() - t0) * 1000.0, 3)
+        out["steady_compiles"] = plan_compiles() - c0
+        lat.sort()
+        out["p50_ms"] = round(lat[len(lat) // 2], 3)
+        out["p99_ms"] = round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3)
+        out["max_ms"] = round(lat[-1], 3)
+        out["target_decision"] = server._target_decision.to_json()
+finally:
+    server.stop()
+print(json.dumps(out))
+'''
+
+#: autotune child: the racing-search axis. role=profile persists the
+#: family:* compile/wall records a racing run measures; role=measure
+#: times the SAME search under the schedule TX_TUNE resolves, and
+#: TX_AUTOTUNE_EXACT=1 additionally runs exhaustive exact CV in the
+#: same process for the bitwise-finalist check.
+_AUTOTUNE_RACING_CHILD = r'''
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from examples.titanic import build_features, synthetic_titanic, \
+    stratified_split
+from transmogrifai_tpu.models import LogisticRegression, NaiveBayes
+from transmogrifai_tpu.observability import persist_process_profiles
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        SelectedModel)
+from transmogrifai_tpu.workflow import Workflow
+
+records = synthetic_titanic(900)
+train, _ = stratified_split(records)
+survived, features = build_features()
+
+def pool():
+    return [
+        (LogisticRegression(), [{"reg_param": p, "max_iter": 40}
+                                for p in (0.001, 0.01, 0.1, 1.0)]),
+        (NaiveBayes(), [{"smoothing": s} for s in (0.5, 1.0, 2.0)]),
+    ]
+
+def search(validation):
+    pred = (BinaryClassificationModelSelector
+            .with_cross_validation(num_folds=3, models=pool(),
+                                   validation=validation)
+            .set_input(survived, features).get_output())
+    wf = (Workflow().set_result_features(survived, pred)
+          .set_input_records(train))
+    t0 = time.perf_counter()
+    model = wf.train(validate="off")
+    wall = time.perf_counter() - t0
+    s = [st for st in model.stages()
+         if isinstance(st, SelectedModel)][0].summary
+    return {"wall": round(wall, 3), "winner": s.best_model_name,
+            "params": s.best_model_params,
+            "metric": s.best_validation_metric,
+            "racing": getattr(s, "racing", None) or {}}
+
+out = {"racing": search("racing")}
+if os.environ.get("TX_AUTOTUNE_ROLE") == "profile":
+    out["profiled"] = sorted(persist_process_profiles())
+else:
+    from transmogrifai_tpu.tuning.policy import TuningPolicy, \
+        tuning_enabled
+    if tuning_enabled():
+        eta, mf, decs = TuningPolicy().racing_schedule()
+        out["schedule"] = {"eta": eta, "min_fidelity": mf,
+                           "decisions": [d.to_json() for d in decs]}
+    if os.environ.get("TX_AUTOTUNE_EXACT") == "1":
+        out["exact"] = search("exact")
+print(json.dumps(out))
+'''
+
+#: autotune child: the placement axis. role=profile trains under
+#: TX_PREPARE_FIT=host so the store learns host fit costs; the measure
+#: roles train the SAME wide workflow cold in auto mode — the tuned
+#: process seeds host-vs-device from the store and skips the
+#: optimistic device trace+compile on its FIRST fit.
+_AUTOTUNE_PREPARE_CHILD = r'''
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from bench import _wide_prepare_records
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.observability import persist_process_profiles
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.plans import placement_report
+from transmogrifai_tpu.workflow import Workflow
+
+rows = int(os.environ.get("TX_AUTOTUNE_PREP_ROWS", "1200"))
+records, schema = _wide_prepare_records(rows)
+feats = [FeatureBuilder.of(name, getattr(T, tname)).extract(
+    lambda r, k=name: r.get(k)).as_predictor()
+    for name, tname in schema]
+label = FeatureBuilder.of("label", T.RealNN).extract(
+    lambda r: r.get("label")).as_response()
+checked = transmogrify(feats).sanity_check(label, min_variance=-0.1)
+pred = LogisticRegression(reg_param=0.05, max_iter=20).set_input(
+    label, checked).get_output()
+os.environ["TX_PREPARE"] = "plan"
+wf = Workflow().set_result_features(pred).set_input_records(records)
+t0 = time.perf_counter()
+wf.train(validate="off")
+out = {"first_train_wall_seconds":
+           round(time.perf_counter() - t0, 3),
+       "placements": placement_report()}
+if os.environ.get("TX_AUTOTUNE_ROLE") == "profile":
+    out["profiled"] = sorted(k for k in persist_process_profiles()
+                             if k.startswith("placement:"))
+print(json.dumps(out))
+'''
+
+
+def _run_autotune_child(code: str, env_extra: dict,
+                        timeout: int = 900) -> dict:
+    """Run one measurement child, return its final JSON line. Children
+    never inherit TX_PROFILE_PERSIST — each role persists explicitly
+    (or not at all), so measure runs can't pollute the seeded store."""
+    env = dict(os.environ, **env_extra)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TX_PROFILE_PERSIST", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=timeout,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"autotune child failed (rc={proc.returncode}): "
+            f"{proc.stderr[-2000:]}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"autotune child produced no JSON: "
+                       f"{proc.stdout[-2000:]}")
+
+
+def _measure_autotune() -> dict:
+    """TX_BENCH_MODE=autotune: tuned vs static on the three axes the
+    TuningPolicy governs (ISSUE 13, docs/autotuning.md). Per axis: a
+    PROFILE child populates a temp store, then a STATIC child
+    (TX_TUNE=off) and a TUNED child measure the same workload in fresh
+    processes — cold-start p99 of an unprofiled serving plan (tuned
+    pre-warms the predicted buckets before traffic), racing
+    search_seconds under the cost-model schedule (finalists checked
+    bitwise against exhaustive exact CV in the same process), and the
+    first-train wall of the wide prepare workflow (tuned seeds
+    host-vs-device placement from the store). The full TuningDecision
+    list + per-axis deltas land in BENCH_STATE.json's ``autotune``
+    block through the atomic merge writer."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="tx_autotune_")
+    store = os.path.join(tmp, "store.json")
+    base = {"TX_PROFILE_STORE": store}
+
+    # -- axis 1: unprofiled-plan serving cold-start p99 ----------------
+    _run_autotune_child(_AUTOTUNE_SERVE_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "profile", "TX_TUNE": "off"})
+    serve_static = _run_autotune_child(_AUTOTUNE_SERVE_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "measure", "TX_TUNE": "off"})
+    serve_tuned = _run_autotune_child(_AUTOTUNE_SERVE_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "measure", "TX_TUNE": "on"})
+
+    # -- axis 2: racing search seconds under the tuned schedule --------
+    _run_autotune_child(_AUTOTUNE_RACING_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "profile", "TX_TUNE": "off"})
+    racing_static = _run_autotune_child(_AUTOTUNE_RACING_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "measure", "TX_TUNE": "off"})
+    racing_tuned = _run_autotune_child(_AUTOTUNE_RACING_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "measure", "TX_TUNE": "on",
+        "TX_AUTOTUNE_EXACT": "1"})
+
+    # -- axis 3: first-fit placement wall ------------------------------
+    _run_autotune_child(_AUTOTUNE_PREPARE_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "profile", "TX_TUNE": "off",
+        "TX_PREPARE_FIT": "host"})
+    prep_static = _run_autotune_child(_AUTOTUNE_PREPARE_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "measure", "TX_TUNE": "off"})
+    prep_tuned = _run_autotune_child(_AUTOTUNE_PREPARE_CHILD, {
+        **base, "TX_AUTOTUNE_ROLE": "measure", "TX_TUNE": "on"})
+
+    # the full decision table the seeded store resolves to (what
+    # `tx tune --explain --store <store>` would render)
+    from transmogrifai_tpu.tuning.policy import TuningPolicy
+    decisions = [d.to_json() for d in
+                 TuningPolicy(path=store, enabled=True).decisions(
+                     max_wait_ms=2.0, max_batch=256)]
+
+    # wall-clock axes get a noise band (5% + 0.25s): when the cost
+    # model CHOOSES the static schedule the two runs are the same work
+    # and only jitter separates them — "no worse" must not flap on it
+    serve_win = (serve_tuned["p99_ms"] <= serve_static["p99_ms"]
+                 and serve_tuned["steady_compiles"] == 0)
+    rac_s, rac_t = (racing_static["racing"]["wall"],
+                    racing_tuned["racing"]["wall"])
+    racing_win = rac_t <= rac_s * 1.05 + 0.25
+    prep_s, prep_t = (prep_static["first_train_wall_seconds"],
+                      prep_tuned["first_train_wall_seconds"])
+    prep_win = prep_t <= prep_s * 1.05 + 0.25
+    wins = int(serve_win) + int(racing_win) + int(prep_win)
+    bitwise_finalists = (
+        "exact" in racing_tuned
+        and racing_tuned["racing"]["winner"]
+        == racing_tuned["exact"]["winner"]
+        and racing_tuned["racing"]["params"]
+        == racing_tuned["exact"]["params"]
+        and racing_tuned["racing"]["metric"]
+        == racing_tuned["exact"]["metric"])
+
+    axes = {
+        "serving_cold_p99": {
+            "static_p99_ms": serve_static["p99_ms"],
+            "tuned_p99_ms": serve_tuned["p99_ms"],
+            "delta_ms": round(serve_static["p99_ms"]
+                              - serve_tuned["p99_ms"], 3),
+            "static_burst_wall_ms": serve_static["burst_wall_ms"],
+            "tuned_burst_wall_ms": serve_tuned["burst_wall_ms"],
+            "tuned_prewarmed": serve_tuned["prewarmed"],
+            "prewarm_startup_seconds": serve_tuned["prewarm_seconds"],
+            "static_steady_compiles": serve_static["steady_compiles"],
+            "tuned_steady_compiles": serve_tuned["steady_compiles"],
+            "target_decision": serve_tuned["target_decision"],
+            "tuned_no_worse": bool(serve_win),
+        },
+        "racing_search_seconds": {
+            "static_wall_s": rac_s,
+            "tuned_wall_s": rac_t,
+            "delta_s": round(rac_s - rac_t, 3),
+            "tuned_schedule": racing_tuned.get("schedule"),
+            "static_winner": racing_static["racing"]["winner"],
+            "tuned_winner": racing_tuned["racing"]["winner"],
+            "finalists_bitwise_equal_exact_cv":
+                bool(bitwise_finalists),
+            "tuned_no_worse": bool(racing_win),
+        },
+        "placement_first_fit_wall": {
+            "static_wall_s": prep_s,
+            "tuned_wall_s": prep_t,
+            "delta_s": round(prep_s - prep_t, 3),
+            "static_placements": prep_static["placements"],
+            "tuned_placements": prep_tuned["placements"],
+            "tuned_no_worse": bool(prep_win),
+        },
+    }
+    doc = {"decisions": decisions, "axes": axes,
+           "axes_no_worse": wins,
+           "tuned_steady_compiles":
+               serve_tuned["steady_compiles"],
+           "bitwise_finalists": bool(bitwise_finalists)}
+    try:
+        # the decision trail + deltas persist into the repo bench
+        # state through the SAME atomic merge writer the profiles use
+        from transmogrifai_tpu.observability.store import ProfileStore
+        ProfileStore(_STATE_PATH).record_autotune(doc)
+    except Exception:  # pragma: no cover - read-only repo
+        pass
+    return {
+        "metric": "autotune_axes_no_worse",
+        "value": wins,
+        "unit": "axes",
+        # acceptance: tuned >= static on >= 2 of the 3 axes, zero
+        # tuned steady-state compiles, bitwise finalists
+        "vs_baseline": round(wins / 2.0, 2),
+        "axes": axes,
+        "tuned_zero_steady_compiles":
+            serve_tuned["steady_compiles"] == 0,
+        "finalists_bitwise_equal_exact_cv": bool(bitwise_finalists),
+        "decisions": decisions,
+        "profile_store": store,
+        "platform": "cpu",
+    }
+
+
 def _measure() -> dict:
+    if os.environ.get("TX_BENCH_MODE") == "autotune":
+        return _measure_autotune()
     if os.environ.get("TX_BENCH_MODE") == "sharded_search":
         return _measure_sharded_search()
     if os.environ.get("TX_BENCH_MODE") == "prepare":
@@ -1837,7 +2173,7 @@ def _probe_ambient() -> tuple[bool, str, list]:
 def main() -> None:
     if os.environ.get("TX_BENCH_MODE") in ("sharded_search", "prepare",
                                            "serve_loop", "self_heal",
-                                           "restart"):
+                                           "restart", "autotune"):
         # these modes are DEFINED on the forced-CPU backend (the
         # sharded sweep on a virtual device pool, the prepare
         # comparison on the x64 CPU path, the serve-loop latency SLO
@@ -1891,6 +2227,8 @@ def main() -> None:
 
 
 def _headline_metric() -> tuple:
+    if os.environ.get("TX_BENCH_MODE") == "autotune":
+        return "autotune_axes_no_worse", "axes"
     if os.environ.get("TX_BENCH_MODE") == "sharded_search":
         return "sharded_models_x_folds_per_sec", "models_x_folds/s"
     if os.environ.get("TX_BENCH_MODE") == "prepare":

@@ -36,6 +36,19 @@ def _deterministic_uids():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _isolated_profile_store(tmp_path, monkeypatch):
+    """Hermetic profile store: the autotuning layer (tuning/policy.py)
+    consults the persisted ProfileStore from serving/search/prepare, so
+    tests must neither READ the repo-level seeded ``BENCH_STATE.json``
+    (tuned decisions would leak into behavior assertions) nor WRITE
+    test profiles into it. Tests that need a specific store re-point
+    TX_PROFILE_STORE themselves (monkeypatch wins inside the test)."""
+    monkeypatch.setenv("TX_PROFILE_STORE",
+                       str(tmp_path / "profile_store.json"))
+    yield
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

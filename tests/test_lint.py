@@ -1340,3 +1340,81 @@ class TestJ08ShardClosure:
                     out_specs=P("models")))
         """)
         assert "TX-J08" in _rules(findings)
+
+
+class TestT01TunableKnobFork:
+    """TX-T01: a numeric literal default for a registered tunable knob
+    outside ``tuning/`` forks the knob away from the autotuning
+    registry (tuning/registry.py STATIC_DEFAULTS) — the policy and
+    ``tx tune`` overrides would govern one copy while the literal
+    silently rules the hot path (docs/autotuning.md, docs/lint.md)."""
+
+    def test_const_literal_flagged_in_consumer(self):
+        findings = lint_source(
+            "_DEFAULT_TARGET = 64\n",
+            "transmogrifai_tpu/serving/server.py")
+        flagged = [f for f in findings if f.rule_id == "TX-T01"]
+        assert len(flagged) == 1
+        assert flagged[0].severity == "error"
+        assert "STATIC_DEFAULTS" in (flagged[0].hint or "")
+
+    def test_annotated_const_literal_flagged(self):
+        findings = lint_source(
+            "DEFAULT_MIN_BUCKET: int = 8\n",
+            "transmogrifai_tpu/plans/common.py")
+        assert "TX-T01" in _rules(findings)
+
+    def test_registry_read_is_clean(self):
+        findings = lint_source(textwrap.dedent("""
+            from ..tuning.registry import STATIC_DEFAULTS as _TUNABLES
+
+            _DEFAULT_TARGET = int(_TUNABLES["serving.target_batch"])
+        """), "transmogrifai_tpu/serving/server.py")
+        assert "TX-T01" not in _rules(findings)
+
+    def test_literal_inside_tuning_package_is_clean(self):
+        findings = lint_source(
+            "_DEFAULT_TARGET = 64\n",
+            "transmogrifai_tpu/tuning/registry.py")
+        assert "TX-T01" not in _rules(findings)
+
+    def test_param_default_flagged_in_consumer_package(self):
+        findings = lint_source(textwrap.dedent("""
+            def __init__(self, evaluator, eta=3):
+                pass
+        """), "transmogrifai_tpu/selector/racing.py")
+        assert "TX-T01" in _rules(findings)
+
+    def test_kwonly_param_default_flagged(self):
+        findings = lint_source(textwrap.dedent("""
+            def decide(*, placement_margin=1.5):
+                pass
+        """), "transmogrifai_tpu/plans/placement.py")
+        assert "TX-T01" in _rules(findings)
+
+    def test_none_default_resolving_through_policy_is_clean(self):
+        findings = lint_source(textwrap.dedent("""
+            def __init__(self, evaluator, eta=None,
+                         min_fidelity=None):
+                pass
+        """), "transmogrifai_tpu/selector/racing.py")
+        assert "TX-T01" not in _rules(findings)
+
+    def test_same_spelling_outside_consumer_package_is_clean(self):
+        """``eta`` is ALSO the gradient-boosting learning rate — the
+        param check is scoped to the knob's consumer layer."""
+        findings = lint_source(textwrap.dedent("""
+            def __init__(self, eta=0.3, max_depth=6):
+                pass
+        """), "transmogrifai_tpu/models/trees.py")
+        assert "TX-T01" not in _rules(findings)
+
+    def test_local_variable_is_clean(self):
+        """Only module/class-level constants fork a default; a local
+        named like one is somebody's loop temporary."""
+        findings = lint_source(textwrap.dedent("""
+            def f():
+                _DEFAULT_TARGET = 64
+                return _DEFAULT_TARGET
+        """), "transmogrifai_tpu/serving/server.py")
+        assert "TX-T01" not in _rules(findings)

@@ -1,0 +1,589 @@
+"""Telemetry-driven autotuning (tuning/, docs/autotuning.md).
+
+The load-bearing contract is COLD-START IDENTITY: with an empty store
+(or TX_TUNE=off) every consumer — serving coalescer/bucket range,
+racing schedule, fit placement — must behave bitwise identically to
+the static defaults. The tuned paths are then checked against a
+hand-seeded store, and the override block (`tx tune --set`) must
+round-trip through a fresh policy.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from transmogrifai_tpu.observability.store import (ProfileStore,
+                                                   persist_process_profiles)
+from transmogrifai_tpu.tuning.model import (DEFAULT, INTERPOLATED,
+                                            RECORDED, CostModel)
+from transmogrifai_tpu.tuning.policy import TuningPolicy, tuning_enabled
+from transmogrifai_tpu.tuning.registry import (KNOBS, STATIC_DEFAULTS,
+                                               static_default)
+
+
+def _seed_store(path, records):
+    ProfileStore(path).record_profiles(records)
+    return path
+
+
+def _bucket_rec(calls, wall, compile_s, rows=0):
+    return {"calls": calls, "wall_seconds": wall,
+            "compile_seconds": compile_s,
+            "execute_seconds": max(wall - compile_s, 0.0),
+            "rows": rows}
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_static_defaults_cover_every_knob(self):
+        assert set(STATIC_DEFAULTS) == {k.name for k in KNOBS}
+        assert STATIC_DEFAULTS["serving.target_batch"] == 64
+        assert STATIC_DEFAULTS["serving.min_bucket"] == 8
+        assert STATIC_DEFAULTS["serving.max_bucket"] == 8192
+        assert STATIC_DEFAULTS["search.eta"] == 3
+
+    def test_static_default_unknown_knob_raises(self):
+        with pytest.raises(KeyError):
+            static_default("serving.nope")
+
+    def test_consumers_import_the_registry_defaults(self):
+        from transmogrifai_tpu.plans.common import (DEFAULT_MAX_BUCKET,
+                                                    DEFAULT_MIN_BUCKET)
+        from transmogrifai_tpu.serving.server import _DEFAULT_TARGET
+        assert _DEFAULT_TARGET == STATIC_DEFAULTS["serving.target_batch"]
+        assert DEFAULT_MIN_BUCKET == STATIC_DEFAULTS["serving.min_bucket"]
+        assert DEFAULT_MAX_BUCKET == STATIC_DEFAULTS["serving.max_bucket"]
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_recorded_lookup_is_per_call_mean(self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b64": _bucket_rec(4, 2.0, 1.2)})
+        m = CostModel.from_store(path)
+        est = m.predict("score", bucket=64)
+        assert est.confidence == RECORDED
+        assert est.wall == pytest.approx(0.5)
+        assert est.compile == pytest.approx(0.3)
+        assert est.execute == pytest.approx(0.2)
+        assert est.calls == 4
+
+    def test_empty_store_is_default_confidence(self, tmp_path):
+        m = CostModel.from_store(str(tmp_path / "absent.json"))
+        est = m.predict("score", bucket=64)
+        assert est.confidence == DEFAULT and not est.known()
+        assert est.wall is None
+
+    def test_log_space_interpolation_between_buckets(self, tmp_path):
+        # wall(8)=0.1, wall(64)=0.8 -> at b16 (log2=4, one third of the
+        # way from 3 to 6) the log-log line gives 0.1^(2/3)*0.8^(1/3)
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b8": _bucket_rec(1, 0.1, 0.0),
+            "score:b64": _bucket_rec(1, 0.8, 0.0)})
+        est = CostModel.from_store(path).predict("score", bucket=16)
+        assert est.confidence == INTERPOLATED
+        assert est.wall == pytest.approx(0.1 ** (2 / 3) * 0.8 ** (1 / 3),
+                                         rel=1e-6)
+
+    def test_single_point_nearest_neighbor(self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b32": _bucket_rec(2, 0.4, 0.0)})
+        est = CostModel.from_store(path).predict("score", bucket=128)
+        assert est.confidence == INTERPOLATED
+        assert est.wall == pytest.approx(0.2)
+
+    def test_family_totals_aggregate(self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "family:A": _bucket_rec(2, 2.0, 1.0),
+            "family:B": _bucket_rec(2, 4.0, 3.0)})
+        fam = CostModel.from_store(path).family_totals()
+        assert fam.calls == 4
+        assert fam.wall == pytest.approx(1.5)
+        assert fam.compile == pytest.approx(1.0)
+
+    def test_placement_records_parse(self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "placement:SanityChecker:device": _bucket_rec(
+                2, 1.0, 0.6, rows=100),
+            "placement:bad": _bucket_rec(1, 1.0, 0.0)})
+        recs = CostModel.from_store(path).placement_records()
+        assert set(recs) == {("SanityChecker", "device")}
+        assert recs[("SanityChecker", "device")]["seconds"] \
+            == pytest.approx(1.0)
+
+    def test_reserved_keys_are_invisible(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        _seed_store(path, {"score:b8": _bucket_rec(1, 0.1, 0.0)})
+        m = CostModel.from_store(path)
+        assert "_schema" not in m.records
+        assert set(m.recorded_buckets("score")) == {8}
+
+
+# ---------------------------------------------------------------------------
+# cold-start identity: the contract the whole layer hangs on
+# ---------------------------------------------------------------------------
+
+class TestColdStartIdentity:
+    def test_empty_store_every_decision_is_the_static_default(self):
+        policy = TuningPolicy()            # conftest points at a tmp store
+        for d in policy.decisions(max_wait_ms=5.0, max_batch=256):
+            if d.knob in STATIC_DEFAULTS:
+                assert d.chosen == STATIC_DEFAULTS[d.knob], d.knob
+            assert d.source == "default", d.knob
+            assert not d.tuned(), d.knob
+
+    def test_tx_tune_off_disables_a_populated_store(self, tmp_path,
+                                                    monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b8": _bucket_rec(4, 0.4, 0.39),
+            "score:b64": _bucket_rec(4, 0.5, 0.45),
+            "family:GBT": _bucket_rec(3, 9.0, 6.0)})
+        monkeypatch.setenv("TX_TUNE", "off")
+        assert not tuning_enabled()
+        policy = TuningPolicy(path=path)
+        for d in policy.decisions():
+            if d.knob in STATIC_DEFAULTS:
+                assert d.chosen == STATIC_DEFAULTS[d.knob], d.knob
+            assert d.source == "disabled", d.knob
+
+    def test_server_cold_store_matches_static_defaults(self):
+        from transmogrifai_tpu.serving.server import (_DEFAULT_TARGET,
+                                                      ServeConfig,
+                                                      ServingServer)
+        server = ServingServer(ServeConfig(sentinel=False))
+        assert server._target_decision.chosen == _DEFAULT_TARGET
+        assert server._target_decision.source == "default"
+        # plan-cache key stays the untuned (None, None) pair
+        assert server.plan_buckets == (None, None)
+        assert server.prewarm() == {}
+
+    def test_racing_cold_store_is_the_classic_ladder(self):
+        from transmogrifai_tpu.evaluators import \
+            BinaryClassificationEvaluator
+        from transmogrifai_tpu.selector.racing import \
+            RacingCrossValidation
+        r = RacingCrossValidation(BinaryClassificationEvaluator())
+        assert r.eta == 3
+        assert r.min_fidelity == pytest.approx(1.0 / 9.0)
+
+    def test_racing_tx_tune_off_is_the_classic_ladder(self, tmp_path,
+                                                      monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "family:GBT": _bucket_rec(3, 9.0, 8.5)})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        monkeypatch.setenv("TX_TUNE", "off")
+        from transmogrifai_tpu.evaluators import \
+            BinaryClassificationEvaluator
+        from transmogrifai_tpu.selector.racing import \
+            RacingCrossValidation
+        r = RacingCrossValidation(BinaryClassificationEvaluator())
+        assert (r.eta, r.min_fidelity) == (3, pytest.approx(1.0 / 9.0))
+
+    def test_racing_caller_args_always_win(self, tmp_path, monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "family:GBT": _bucket_rec(3, 9.0, 8.5)})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        from transmogrifai_tpu.evaluators import \
+            BinaryClassificationEvaluator
+        from transmogrifai_tpu.selector.racing import \
+            RacingCrossValidation
+        r = RacingCrossValidation(BinaryClassificationEvaluator(),
+                                  eta=4, min_fidelity=0.25)
+        assert (r.eta, r.min_fidelity) == (4, 0.25)
+        assert r.tuning_decisions == []
+
+    def test_placement_cold_store_stays_optimistic_device(self):
+        from transmogrifai_tpu.plans.placement import (PlacementPolicy,
+                                                       reset_placement)
+        reset_placement()
+        try:
+            policy = PlacementPolicy("auto")
+            assert policy.margin == pytest.approx(1.0)
+
+            class DevStage:
+                def supports_device_fit(self):
+                    return True
+
+            where, reason = policy.decide_fit(DevStage(), 100)
+            assert where == "device"
+            assert "no record yet" in reason
+        finally:
+            reset_placement()
+
+
+# ---------------------------------------------------------------------------
+# tuned decisions from a seeded store
+# ---------------------------------------------------------------------------
+
+class TestTunedDecisions:
+    def test_target_batch_largest_bucket_inside_budget(self, tmp_path):
+        # per-call execute: b8 1ms, b64 4ms, b256 20ms; 5ms budget
+        # -> 64 is the largest fit
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b8": _bucket_rec(10, 0.01, 0.0),
+            "score:b64": _bucket_rec(10, 0.04, 0.0),
+            "score:b256": _bucket_rec(10, 0.2, 0.0)})
+        d = TuningPolicy(path=path).target_batch(max_wait_ms=5.0,
+                                                 max_batch=256)
+        assert d.chosen == 64 and d.source == "model"
+        assert d.predicted_chosen == pytest.approx(0.004)
+
+    def test_target_batch_nothing_fits_falls_back(self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b8": _bucket_rec(1, 5.0, 0.0)})
+        d = TuningPolicy(path=path).target_batch(max_wait_ms=1.0,
+                                                 max_batch=64)
+        assert d.chosen == STATIC_DEFAULTS["serving.target_batch"]
+        assert d.source == "default"
+
+    def test_bucket_range_spans_recorded_shapes(self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b16": _bucket_rec(2, 0.1, 0.0),
+            "score:b64": _bucket_rec(2, 0.2, 0.0)})
+        lo, hi = TuningPolicy(path=path).bucket_range(max_batch=256)
+        assert lo.chosen == 16 and lo.source == "model"
+        # the cap grows the top so the serve cap stays reachable
+        assert hi.chosen == 256
+
+    def test_prewarm_set_is_the_recorded_buckets(self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b8": _bucket_rec(2, 0.1, 0.05),
+            "score:b32": _bucket_rec(2, 0.2, 0.1),
+            "score:b512": _bucket_rec(2, 0.9, 0.4)})
+        d = TuningPolicy(path=path).prewarm_buckets(max_batch=256)
+        assert d.chosen == (8, 32)      # 512 is over the serve cap
+        assert d.source == "model"
+
+    def test_racing_schedule_compile_dominated_gets_shallow(
+            self, tmp_path):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "family:GBT": _bucket_rec(2, 20.0, 19.8)})
+        eta, mf, decs = TuningPolicy(path=path).racing_schedule()
+        # per-rung compile dominates: the cheapest ladder has the
+        # FEWEST rungs (depth 1)
+        assert mf == pytest.approx(1.0 / eta)
+        assert all(d.source == "model" for d in decs)
+        assert decs[0].predicted_chosen <= decs[0].predicted_default
+
+    def test_racing_schedule_tie_prefers_the_static_ladder(
+            self, tmp_path):
+        # zero recorded seconds -> every candidate predicts 0.0: the
+        # deterministic tiebreak must keep (3, 1/9)
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "family:Z": _bucket_rec(2, 0.0, 0.0)})
+        eta, mf, _ = TuningPolicy(path=path).racing_schedule()
+        assert (eta, mf) == (3, pytest.approx(1.0 / 9.0))
+
+    def test_server_tuned_store_moves_the_target(self, tmp_path,
+                                                 monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "score:b8": _bucket_rec(10, 0.001, 0.0),
+            "score:b16": _bucket_rec(10, 0.002, 0.0)})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        from transmogrifai_tpu.serving.server import (ServeConfig,
+                                                      ServingServer)
+        server = ServingServer(ServeConfig(max_wait_ms=5.0,
+                                           sentinel=False))
+        assert server._target_decision.source == "model"
+        assert server.plan_buckets[0] == 8
+
+
+# ---------------------------------------------------------------------------
+# overrides: tx tune --set / --reset honored by a fresh process
+# ---------------------------------------------------------------------------
+
+class TestOverrides:
+    def test_override_round_trip_and_coercion(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        ProfileStore(path).set_tuning_override("serving.target_batch",
+                                               "32")
+        d = TuningPolicy(path=path).target_batch(5.0, 256)
+        assert d.chosen == 32 and isinstance(d.chosen, int)
+        assert d.source == "override" and d.tuned()
+        ProfileStore(path).clear_tuning_overrides(
+            "serving.target_batch")
+        d2 = TuningPolicy(path=path).target_batch(5.0, 256)
+        assert d2.chosen == STATIC_DEFAULTS["serving.target_batch"]
+        assert d2.source == "default"
+
+    def test_prewarm_override_parses_lists_and_strings(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        ProfileStore(path).set_tuning_override("serving.prewarm",
+                                               "64,8")
+        d = TuningPolicy(path=path).prewarm_buckets(max_batch=256)
+        assert d.chosen == (8, 64) and d.source == "override"
+
+    def test_tx_tune_off_ignores_overrides(self, tmp_path,
+                                           monkeypatch):
+        path = str(tmp_path / "s.json")
+        ProfileStore(path).set_tuning_override("serving.target_batch",
+                                               16)
+        monkeypatch.setenv("TX_TUNE", "off")
+        d = TuningPolicy(path=path).target_batch(5.0, 256)
+        assert d.chosen == STATIC_DEFAULTS["serving.target_batch"]
+        assert d.source == "disabled"
+
+    def test_override_honored_by_fresh_subprocess(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        ProfileStore(path).set_tuning_override("search.eta", 4)
+        code = (
+            "import json, os\n"
+            "os.environ['TX_PROFILE_STORE'] = %r\n"
+            "from transmogrifai_tpu.tuning.policy import TuningPolicy\n"
+            "eta, mf, _ = TuningPolicy().racing_schedule()\n"
+            "print(json.dumps({'eta': eta, 'mf': mf}))\n" % path)
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=120,
+                              env=dict(os.environ,
+                                       JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stderr
+        got = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert got["eta"] == 4
+
+
+# ---------------------------------------------------------------------------
+# tx tune CLI
+# ---------------------------------------------------------------------------
+
+class TestTuneCli:
+    def _run(self, args, capsys):
+        from transmogrifai_tpu.cli.tune import main
+        rc = main(["tune"] + args)
+        return rc, capsys.readouterr().out
+
+    def test_table_renders_every_knob(self, tmp_path, capsys):
+        rc, out = self._run(["--store", str(tmp_path / "s.json")],
+                            capsys)
+        assert rc == 0
+        for knob in STATIC_DEFAULTS:
+            assert knob in out
+        assert "prepare.placement_seed" in out
+
+    def test_explain_renders_every_reason(self, tmp_path, capsys):
+        rc, out = self._run(["--store", str(tmp_path / "s.json"),
+                             "--explain"], capsys)
+        assert rc == 0
+        assert out.count("why:") == 8    # one per decision
+
+    def test_set_then_json_then_reset(self, tmp_path, capsys):
+        store = str(tmp_path / "s.json")
+        rc, out = self._run(["--store", store, "--set",
+                             "serving.target_batch=32"], capsys)
+        assert rc == 0 and "set serving.target_batch" in out
+        rc, out = self._run(["--store", store, "--format", "json"],
+                            capsys)
+        doc = json.loads(out)
+        assert doc["overrides"] == {"serving.target_batch": 32}
+        chosen = {d["knob"]: d for d in doc["decisions"]}
+        assert chosen["serving.target_batch"]["chosen"] == 32
+        assert chosen["serving.target_batch"]["source"] == "override"
+        rc, _ = self._run(["--store", store, "--reset"], capsys)
+        assert rc == 0
+        assert ProfileStore(store).tuning_overrides() == {}
+
+    def test_unknown_knob_is_an_error(self, tmp_path, capsys):
+        rc, out = self._run(["--store", str(tmp_path / "s.json"),
+                             "--set", "serving.bogus=1"], capsys)
+        assert rc == 2 and "error:" in out
+
+    def test_disabled_banner(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.setenv("TX_TUNE", "off")
+        rc, out = self._run(["--store", str(tmp_path / "s.json")],
+                            capsys)
+        assert rc == 0 and "DISABLED" in out
+
+
+# ---------------------------------------------------------------------------
+# placement seeding (satellite: record_fit persists; seeds never
+# double-count)
+# ---------------------------------------------------------------------------
+
+class _SeededStage:
+    def supports_device_fit(self):
+        return True
+
+
+class TestPlacementSeeding:
+    def test_host_only_seed_places_host_on_first_fit(self, tmp_path,
+                                                     monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "placement:_SeededStage:host": _bucket_rec(
+                2, 0.2, 0.0, rows=50)})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        from transmogrifai_tpu.plans.placement import (PlacementPolicy,
+                                                       reset_placement)
+        reset_placement()
+        try:
+            where, reason = PlacementPolicy("auto").decide_fit(
+                _SeededStage(), 100)
+            assert where == "host"
+            assert "cross-run seed" in reason
+        finally:
+            reset_placement()
+
+    def test_seed_comparison_prefers_recorded_cheaper_side(
+            self, tmp_path, monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "placement:_SeededStage:device": _bucket_rec(
+                2, 2.0, 0.0, rows=50),
+            "placement:_SeededStage:host": _bucket_rec(
+                2, 0.2, 0.0, rows=50)})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        from transmogrifai_tpu.plans.placement import (PlacementPolicy,
+                                                       reset_placement)
+        reset_placement()
+        try:
+            where, reason = PlacementPolicy("auto").decide_fit(
+                _SeededStage(), 100)
+            assert where == "host" and "cross-run seed" in reason
+        finally:
+            reset_placement()
+
+    def test_process_local_record_wins_over_seed(self, tmp_path,
+                                                 monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "placement:_SeededStage:host": _bucket_rec(
+                2, 0.2, 0.0, rows=50)})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        from transmogrifai_tpu.plans.placement import (PlacementPolicy,
+                                                       reset_placement)
+        reset_placement()
+        try:
+            policy = PlacementPolicy("auto")
+            PlacementPolicy.record_fit(_SeededStage(), "device",
+                                       0.001, 0.0, 100)
+            where, _ = policy.decide_fit(_SeededStage(), 100)
+            assert where == "device"     # measured beats seeded
+        finally:
+            reset_placement()
+
+    def test_record_fit_persists_and_seeds_never_do(self, tmp_path,
+                                                    monkeypatch):
+        path = _seed_store(str(tmp_path / "s.json"), {
+            "placement:_SeededStage:host": _bucket_rec(
+                2, 0.2, 0.0, rows=50)})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        from transmogrifai_tpu.plans.placement import (PlacementPolicy,
+                                                       placement_report,
+                                                       reset_placement)
+        reset_placement()
+        try:
+            policy = PlacementPolicy("auto")
+            policy.decide_fit(_SeededStage(), 100)   # loads the seed
+            PlacementPolicy.record_fit(_SeededStage(), "device",
+                                       0.5, 0.1, 100)
+            # the report (and so the persisted records) carries ONLY
+            # what this process measured, never the loaded seed
+            rows = placement_report()
+            assert [(r["stage"], r["placement"]) for r in rows] \
+                == [("_SeededStage", "device")]
+            persist_process_profiles(path)
+            rec = ProfileStore(path).profiles(
+                "placement:_SeededStage:host")
+            # host seconds unchanged: seed was not re-persisted
+            assert rec["placement:_SeededStage:host"]["wall_seconds"] \
+                == pytest.approx(0.2)
+        finally:
+            reset_placement()
+
+
+# ---------------------------------------------------------------------------
+# store hardening: schema, key cap, compaction (satellite)
+# ---------------------------------------------------------------------------
+
+class TestStoreHardening:
+    def test_schema_stamp(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        ProfileStore(path).record_profiles(
+            {"score:b8": _bucket_rec(1, 0.1, 0.0)})
+        meta = ProfileStore(path).meta()
+        assert meta["schema"] == 1
+        assert meta["compacted"] is None
+
+    def test_key_cap_merges_out_lowest_calls_loudly(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("TX_PROFILE_KEY_CAP", "3")
+        path = str(tmp_path / "s.json")
+        store = ProfileStore(path)
+        store.record_profiles({
+            f"score:b{2 ** i}": _bucket_rec(i + 1, float(i + 1), 0.0)
+            for i in range(6)})          # 6 keys, cap 3
+        kept = store.profiles()
+        assert len(kept) == 3
+        # deterministic order: lowest calls out first -> the three
+        # highest-calls records survive
+        assert set(kept) == {"score:b8", "score:b16", "score:b32"}
+        marker = store.meta()["compacted"]
+        assert marker["keys"] == 3
+        assert marker["calls"] == 1 + 2 + 3
+        # no cost mass lost: kept + marker == everything written
+        total = sum(r["calls"] for r in kept.values()) \
+            + marker["calls"]
+        assert total == sum(range(1, 7))
+
+    def test_reserved_keys_never_accepted_from_writers(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        store = ProfileStore(path)
+        store.record_profiles({"_schema": {"calls": 9},
+                               "score:b8": _bucket_rec(1, 0.1, 0.0)})
+        assert store.meta()["schema"] == 1       # not clobbered
+        assert set(store.profiles()) == {"score:b8"}
+
+    def test_concurrent_writers_lose_nothing(self, tmp_path):
+        """Two subprocesses each merge N distinct keys through the
+        flock'd read-merge-write: every record survives and the file
+        stays valid JSON (the satellite's teeth)."""
+        path = str(tmp_path / "s.json")
+        n = 20
+        code = (
+            "import sys\n"
+            "from transmogrifai_tpu.observability.store import "
+            "ProfileStore\n"
+            "store = ProfileStore(%r)\n"
+            "tag = sys.argv[1]\n"
+            "for i in range(%d):\n"
+            "    store.record_profiles({f'score:{tag}{i}:b8': "
+            "{'calls': 1, 'wall_seconds': 0.01, "
+            "'compile_seconds': 0.0, 'execute_seconds': 0.01, "
+            "'rows': 8}})\n" % (path, n))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = [subprocess.Popen([sys.executable, "-c", code, tag],
+                                  env=env, stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE)
+                 for tag in ("a", "b")]
+        for p in procs:
+            _, err = p.communicate(timeout=240)
+            assert p.returncode == 0, err.decode()
+        with open(path, encoding="utf-8") as fh:
+            json.load(fh)                        # never torn
+        profiles = ProfileStore(path).profiles()
+        for tag in ("a", "b"):
+            for i in range(n):
+                key = f"score:{tag}{i}:b8"
+                assert key in profiles, f"lost {key}"
+                assert profiles[key]["calls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# autotune trail (bench writes it; the store must round-trip it)
+# ---------------------------------------------------------------------------
+
+class TestAutotuneTrail:
+    def test_record_autotune_round_trips(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        doc = {"decisions": [{"knob": "search.eta", "chosen": 4}],
+               "axes_no_worse": 3}
+        ProfileStore(path).record_autotune(doc)
+        got = ProfileStore(path).load()["autotune"]
+        assert got["axes_no_worse"] == 3
+        assert got["decisions"][0]["knob"] == "search.eta"
+        assert "time" in got

@@ -397,6 +397,18 @@ def run_serve(args) -> int:
         from ..serving.state import StateManager
         state_manager = StateManager(server, write_dir)
         banner_extra["generation"] = server.restart_generation
+    # autotuned prewarm (docs/autotuning.md): compile the buckets the
+    # cost model says this zoo will hit BEFORE the port binds, so the
+    # first live batches skip their compile stall. Cold store or
+    # TX_TUNE=off -> empty set -> no-op, boot time unchanged.
+    warmed = server.prewarm()
+    if warmed:
+        banner_extra["prewarmed"] = warmed
+    if server._target_decision.tuned() or any(
+            d.tuned() for d in server._bucket_decisions):
+        banner_extra["tuned"] = {
+            "target_batch": server._target_decision.chosen,
+            "buckets": [d.chosen for d in server._bucket_decisions]}
     try:
         return asyncio.run(serve_forever(
             server, args.host, args.port,

@@ -1,0 +1,174 @@
+"""``tx tune``: inspect and override the autotuning decisions.
+
+Renders every :class:`~..tuning.policy.TuningDecision` the
+:class:`~..tuning.policy.TuningPolicy` would hand the serving, search
+and prepare layers right now — chosen value, static default,
+predicted cost both ways, confidence, source — and manages the
+persisted override block (``tuning.overrides`` in the profile store)
+the policy honors across processes::
+
+    python -m transmogrifai_tpu.cli tune                   # table
+    python -m transmogrifai_tpu.cli tune --explain         # + reasons
+    python -m transmogrifai_tpu.cli tune --format json
+    python -m transmogrifai_tpu.cli tune --set serving.target_batch=32
+    python -m transmogrifai_tpu.cli tune --reset serving.target_batch
+    python -m transmogrifai_tpu.cli tune --reset           # all knobs
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List, Optional
+
+__all__ = ["add_tune_parser", "run_tune"]
+
+
+def add_tune_parser(sub) -> None:
+    p = sub.add_parser(
+        "tune",
+        help="inspect/override telemetry-driven autotuning decisions")
+    p.add_argument("--explain", action="store_true",
+                   help="show each decision's prediction and reasoning")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--set", dest="assignments", action="append",
+                   default=[], metavar="KNOB=VALUE",
+                   help="persist an override the policy honors "
+                        "(repeatable; value parses as JSON, e.g. "
+                        "serving.prewarm=[8,64])")
+    p.add_argument("--reset", nargs="?", const="*", default=None,
+                   metavar="KNOB",
+                   help="drop one persisted override (or all, with no "
+                        "argument)")
+    p.add_argument("--store", default=None,
+                   help="profile-store path (default: TX_PROFILE_STORE "
+                        "or the repo BENCH_STATE.json)")
+    p.add_argument("--max-wait-ms", type=float, default=None,
+                   help="serving wait budget the target-batch decision "
+                        "assumes (default: ServeConfig default)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="serving dispatch cap the decisions assume "
+                        "(default: ServeConfig default)")
+
+
+def _parse_assignment(text: str):
+    if "=" not in text:
+        raise ValueError(
+            f"--set expects KNOB=VALUE, got {text!r}")
+    knob, raw = text.split("=", 1)
+    knob = knob.strip()
+    try:
+        value = json.loads(raw)
+    except ValueError:
+        value = raw.strip()
+    return knob, value
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    if isinstance(v, (tuple, list)):
+        return "[" + ",".join(str(x) for x in v) + "]" if v else "[]"
+    return str(v)
+
+
+def _render_text(decisions: List, explain: bool,
+                 overrides: dict) -> List[str]:
+    rows = [("knob", "chosen", "default", "confidence", "source")]
+    for d in decisions:
+        rows.append((d.knob, _fmt(d.chosen), _fmt(d.default),
+                     d.confidence, d.source))
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    lines = []
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths))
+                     .rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+            continue
+        if explain:
+            d = decisions[i - 1]
+            pc = ("?" if d.predicted_chosen is None
+                  else f"{d.predicted_chosen:.4f}s")
+            pd = ("?" if d.predicted_default is None
+                  else f"{d.predicted_default:.4f}s")
+            lines.append(f"    predicted: chosen {pc} vs default {pd}")
+            lines.append(f"    why: {d.reason}")
+    if overrides:
+        lines.append("")
+        lines.append(f"persisted overrides: "
+                     f"{json.dumps(overrides, sort_keys=True)}")
+    return lines
+
+
+def run_tune(args: argparse.Namespace) -> int:
+    from ..observability.store import ProfileStore
+    from ..serving.server import ServeConfig
+    from ..tuning.policy import TuningPolicy
+    from ..tuning.registry import STATIC_DEFAULTS
+
+    store = ProfileStore(args.store)
+    rc = 0
+    mutated = False
+    for text in args.assignments:
+        try:
+            knob, value = _parse_assignment(text)
+            if knob not in STATIC_DEFAULTS:
+                raise ValueError(
+                    f"unknown tunable knob {knob!r}; registered: "
+                    f"{sorted(STATIC_DEFAULTS)}")
+        except ValueError as e:
+            print(f"error: {e}")
+            return 2
+        store.set_tuning_override(knob, value)
+        print(f"set {knob} = {value!r} (store {store.path})")
+        mutated = True
+    if args.reset is not None:
+        if args.reset == "*":
+            store.clear_tuning_overrides()
+            print(f"cleared all overrides (store {store.path})")
+        else:
+            if args.reset not in STATIC_DEFAULTS:
+                print(f"error: unknown tunable knob {args.reset!r}; "
+                      f"registered: {sorted(STATIC_DEFAULTS)}")
+                return 2
+            store.clear_tuning_overrides(args.reset)
+            print(f"reset {args.reset} (store {store.path})")
+        mutated = True
+
+    cfg = ServeConfig()
+    max_wait = (cfg.max_wait_ms if args.max_wait_ms is None
+                else args.max_wait_ms)
+    max_batch = cfg.max_batch if args.max_batch is None \
+        else args.max_batch
+    policy = TuningPolicy(path=store.path)
+    decisions = policy.decisions(max_wait_ms=max_wait,
+                                 max_batch=max_batch)
+    if args.format == "json":
+        print(json.dumps({
+            "store": store.path,
+            "enabled": policy.enabled,
+            "overrides": policy.overrides,
+            "decisions": [d.to_json() for d in decisions],
+        }, indent=1, sort_keys=True))
+        return rc
+    if mutated:
+        print("")
+    if not policy.enabled:
+        print("autotuning DISABLED (TX_TUNE=off) — every decision is "
+              "the static default")
+    for line in _render_text(decisions, args.explain, policy.overrides):
+        print(line)
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="transmogrifai_tpu.cli.tune",
+        description="inspect/override autotuning decisions")
+    sub = parser.add_subparsers(dest="command", required=True)
+    add_tune_parser(sub)
+    return run_tune(parser.parse_args(argv))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -94,6 +94,15 @@ RULES: Dict[str, tuple] = {
                       "document if the process dies mid-write — write "
                       "through observability.store.atomic_write_json "
                       "(tmp file + os.replace)"),
+    # -- tuning rules ------------------------------------------------------
+    "TX-T01": (ERROR, "numeric literal default for a registered tunable "
+                      "knob outside tuning/ — the knob's single source "
+                      "of truth is the autotuning registry "
+                      "(tuning/registry.py STATIC_DEFAULTS); read it "
+                      "from there (or default the parameter to None "
+                      "and resolve through TuningPolicy) so `tx tune` "
+                      "overrides and the cost model actually govern "
+                      "the knob"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

@@ -99,6 +99,18 @@ call) are caught here in milliseconds:
   replication is the intent — then it is visible and reviewable).
   Config scalars (``cfg``/``spec``/``statics``/axis names...) may close
   over freely; the rule keys on array-ish names only.
+- TX-T01 numeric literal default for a registered tunable knob outside
+  ``tuning/``: assigning a number to one of the registry's blessed
+  constant names (``_DEFAULT_TARGET``, ``DEFAULT_MIN_BUCKET``, ...) at
+  module/class level, or giving a registered knob PARAMETER (``eta``,
+  ``min_fidelity``, ``placement_margin``) a numeric literal default,
+  re-introduces a second source of truth the autotuning layer cannot
+  govern — ``tx tune --set`` and the cost model would silently stop
+  applying to that call path. The single source of truth is
+  ``tuning/registry.py``'s ``STATIC_DEFAULTS``; consumers read the
+  registry (or default the parameter to ``None`` and resolve through
+  ``TuningPolicy``). Files under ``tuning/`` are exempt — that IS the
+  registry.
 
 Scope discipline keeps the rules precise: J01/J04/J05 only fire INSIDE
 functions statically known to be jitted (decorated with ``jax.jit`` or
@@ -365,6 +377,43 @@ def _is_self_name(node: ast.AST) -> bool:
     return isinstance(node, ast.Name) and node.id == "self"
 
 
+def _is_tuning_path(path: str) -> bool:
+    """tuning/ package files are exempt from TX-T01 — the registry
+    itself is where the literal defaults legally live."""
+    import re
+    return "tuning" in re.split(r"[/\\]", path)
+
+
+def _tunable_names() -> tuple:
+    """(const names, param name -> consumer-package scopes) registered
+    in tuning/registry.py — lazy so the lint package imports standalone
+    (and so a stubbed registry degrades to 'rule never fires', not an
+    ImportError)."""
+    try:
+        from ..tuning.registry import (TUNABLE_CONST_NAMES,
+                                       TUNABLE_PARAM_SCOPES)
+        return TUNABLE_CONST_NAMES, TUNABLE_PARAM_SCOPES
+    except ImportError:  # pragma: no cover - registry always present
+        return frozenset(), {}
+
+
+def _is_numeric_literal(node: ast.AST) -> bool:
+    """A literal number: ``64``, ``1.5``, ``-3``, ``1.0 / 9`` — the
+    shapes a hardcoded knob default takes. bools are not numbers here,
+    and any Name/Call/Attribute breaks literal-ness (reading the
+    registry is exactly the sanctioned fix)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, ast.UnaryOp) \
+            and isinstance(node.op, (ast.USub, ast.UAdd)):
+        return _is_numeric_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_numeric_literal(node.left) \
+            and _is_numeric_literal(node.right)
+    return False
+
+
 def _is_record_drop_path(path: str) -> bool:
     """serving/ files + local/scoring.py get the TX-R02 silent-record-
     drop rule: the code paths rows flow through on their way to or
@@ -446,6 +495,9 @@ class _Visitor(ast.NodeVisitor):
         self.train_path = _is_train_path(path)
         self.resilience = _is_resilience_path(path)
         self.record_drop = _is_record_drop_path(path)
+        #: TX-T01: files under tuning/ may hold the literal defaults
+        self.tuning_path = _is_tuning_path(path)
+        self._tunable_consts, self._tunable_params = _tunable_names()
         self.al = al
         self.findings: List[LintFinding] = []
         #: stack of enclosing FunctionDefs, innermost last
@@ -544,8 +596,60 @@ class _Visitor(ast.NodeVisitor):
                                   + node.args.kwonlyargs)}
         return "grid" in params or "fold_grid" in node.name
 
+    # -- TX-T01 ------------------------------------------------------------
+    def _check_tunable_const(self, target: ast.AST,
+                             value: Optional[ast.AST]) -> None:
+        """Module/class-level ``<BLESSED_CONST> = <number>`` outside
+        tuning/ — a second source of truth for a registered knob."""
+        if self.tuning_path or self.fn_stack or value is None:
+            return
+        if isinstance(target, ast.Name) \
+                and target.id in self._tunable_consts \
+                and _is_numeric_literal(value):
+            self.add(
+                "TX-T01", target,
+                f"numeric literal default for tunable knob constant "
+                f"{target.id!r} outside tuning/ — tx tune overrides "
+                f"and the cost model no longer govern this value",
+                ERROR,
+                hint="read it from the registry: from ..tuning.registry "
+                     "import STATIC_DEFAULTS (tuning/registry.py is the "
+                     "single source of truth)")
+
+    def _check_tunable_defaults(self, node: ast.FunctionDef) -> None:
+        """``def f(eta=3)`` in the knob's consumer package: a
+        registered knob parameter with a hardcoded numeric default
+        bypasses the TuningPolicy resolution path. Scope discipline:
+        the spelling only means the knob in its consumer layer
+        (``eta`` in models/trees.py is a GBT learning rate, legal)."""
+        if self.tuning_path:
+            return
+        import re
+        parts = set(re.split(r"[/\\]", self.path))
+        pos = node.args.posonlyargs + node.args.args
+        pairs = list(zip(pos[len(pos) - len(node.args.defaults):],
+                         node.args.defaults))
+        pairs += [(a, d) for a, d in zip(node.args.kwonlyargs,
+                                         node.args.kw_defaults)
+                  if d is not None]
+        for arg, default in pairs:
+            if parts & self._tunable_params.get(arg.arg, frozenset()) \
+                    and _is_numeric_literal(default):
+                self.add(
+                    "TX-T01", default,
+                    f"parameter {arg.arg!r} of {node.name!r} is a "
+                    f"registered tunable knob with a numeric literal "
+                    f"default — callers that omit it silently pin the "
+                    f"knob, so tx tune overrides and the cost model "
+                    f"never apply",
+                    ERROR,
+                    hint="default it to None and resolve through "
+                         "TuningPolicy (or read tuning/registry.py's "
+                         "STATIC_DEFAULTS)")
+
     # -- function defs -----------------------------------------------------
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_tunable_defaults(node)
         statics = _jit_decoration(node, self.al)
         outer_ctx, outer_name = self.jit_ctx, self.jit_fn_name
         outer_grid, outer_grid_name = self.grid_ctx, self.grid_fn_name
@@ -1189,6 +1293,14 @@ class _Visitor(ast.NodeVisitor):
         if self.serving:
             for target in node.targets:
                 self._check_live_mutation(target)
+        for target in node.targets:
+            self._check_tunable_const(target, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        # TX-T01 also covers the annotated form
+        # (`DEFAULT_ETA: int = 3`) — same knob, same second source
+        self._check_tunable_const(node.target, node.value)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:

@@ -15,30 +15,79 @@ Layout (top-level keys are independent namespaces)::
     {
       "probe":    {"<jax>-<platform>": {healthy, note, time,
                                         transcript?}},
-      "profiles": {"score:b64":        {calls, wall_seconds,
+      "profiles": {"_schema":          1,
+                   "_compacted":       {keys, calls, ...},   # if capped
+                   "score:b64":        {calls, wall_seconds,
                                         compile_seconds,
                                         execute_seconds, rows,
                                         updated},
                    "family:GBT":       {...},
-                   "prepare:seg:...":  {...}}
+                   "placement:...":    {...},
+                   "prepare:seg:...":  {...}},
+      "tuning":   {"overrides": {"serving.target_batch": 32, ...}},
+      "autotune": {...}    # TX_BENCH_MODE=autotune decision trail
     }
 
-``TX_PROFILE_STORE`` overrides the path (tests point it at a tmp dir).
+Reserved ``profiles`` keys start with ``_`` (real labels are
+colon-namespaced section names): ``_schema`` versions the block, and
+``_compacted`` is the loud marker + merged remainder the key cap
+leaves behind. Concurrent writers serialize their read-merge-write
+through an advisory ``flock`` on ``<path>.lock`` (best-effort — the
+atomic replace alone already prevents torn documents; the lock
+prevents LOST records when two processes merge at once).
+
+``TX_PROFILE_STORE`` overrides the path (tests point it at a tmp dir);
+``TX_PROFILE_KEY_CAP`` overrides the growth cap (default 512 keys).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 __all__ = ["ProfileStore", "atomic_write_json", "default_store_path",
-           "gather_process_profiles", "persist_process_profiles"]
+           "gather_process_profiles", "persist_process_profiles",
+           "PROFILES_SCHEMA"]
 
 #: accumulating numeric fields of one profile record; everything else
 #: (``updated``, foreign keys) overwrites on merge
 _ACCUMULATE = ("calls", "wall_seconds", "compile_seconds",
                "execute_seconds", "rows")
+
+#: version stamp written into ``profiles["_schema"]`` on every merge
+PROFILES_SCHEMA = 1
+
+#: growth cap on real profile keys before deterministic merge-out
+_DEFAULT_KEY_CAP = 512
+
+
+@contextlib.contextmanager
+def _merge_lock(path: str):
+    """Advisory cross-process lock for the read-merge-write cycle —
+    two concurrent ``record_profiles`` calls must not both read the
+    same base state and have the second ``os.replace`` erase the
+    first's merge. Best-effort: platforms/paths without ``flock``
+    degrade to the unlocked (still torn-free, possibly lossy)
+    behavior."""
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover - non-posix
+        yield
+        return
+    try:
+        fh = open(path + ".lock", "a+")
+    except OSError:  # pragma: no cover - read-only checkout
+        yield
+        return
+    try:
+        fcntl.flock(fh.fileno(), fcntl.LOCK_EX)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            fcntl.flock(fh.fileno(), fcntl.LOCK_UN)
+        fh.close()
 
 
 def atomic_write_json(path: str, doc: dict, *, indent: int = 1,
@@ -104,13 +153,14 @@ class ProfileStore:
         writer, now shared with the profile records (the ROADMAP
         "hidden prerequisite": the probe's verdict AND its transcript
         persist across rounds in the same store)."""
-        state = self.load()
-        verdict = {"healthy": bool(healthy), "note": str(note),
-                   "time": time.time()}
-        if transcript is not None:
-            verdict["transcript"] = list(transcript)
-        state.setdefault("probe", {})[key] = verdict
-        return self._write(state)
+        with _merge_lock(self.path):
+            state = self.load()
+            verdict = {"healthy": bool(healthy), "note": str(note),
+                       "time": time.time()}
+            if transcript is not None:
+                verdict["transcript"] = list(transcript)
+            state.setdefault("probe", {})[key] = verdict
+            return self._write(state)
 
     def probe_verdict(self, key: str) -> Optional[dict]:
         return self.load().get("probe", {}).get(key)
@@ -123,24 +173,124 @@ class ProfileStore:
         contribution."""
         if not records:
             return True
-        state = self.load()
-        profiles = state.setdefault("profiles", {})
-        now = time.time()
-        for key, rec in records.items():
-            cur = profiles.setdefault(key, {})
+        with _merge_lock(self.path):
+            state = self.load()
+            profiles = state.setdefault("profiles", {})
+            now = time.time()
+            for key, rec in records.items():
+                if key.startswith("_"):     # reserved namespace
+                    continue
+                cur = profiles.setdefault(key, {})
+                for f in _ACCUMULATE:
+                    if f in rec:
+                        total = round(float(cur.get(f, 0.0))
+                                      + float(rec[f] or 0.0), 6)
+                        cur[f] = int(total) if f in ("calls", "rows") \
+                            else total
+                cur["updated"] = now
+            profiles["_schema"] = PROFILES_SCHEMA
+            self._compact(profiles, now)
+            return self._write(state)
+
+    @staticmethod
+    def _compact(profiles: Dict[str, Any], now: float) -> None:
+        """Growth hardening: when real keys exceed the cap
+        (``TX_PROFILE_KEY_CAP``, default 512), merge out the
+        oldest/lowest-calls records — deterministic order (updated
+        ascending, calls ascending, key) — into the loud
+        ``_compacted`` marker, so ``BENCH_STATE.json`` stays bounded
+        as bench modes and tenants multiply but no cost mass is ever
+        silently dropped."""
+        try:
+            cap = int(os.environ.get("TX_PROFILE_KEY_CAP",
+                                     _DEFAULT_KEY_CAP))
+        except ValueError:
+            cap = _DEFAULT_KEY_CAP
+        if cap <= 0:
+            return
+        real = [k for k in profiles if not k.startswith("_")]
+        excess = len(real) - cap
+        if excess <= 0:
+            return
+        order = sorted(real, key=lambda k: (
+            float(profiles[k].get("updated", 0.0)),
+            int(profiles[k].get("calls", 0) or 0), k))
+        merged = profiles.setdefault("_compacted", {
+            "keys": 0, "calls": 0, "wall_seconds": 0.0,
+            "compile_seconds": 0.0, "execute_seconds": 0.0,
+            "rows": 0})
+        for key in order[:excess]:
+            rec = profiles.pop(key)
+            merged["keys"] = int(merged.get("keys", 0)) + 1
             for f in _ACCUMULATE:
-                if f in rec:
-                    total = round(float(cur.get(f, 0.0))
-                                  + float(rec[f] or 0.0), 6)
-                    cur[f] = int(total) if f in ("calls", "rows") \
-                        else total
-            cur["updated"] = now
-        return self._write(state)
+                total = round(float(merged.get(f, 0.0))
+                              + float(rec.get(f, 0.0) or 0.0), 6)
+                merged[f] = int(total) if f in ("calls", "rows") \
+                    else total
+        merged["updated"] = now
+        try:
+            from ..runtime import telemetry
+            telemetry.count("profiles_compacted", excess)
+            telemetry.event("profiles_compacted", evicted=excess,
+                            cap=cap)
+        except Exception:  # pragma: no cover - telemetry optional
+            pass
 
     def profiles(self, prefix: str = "") -> Dict[str, dict]:
+        """Real (non-reserved) profile records; ``_schema`` and
+        ``_compacted`` are internal — read them via :meth:`meta`."""
         return {k: dict(v) for k, v in
                 self.load().get("profiles", {}).items()
-                if k.startswith(prefix)}
+                if k.startswith(prefix) and not k.startswith("_")}
+
+    def meta(self) -> Dict[str, Any]:
+        """The reserved bookkeeping of the ``profiles`` block: schema
+        version and (when the key cap has triggered) the compaction
+        marker."""
+        block = self.load().get("profiles", {})
+        return {"schema": block.get("_schema"),
+                "compacted": block.get("_compacted")}
+
+    # -- tuning overrides (tx tune --set / --reset) ------------------------
+    def tuning_overrides(self) -> Dict[str, Any]:
+        """The persisted override block the TuningPolicy honors."""
+        block = self.load().get("tuning", {})
+        ov = block.get("overrides", {})
+        return dict(ov) if isinstance(ov, dict) else {}
+
+    def set_tuning_override(self, knob: str, value: Any) -> bool:
+        with _merge_lock(self.path):
+            state = self.load()
+            block = state.setdefault("tuning", {})
+            block.setdefault("overrides", {})[knob] = value
+            block["updated"] = time.time()
+            return self._write(state)
+
+    def clear_tuning_overrides(self, knob: Optional[str] = None
+                               ) -> bool:
+        """Drop one override (or all, ``knob=None``)."""
+        with _merge_lock(self.path):
+            state = self.load()
+            block = state.get("tuning", {})
+            if knob is None:
+                block.pop("overrides", None)
+            else:
+                block.get("overrides", {}).pop(knob, None)
+            block["updated"] = time.time()
+            state["tuning"] = block
+            return self._write(state)
+
+    # -- autotune bench trail (TX_BENCH_MODE=autotune) ---------------------
+    def record_autotune(self, doc: dict) -> bool:
+        """Persist the bench's full TuningDecision list + tuned-vs-
+        static deltas, so the perf trajectory records WHY a knob moved,
+        not just that it did."""
+        with _merge_lock(self.path):
+            state = self.load()
+            out = dict(doc)
+            out["time"] = time.time()
+            state["autotune"] = out
+            return self._write(state)
 
 
 def gather_process_profiles() -> Dict[str, dict]:
@@ -152,7 +302,11 @@ def gather_process_profiles() -> Dict[str, dict]:
       are process-local, so bucket labels normalize to
       ``score:b<bucket>``),
     - the validator's per-family compile/wall profile
-      (``family:<Name>``).
+      (``family:<Name>``),
+    - the fit-placement policy's measured (stage class, host|device)
+      records (``placement:<Class>:<where>`` — what this process
+      MEASURED, never the cross-run seeds it loaded), so the cost
+      model and future processes see placement history.
     """
     from ..utils.compile_time import seconds_by_section
     out: Dict[str, dict] = {}
@@ -182,6 +336,15 @@ def gather_process_profiles() -> Dict[str, dict]:
             _acc(f"family:{row['family']}", row["seconds"],
                  row["compileSeconds"], row["calls"])
     except Exception:  # pragma: no cover - selector not imported yet
+        pass
+
+    try:
+        from ..plans.placement import placement_report
+        for row in placement_report():
+            _acc(f"placement:{row['stage']}:{row['placement']}",
+                 row["seconds"], row["compileSeconds"], row["calls"],
+                 row["rows"])
+    except Exception:  # pragma: no cover - plans not imported yet
         pass
     return out
 

@@ -37,11 +37,15 @@ __all__ = ["DEFAULT_MIN_BUCKET", "DEFAULT_MAX_BUCKET", "bucket_for",
            "fallback_reason", "record_compile", "compiles", "plan_seq",
            "bucket_section", "bucket_profile"]
 
+from ..tuning.registry import STATIC_DEFAULTS as _TUNABLES
+
 #: smallest padded batch — single-record requests share one program
-DEFAULT_MIN_BUCKET = 8
+#: (the number lives in tuning/registry.py, the single knob registry
+#: lint rule TX-T01 enforces)
+DEFAULT_MIN_BUCKET = int(_TUNABLES["serving.min_bucket"])
 #: largest padded batch — bigger inputs are chunked so the compile
 #: count stays bounded at log2(max/min)+1 programs per plan
-DEFAULT_MAX_BUCKET = 8192
+DEFAULT_MAX_BUCKET = int(_TUNABLES["serving.max_bucket"])
 
 #: distinct compiled programs per namespace ("score" for ScoringPlan
 #: buckets, "prepare" for PreparePlan segments)

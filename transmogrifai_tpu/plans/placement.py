@@ -25,6 +25,18 @@ grown-up version of this record-and-compare seed):
 ``TX_PREPARE_FIT=device|host`` overrides the policy wholesale (the
 escape hatches the identity tests pin); ``auto`` (default) applies the
 recorded-cost rule above.
+
+Cross-run memory (docs/autotuning.md): ``auto`` mode additionally
+SEEDS the comparison from the profile store's persisted
+``placement:<Class>:<where>`` records at construction — a fresh
+process whose predecessor measured that (say) StandardScaler fits
+cheaper on host places correctly on its FIRST fit instead of paying
+the optimistic device compile again. Seeds live in a separate map so
+:func:`placement_report` (and hence ``persist_process_profiles``)
+only ever reports/persists what THIS process measured — cross-run
+records never double-count. An empty store or ``TX_TUNE=off`` leaves
+the seed map empty: decisions are bitwise the optimistic-device
+defaults.
 """
 from __future__ import annotations
 
@@ -37,6 +49,29 @@ __all__ = ["PlacementPolicy", "placement_report", "reset_placement"]
 _LOCK = threading.Lock()
 #: (stage class name, "host"|"device") -> accumulated fit cost record
 _RECORDS: Dict[Tuple[str, str], Dict[str, float]] = {}
+#: cross-run seeds from the profile store (tuning/policy.py) — read as
+#: a fallback by decide_fit, NEVER persisted back
+_SEEDS: Dict[Tuple[str, str], Dict[str, float]] = {}
+_SEED_STATE = {"done": False}
+
+
+def _ensure_seeded(policy=None) -> None:
+    """Load the store's placement records into the seed map, once per
+    process (reset_placement re-arms it for tests)."""
+    with _LOCK:
+        if _SEED_STATE["done"]:
+            return
+        _SEED_STATE["done"] = True
+    try:
+        if policy is None:
+            from ..tuning.policy import TuningPolicy
+            policy = TuningPolicy()
+        seeds, _decision = policy.placement_seed()
+    except Exception:  # pragma: no cover - store unreadable
+        seeds = {}
+    with _LOCK:
+        for key, rec in seeds.items():
+            _SEEDS.setdefault(key, dict(rec))
 
 
 def _record(cls_name: str, where: str, seconds: float,
@@ -76,6 +111,8 @@ def placement_report() -> List[dict]:
 def reset_placement() -> None:
     with _LOCK:
         _RECORDS.clear()
+        _SEEDS.clear()
+        _SEED_STATE["done"] = False
 
 
 class PlacementPolicy:
@@ -88,6 +125,16 @@ class PlacementPolicy:
             raise ValueError(
                 f"TX_PREPARE_FIT must be auto, device or host, "
                 f"got {self.mode!r}")
+        from ..tuning.registry import STATIC_DEFAULTS
+        self.margin = float(STATIC_DEFAULTS["prepare.placement_margin"])
+        if self.mode == "auto":
+            try:
+                from ..tuning.policy import TuningPolicy
+                policy = TuningPolicy()
+                self.margin = float(policy.placement_margin().chosen)
+                _ensure_seeded(policy)
+            except Exception:  # pragma: no cover - store unreadable
+                pass
 
     def decide_fit(self, stage, n_rows: int) -> Tuple[str, str]:
         """("device"|"host", reason). "device" is only returned for
@@ -103,15 +150,27 @@ class PlacementPolicy:
         with _LOCK:
             dev = _RECORDS.get((cls, "device"))
             host = _RECORDS.get((cls, "host"))
+            seeded = dev is None and host is None
+            if seeded:
+                # no process-local measurement yet: fall back to the
+                # cross-run seeds (empty unless the store has history)
+                dev = _SEEDS.get((cls, "device"))
+                host = _SEEDS.get((cls, "host"))
         dev_s, host_s = _steady_state(dev), _steady_state(host)
+        via = " (cross-run seed)" if seeded and (
+            dev_s is not None or host_s is not None) else ""
         if dev_s is None:
+            if host_s is not None and seeded:
+                return "host", (f"cross-run seed: only a host record "
+                                f"({host_s:.4f}s) — keep measuring it")
             return "device", "no record yet; measuring the device path"
-        if host_s is None or dev_s <= host_s:
+        if host_s is None or dev_s <= self.margin * host_s:
             return "device", (f"recorded steady-state device fit "
                               f"{dev_s:.4f}s <= host "
-                              f"{host_s if host_s is not None else '?'}")
+                              f"{host_s if host_s is not None else '?'}"
+                              f"{via}")
         return "host", (f"recorded steady-state device fit {dev_s:.4f}s "
-                        f"> host {host_s:.4f}s")
+                        f"> host {host_s:.4f}s{via}")
 
     @staticmethod
     def record_fit(stage, where: str, seconds: float,

@@ -101,7 +101,7 @@ class _SelectorFactory:
                               model_types_to_use: Optional[Sequence] = None,
                               stratify: bool = False,
                               validation: str = "exact",
-                              eta: int = 3,
+                              eta: Optional[int] = None,
                               min_fidelity: Optional[float] = None,
                               mesh="auto") -> ModelSelector:
         """(reference withCrossValidation:159; ``mesh`` shards the

@@ -103,15 +103,39 @@ class RacingCrossValidation(CrossValidation):
                    (e.g. eta=3 -> 1/9, 1/3, 1). The ladder always ends
                    at exactly 1.0: the final rung IS full CV for the
                    survivors.
+
+    When NEITHER is given, the schedule comes from the TuningPolicy
+    (tuning/policy.py): the persisted ``family:*`` compile-vs-execute
+    records pick the ladder that amortizes recorded compile cost
+    (docs/autotuning.md). A cold/absent store or ``TX_TUNE=off``
+    resolves to exactly the classic (eta=3, 1/9) ladder — bitwise the
+    old defaults. Explicit arguments always win (``caller`` source).
     """
 
     validation_type = "RacingCrossValidation"
 
-    def __init__(self, evaluator, num_folds: int = 3, eta: int = 3,
+    def __init__(self, evaluator, num_folds: int = 3,
+                 eta: Optional[int] = None,
                  min_fidelity: Optional[float] = None, seed: int = 42,
                  stratify: bool = False, mesh="auto"):
         super().__init__(evaluator, num_folds=num_folds, seed=seed,
                          stratify=stratify, mesh=mesh)
+        #: the TuningDecision records behind this schedule ([] when the
+        #: caller pinned it); bench/tx tune surface them
+        self.tuning_decisions: List = []
+        if eta is None and min_fidelity is None:
+            try:
+                from ..tuning.policy import TuningPolicy
+                eta, min_fidelity, self.tuning_decisions = \
+                    TuningPolicy().racing_schedule()
+            except (ImportError, OSError, ValueError,
+                    KeyError, TypeError):
+                # pragma: no cover - unreadable/malformed store:
+                # fall through to the static schedule below
+                pass
+        if eta is None:
+            from ..tuning.registry import STATIC_DEFAULTS
+            eta = int(STATIC_DEFAULTS["search.eta"])
         if eta < 2:
             raise ValueError("eta must be >= 2")
         self.eta = int(eta)
@@ -126,7 +150,8 @@ class RacingCrossValidation(CrossValidation):
         self.last_report: Dict = {}
 
     @classmethod
-    def from_cross_validation(cls, cv: CrossValidation, eta: int = 3,
+    def from_cross_validation(cls, cv: CrossValidation,
+                              eta: Optional[int] = None,
                               min_fidelity: Optional[float] = None
                               ) -> "RacingCrossValidation":
         """Racing twin of an exact CV validator (same folds, same seed,

@@ -216,7 +216,7 @@ class ModelSelector(Predictor):
                  splitter: Optional[Splitter] = None,
                  problem_type: str = "",
                  validation: str = "exact",
-                 eta: int = 3,
+                 eta: Optional[int] = None,
                  min_fidelity: Optional[float] = None,
                  checkpoint_dir: Optional[str] = None,
                  retry_policy=None,

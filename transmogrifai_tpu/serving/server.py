@@ -77,8 +77,11 @@ _log = logging.getLogger(__name__)
 __all__ = ["ServeConfig", "ServingServer", "ServingClient", "PlanCache",
            "ServeRejected", "ServeDraining", "serve_in_process"]
 
-#: coalescer target when no bucket profile has been recorded yet
-_DEFAULT_TARGET = 64
+from ..tuning.registry import STATIC_DEFAULTS as _TUNABLES
+
+#: coalescer target when no bucket profile has been recorded yet (the
+#: number lives in tuning/registry.py — lint rule TX-T01)
+_DEFAULT_TARGET = int(_TUNABLES["serving.target_batch"])
 
 #: raw admitted records retained per model for the warm-restart
 #: snapshot's prewarm manifest (serving/state.py) — enough to cycle
@@ -468,6 +471,22 @@ class ServingServer:
         if lc is not None and getattr(lc, "enabled", False):
             from .lifecycle import ModelLifecycle
             self.lifecycle = ModelLifecycle(self, lc)
+        #: telemetry-driven autotuning (docs/autotuning.md): one store
+        #: snapshot's decisions for this server's lifetime. With an
+        #: empty store or TX_TUNE=off every decision IS the static
+        #: default, so behavior below is bitwise the untuned loop.
+        from ..tuning.policy import TuningPolicy
+        self.tuning = TuningPolicy()
+        self._target_decision = self.tuning.target_batch(
+            self.config.max_wait_ms, self.config.max_batch)
+        lo_d, hi_d = self.tuning.bucket_range(self.config.max_batch)
+        #: ScoringPlan bucket range for every plan this server
+        #: compiles; (None, None) = plan defaults (and the SAME cache
+        #: key as before, keeping cold-start bitwise)
+        self.plan_buckets: Tuple[Optional[int], Optional[int]] = (
+            (lo_d.chosen, hi_d.chosen)
+            if (lo_d.tuned() or hi_d.tuned()) else (None, None))
+        self._bucket_decisions = (lo_d, hi_d)
 
     # -- registry ----------------------------------------------------------
     def add_model(self, name: str, model_or_dir: Any,
@@ -501,6 +520,58 @@ class ServingServer:
             base_records=base_records, checkpoint_dir=checkpoint_dir,
             save_dir=save_dir))
         return self
+
+    def prewarm(self, names: Optional[List[str]] = None,
+                samples: Optional[Dict[str, List[dict]]] = None
+                ) -> Dict[str, List[int]]:
+        """Pre-compile the tuning policy's pre-warm bucket set for
+        each registered model BEFORE traffic (the serving/state.py
+        warm-restart idiom: score a cycled placeholder batch per
+        bucket), so an unprofiled plan's first requests never pay the
+        per-bucket compile bill in-band. With a cold store or
+        TX_TUNE=off the decision is the empty set and this is a no-op.
+        ``samples`` supplies representative raw records per model;
+        without it the admitted-traffic ring (populated by a state
+        restore) is used, then an empty placeholder record — models
+        whose raw extractors index keys strictly need real samples.
+        Blocking — call before the port binds (cli/serve.py does)."""
+        decision = self.tuning.prewarm_buckets(self.config.max_batch)
+        buckets = sorted(int(b) for b in (decision.chosen or ()))
+        warmed: Dict[str, List[int]] = {}
+        if not buckets:
+            return warmed
+        for name in (names if names is not None
+                     else self.plans.names()):
+            try:
+                entry = self.plans.get(name, self.plan_buckets)
+            except Exception as e:  # pragma: no cover - bad loader
+                from ..runtime.errors import classify_error
+                _telemetry.event("serve_prewarm_failed", model=name,
+                                 kind=classify_error(e),
+                                 error=f"{type(e).__name__}: {e}")
+                continue
+            given = (samples or {}).get(name)
+            ring = self._sample_records.get(name)
+            samples_for = given or (list(ring) if ring else [{}])
+            done: List[int] = []
+            for bucket in buckets:
+                if bucket < entry.plan.min_bucket \
+                        or bucket > entry.plan.max_bucket:
+                    continue
+                try:
+                    entry.plan.score(list(itertools.islice(
+                        itertools.cycle(samples_for), bucket)))
+                    done.append(bucket)
+                except Exception as e:
+                    from ..runtime.errors import classify_error
+                    _telemetry.event("serve_prewarm_failed",
+                                     model=name, bucket=bucket,
+                                     kind=classify_error(e),
+                                     error=f"{type(e).__name__}: {e}")
+            warmed[name] = done
+            _telemetry.event("serve_prewarmed", model=name,
+                             buckets=done)
+        return warmed
 
     # -- async request edge ------------------------------------------------
     async def score_async(self, record: dict, model: Optional[str] = None,
@@ -588,7 +659,13 @@ class ServingServer:
             per_dispatch = rec["execute_seconds"] / rec["calls"]
             if per_dispatch <= budget_s and bucket > best:
                 best = bucket
-        return best or min(_DEFAULT_TARGET, cfg.max_batch)
+        if best:
+            return best
+        # no local profile yet: the tuning policy's cross-run
+        # prediction (tuning/policy.py) replaces the static constant;
+        # cold store / TX_TUNE=off resolves to exactly _DEFAULT_TARGET
+        return max(1, min(int(self._target_decision.chosen),
+                          cfg.max_batch))
 
     async def _collect(self, lane: _Lane, target: int
                        ) -> List[_Request]:
@@ -628,7 +705,9 @@ class ServingServer:
         next one — the double buffer."""
         from ..runtime.errors import classify_error
         loop = asyncio.get_running_loop()
-        target = _DEFAULT_TARGET
+        # first-collect target before any plan profile exists: the
+        # tuning decision (== _DEFAULT_TARGET on a cold store)
+        target = max(1, int(self._target_decision.chosen))
         while self._running:
             batch: List[_Request] = []
             try:
@@ -662,7 +741,8 @@ class ServingServer:
         an evicted model), schema admission with per-row quarantine
         reasons, raw-Dataset boxing, and bucket encode/padding."""
         marks = {"encode_t0": time.monotonic()}
-        entry = self.plans.entry_for(lane.model_name, lane.tenant)
+        entry = self.plans.entry_for(lane.model_name, lane.tenant,
+                                     buckets=self.plan_buckets)
         guards = entry.guards.get(lane.tenant)
         if guards is None:
             guards = entry.guards[lane.tenant] = _TenantGuards(

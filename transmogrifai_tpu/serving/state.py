@@ -192,7 +192,8 @@ class ServingStateSnapshot:
                                      reason="unregistered in-memory "
                                             "model")
                     continue
-            entry = server.plans.get(name)
+            entry = server.plans.get(
+                name, getattr(server, "plan_buckets", (None, None)))
             samples = list(mdoc.get("samples") or []) or [{}]
             buckets = [int(b) for b in mdoc.get("warm_buckets") or []]
             for bucket in sorted(buckets):

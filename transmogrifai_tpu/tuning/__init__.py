@@ -1,0 +1,18 @@
+"""Telemetry-driven autotuning (docs/autotuning.md).
+
+A persisted :class:`~.model.CostModel` over the ProfileStore's
+cross-run wall/compile/execute records, and a
+:class:`~.policy.TuningPolicy` that turns its predictions into
+:class:`~.policy.TuningDecision` records for serving (coalescer
+target, bucket range, pre-warm set), search (racing eta/min_fidelity)
+and prepare (fit placement seed/margin). ``tx tune`` inspects and
+pins every decision; ``TX_TUNE=off`` or an empty store yields the
+static defaults bitwise (tuning/registry.py owns those numbers).
+"""
+from .model import CostModel, CostEstimate
+from .policy import TuningDecision, TuningPolicy, tuning_enabled
+from .registry import KNOBS, STATIC_DEFAULTS, static_default
+
+__all__ = ["CostModel", "CostEstimate", "TuningDecision",
+           "TuningPolicy", "tuning_enabled", "KNOBS",
+           "STATIC_DEFAULTS", "static_default"]

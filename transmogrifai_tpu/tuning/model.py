@@ -1,0 +1,197 @@
+"""The persisted cost model: recorded lookup + log-space interpolation
+over the ProfileStore.
+
+The store (observability/store.py -> ``BENCH_STATE.json`` ``profiles``)
+accumulates per-(stage, family, bucket) wall/compile/execute seconds
+across runs. :class:`CostModel` snapshots those records once at
+construction and answers ``predict(key, bucket)`` with PER-CALL cost
+estimates plus a confidence tag:
+
+- ``recorded``     — the exact key exists with calls > 0; the estimate
+                     is its measured mean,
+- ``interpolated`` — no exact record, but sibling bucket records exist
+                     for the same namespace: costs are interpolated
+                     linearly in (log2 bucket, log seconds) space —
+                     dispatch cost is close to power-law in the padded
+                     row count, so log-log is where it is straightest
+                     (the recorded-lookup seed of PAPERS.md "A Learned
+                     Performance Model for TPUs"),
+- ``default``      — the store knows nothing; the caller must fall
+                     back to its static default (tuning/registry.py).
+
+The model is a pure reader: it never writes the store and never
+touches a device.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from ..observability.store import ProfileStore
+
+__all__ = ["CostModel", "CostEstimate",
+           "RECORDED", "INTERPOLATED", "DEFAULT"]
+
+RECORDED = "recorded"
+INTERPOLATED = "interpolated"
+DEFAULT = "default"
+
+#: guards log() against exact-zero recorded costs
+_EPS = 1e-9
+
+
+@dataclass(frozen=True)
+class CostEstimate:
+    """Per-call cost prediction for one key (seconds)."""
+    key: str
+    wall: Optional[float]
+    compile: Optional[float]
+    execute: Optional[float]
+    confidence: str            # recorded | interpolated | default
+    calls: int = 0             # recorded calls backing the estimate
+
+    def known(self) -> bool:
+        return self.confidence != DEFAULT
+
+    def to_json(self) -> dict:
+        rnd = (lambda v: None if v is None else round(float(v), 6))
+        return {"key": self.key, "wall": rnd(self.wall),
+                "compile": rnd(self.compile),
+                "execute": rnd(self.execute),
+                "confidence": self.confidence, "calls": self.calls}
+
+
+def _per_call(rec: dict) -> Optional[Tuple[float, float, float, int]]:
+    calls = int(rec.get("calls", 0) or 0)
+    if calls < 1:
+        return None
+    return (float(rec.get("wall_seconds", 0.0)) / calls,
+            float(rec.get("compile_seconds", 0.0)) / calls,
+            float(rec.get("execute_seconds", 0.0)) / calls,
+            calls)
+
+
+class CostModel:
+    """Snapshot of the profile store, queryable by key or by
+    (namespace, bucket)."""
+
+    def __init__(self, profiles: Dict[str, dict]):
+        self.records = {k: dict(v) for k, v in (profiles or {}).items()
+                        if not k.startswith("_")}
+
+    @classmethod
+    def from_store(cls, path: Optional[str] = None) -> "CostModel":
+        return cls(ProfileStore(path).profiles())
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    # -- exact lookup ------------------------------------------------------
+    def predict(self, key: str,
+                bucket: Optional[int] = None) -> CostEstimate:
+        """Per-call cost for ``key`` — or, with ``bucket``, for the
+        bucketed key ``{key}:b{bucket}`` with interpolation across the
+        namespace's recorded buckets when the exact one is missing."""
+        if bucket is not None:
+            return self._predict_bucket(key, int(bucket))
+        rec = self.records.get(key)
+        got = _per_call(rec) if rec else None
+        if got is None:
+            return CostEstimate(key, None, None, None, DEFAULT)
+        wall, comp, execute, calls = got
+        return CostEstimate(key, wall, comp, execute, RECORDED, calls)
+
+    # -- bucketed lookup + log-space interpolation -------------------------
+    def recorded_buckets(self, namespace: str = "score"
+                         ) -> Dict[int, CostEstimate]:
+        """Every recorded ``{namespace}:b<bucket>`` key with calls,
+        as per-call estimates keyed by the integer bucket size."""
+        prefix = f"{namespace}:b"
+        out: Dict[int, CostEstimate] = {}
+        for key, rec in self.records.items():
+            if not key.startswith(prefix):
+                continue
+            tail = key[len(prefix):]
+            if not tail.isdigit():
+                continue
+            got = _per_call(rec)
+            if got is None:
+                continue
+            wall, comp, execute, calls = got
+            out[int(tail)] = CostEstimate(key, wall, comp, execute,
+                                          RECORDED, calls)
+        return out
+
+    def _predict_bucket(self, namespace: str, bucket: int
+                        ) -> CostEstimate:
+        key = f"{namespace}:b{bucket}"
+        known = self.recorded_buckets(namespace)
+        if bucket in known:
+            return known[bucket]
+        if not known:
+            return CostEstimate(key, None, None, None, DEFAULT)
+        pts = sorted(known.items())
+
+        def interp(field: str) -> float:
+            xs = [math.log2(b) for b, _ in pts]
+            ys = [math.log(max(getattr(e, field), _EPS))
+                  for _, e in pts]
+            x = math.log2(max(bucket, 1))
+            if len(xs) == 1:
+                # one point: nearest-neighbor — no slope to fit
+                return math.exp(ys[0])
+            if x <= xs[0]:
+                i = 0
+            elif x >= xs[-1]:
+                i = len(xs) - 2
+            else:
+                i = max(j for j in range(len(xs) - 1) if xs[j] <= x)
+            t = (x - xs[i]) / (xs[i + 1] - xs[i])
+            return math.exp(ys[i] + t * (ys[i + 1] - ys[i]))
+
+        return CostEstimate(key, interp("wall"), interp("compile"),
+                            interp("execute"), INTERPOLATED)
+
+    # -- aggregates the policy consumes ------------------------------------
+    def family_totals(self) -> Optional[CostEstimate]:
+        """Mean per-call (one full-CV family dispatch) cost across
+        every recorded ``family:*`` key — the compile-vs-execute split
+        the racing-schedule decision keys on."""
+        wall = comp = execute = 0.0
+        calls = 0
+        for key, rec in self.records.items():
+            if not key.startswith("family:"):
+                continue
+            got = _per_call(rec)
+            if got is None:
+                continue
+            wall += float(rec.get("wall_seconds", 0.0))
+            comp += float(rec.get("compile_seconds", 0.0))
+            execute += float(rec.get("execute_seconds", 0.0))
+            calls += got[3]
+        if calls < 1:
+            return None
+        return CostEstimate("family:*", wall / calls, comp / calls,
+                            execute / calls, RECORDED, calls)
+
+    def placement_records(self) -> Dict[Tuple[str, str], dict]:
+        """Cross-run fit-placement records ``placement:<Class>:<where>``
+        in the shape ``plans/placement.py`` accumulates process-locally
+        ({seconds, compile, calls, rows}) — the seed for a fresh
+        process's first decide_fit."""
+        out: Dict[Tuple[str, str], dict] = {}
+        for key, rec in self.records.items():
+            parts = key.split(":")
+            if len(parts) != 3 or parts[0] != "placement" \
+                    or parts[2] not in ("host", "device"):
+                continue
+            if int(rec.get("calls", 0) or 0) < 1:
+                continue
+            out[(parts[1], parts[2])] = {
+                "seconds": float(rec.get("wall_seconds", 0.0)),
+                "compile": float(rec.get("compile_seconds", 0.0)),
+                "calls": int(rec.get("calls", 0)),
+                "rows": int(rec.get("rows", 0)),
+            }
+        return out
