@@ -1,0 +1,585 @@
+"""Overload admission control (serving/admission.py + its wiring).
+
+The acceptance contracts, in the ISSUE's words:
+
+- every (model, tenant) lane queue is BOUNDED: overflow answers with a
+  machine-readable shed (``"shed": true, "retry_after_ms": N``, the
+  hint derived from predicted queue drain time) instead of growing;
+- the brownout state machine walks ok -> brownout -> shed on
+  sustained pressure and recovers through hysteresis dwells, one level
+  per dwell — provable under a fake clock;
+- per-tenant weighted DRR fair queuing: a flooding tenant is capped at
+  its share while a victim tenant keeps bitwise-identical results and
+  bounded latency; idle shares redistribute (a lone tenant is never
+  quota-shed);
+- the ``burst`` fault kind drills every shed path without real load
+  (``TX_FAULT_PLAN="admission:<model>:enqueue:1=burst:512"``);
+- the TCP front end keeps the connection OPEN across a shed answer
+  (unlike draining) and ``TcpServingClient`` honors ``retry_after_ms``
+  under its own counter (``serve_client_shed_retries``);
+- ``admission_control=None`` (tx serve --admission=off) constructs no
+  controller: the enqueue edge and answers are byte-identical to a
+  build without the module, and ``TX_TUNE=off`` / an empty store land
+  the knobs bitwise on the registry's static defaults.
+
+Everything here must stay tier-1-safe on a 1-CPU container: one small
+trained model per module, fake clocks for every dwell, short floods.
+"""
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.runtime import FaultInjector, telemetry
+from transmogrifai_tpu.runtime.errors import classify_error
+from transmogrifai_tpu.serving import (AdmissionConfig,
+                                       AdmissionController, ScoringPlan,
+                                       ServeConfig, ServeShed,
+                                       serve_in_process)
+from transmogrifai_tpu.serving.admission import BROWNOUT, OK, SHED
+from transmogrifai_tpu.serving.server import ServingServer
+from transmogrifai_tpu.tuning.registry import STATIC_DEFAULTS
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+class _Clock:
+    """Injectable fake clock: time moves only when the test says so."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def _controller(**cfg_kwargs) -> AdmissionController:
+    clk = cfg_kwargs.pop("clock", None) or _Clock()
+    ctrl = AdmissionController(
+        AdmissionConfig(clock=clk, **cfg_kwargs))
+    ctrl._test_clock = clk
+    return ctrl
+
+
+def _records(n=160, seed=5):
+    rng = np.random.default_rng(seed)
+    cats = ["a", "b", "c"]
+    recs = []
+    for _ in range(n):
+        x = float(rng.normal())
+        z = float(rng.uniform(0, 4))
+        recs.append({"x": x, "z": z,
+                     "cat": cats[int(rng.integers(0, len(cats)))],
+                     "label": float(x + 0.5 * rng.normal() > 0)})
+    return recs
+
+
+def _warm_buckets(server, name, recs, up_to=64):
+    """Pre-compile the bucket programs so measured drain rates come
+    from warm dispatches, not one-off compiles."""
+    entry = server.plans.get(name)
+    size = 1
+    while size <= up_to:
+        entry.plan.score(recs[:size])
+        size *= 2
+    return entry
+
+
+@pytest.fixture(scope="module")
+def trained():
+    recs = _records()
+    x = FeatureBuilder.of("x", Real).extract(
+        lambda r: r.get("x")).as_predictor()
+    z = FeatureBuilder.of("z", RealNN).extract(
+        lambda r: r.get("z")).as_predictor()
+    cat = FeatureBuilder.of("cat", PickList).extract(
+        lambda r: r.get("cat")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        label, transmogrify([x, z, cat])).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(recs).train(validate="off"))
+    return model, recs, pred.name
+
+
+# ---------------------------------------------------------------------------
+# the brownout FSM under a fake clock: dwells, escalation, step-down
+# ---------------------------------------------------------------------------
+
+class TestBrownoutFSM:
+    def _pressurize(self, ctrl, rows):
+        # rows=0/seconds=0 feeds no rate sample — a pure FSM probe
+        ctrl.note_dispatch(0, 0.0, total_queued_rows=rows)
+
+    def test_enter_requires_sustained_dwell(self):
+        ctrl = _controller(queue_rows=100)
+        clk = ctrl._test_clock
+        self._pressurize(ctrl, 80)          # 0.8 >= 0.75, dwell starts
+        assert ctrl.state == OK             # not sustained yet
+        clk.tick(0.3)                       # > brownout_enter_seconds
+        self._pressurize(ctrl, 80)
+        assert ctrl.state == BROWNOUT
+        assert ctrl.transitions == 1
+        assert telemetry.counters()["serve_brownout_transitions"] == 1
+
+    def test_shed_escalation_and_one_level_stepdown(self):
+        ctrl = _controller(queue_rows=100)
+        clk = ctrl._test_clock
+        self._pressurize(ctrl, 80)
+        clk.tick(0.3)
+        self._pressurize(ctrl, 80)          # -> brownout
+        self._pressurize(ctrl, 110)         # pressure 1.1 >= shed ratio
+        assert ctrl.state == SHED
+        # recovery: below the exit ratio, but one dwell steps down ONE
+        # level — shed never snaps straight back to ok
+        self._pressurize(ctrl, 10)
+        assert ctrl.state == SHED           # dwell just started
+        clk.tick(0.6)                       # > brownout_exit_seconds
+        self._pressurize(ctrl, 10)
+        assert ctrl.state == BROWNOUT
+        clk.tick(0.6)
+        self._pressurize(ctrl, 10)
+        assert ctrl.state == OK
+        assert ctrl.transitions == 4
+        events = [e for e in telemetry.events_since(0)
+                  if e["event"] == "serve_brownout_transition"]
+        assert [(e["prev"], e["state"]) for e in events] == [
+            (OK, BROWNOUT), (BROWNOUT, SHED),
+            (SHED, BROWNOUT), (BROWNOUT, OK)]
+
+    def test_hysteresis_band_accumulates_neither_dwell(self):
+        ctrl = _controller(queue_rows=100)
+        clk = ctrl._test_clock
+        self._pressurize(ctrl, 80)          # enter dwell starts
+        clk.tick(0.2)
+        self._pressurize(ctrl, 50)          # 0.5: inside the band
+        clk.tick(1.0)                       # band time counts nowhere
+        self._pressurize(ctrl, 80)          # dwell restarts from zero
+        assert ctrl.state == OK
+        clk.tick(0.3)
+        self._pressurize(ctrl, 80)
+        assert ctrl.state == BROWNOUT
+
+    def test_brownout_cuts_the_coalescer_wait(self):
+        ctrl = _controller(queue_rows=100, brownout_wait_factor=0.25)
+        assert ctrl.effective_max_wait_ms(8.0) == 8.0
+        ctrl.state = BROWNOUT
+        assert ctrl.effective_max_wait_ms(8.0) == 2.0
+
+    def test_brownout_sheds_lowest_weight_tenant_first(self):
+        ctrl = _controller(queue_rows=100,
+                           tenant_weights={"gold": 2.0, "free": 1.0})
+        clk = ctrl._test_clock
+        self._pressurize(ctrl, 80)
+        clk.tick(0.3)
+        self._pressurize(ctrl, 80)
+        assert ctrl.state == BROWNOUT
+        with pytest.raises(ServeShed, match="brownout"):
+            ctrl.admit("m", "free", 0)
+        ctrl.admit("m", "gold", 0)          # the heavy tenant passes
+        snap = ctrl.snapshot()
+        assert snap["tenants"]["free"]["shed"] == 1
+        assert snap["tenants"]["gold"]["admitted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# enqueue-edge verdicts: queue bound, deadline budget, quota
+# ---------------------------------------------------------------------------
+
+class TestAdmitVerdicts:
+    def test_queue_bound_shed_answer_shape(self):
+        ctrl = _controller(queue_rows=8)
+        with pytest.raises(ServeShed) as ei:
+            ctrl.admit("m", "default", queued_rows=8)
+        e = ei.value
+        assert e.model == "m" and e.tenant == "default"
+        assert "admission bound" in e.reason
+        # the machine-readable contract the TCP answer echoes
+        assert isinstance(e.retry_after_ms, int)
+        assert 1 <= e.retry_after_ms <= 5000
+        assert str(e).startswith("RESOURCE_EXHAUSTED")
+        # classify_error triages shed TRANSIENT: protect-the-SLO, not
+        # a verdict on the request
+        assert classify_error(e) == "transient"
+        assert telemetry.counters()["serve_admission_sheds"] == 1
+
+    def test_retry_hint_tracks_predicted_drain(self):
+        ctrl = _controller(queue_rows=8)
+        # fallback drain rate is 500 rows/s: 600 rows -> 1200 ms
+        with pytest.raises(ServeShed) as ei:
+            ctrl.admit("m", "default", queued_rows=600)
+        assert ei.value.retry_after_ms == 1200
+
+    def test_deadline_budget_sheds_doomed_requests_early(self):
+        ctrl = _controller(queue_rows=100_000,
+                           tenant_deadline_ms=100.0)
+        ctrl.admit("m", "default", queued_rows=0)       # fits
+        with pytest.raises(ServeShed, match="deadline budget"):
+            # 200 backlog rows at 500 rows/s = 400ms wait > 100ms
+            ctrl.admit("m", "default", queued_rows=200)
+
+    def test_per_tenant_deadline_map(self):
+        ctrl = _controller(queue_rows=100_000,
+                           tenant_deadline_ms={"slo": 100.0})
+        with pytest.raises(ServeShed):
+            ctrl.admit("m", "slo", queued_rows=200)
+        ctrl.admit("m", "batchy", queued_rows=200)      # unbudgeted
+
+    def test_quota_enforced_only_under_contention(self):
+        ctrl = _controller(queue_rows=100_000,
+                           token_burst_seconds=0.001)
+        clk = ctrl._test_clock
+        # a LONE flooding tenant takes the whole device: idle shares
+        # redistribute, the bucket never arms
+        for _ in range(50):
+            ctrl.admit("m", "a", 0, tenant_backlog={"a": 50})
+        # a victim shows up: the flooder is capped at its share
+        ctrl.admit("m", "a", 0, tenant_backlog={"a": 50, "b": 50})
+        with pytest.raises(ServeShed, match="quota share"):
+            ctrl.admit("m", "a", 0, tenant_backlog={"a": 50, "b": 50})
+        # the bucket refills at the weighted share of the drain rate
+        clk.tick(1.0)
+        ctrl.admit("m", "a", 0, tenant_backlog={"a": 50, "b": 50})
+
+
+# ---------------------------------------------------------------------------
+# the DRR dispatch-grant gate: weighted interleave, deterministic
+# ---------------------------------------------------------------------------
+
+class TestDRRGrants:
+    def test_weighted_deficit_round_robin_order(self):
+        async def drive():
+            ctrl = _controller(queue_rows=1000,
+                               tenant_weights={"v": 2.0, "a": 1.0})
+            ctrl.quantum = 4
+            order = []
+
+            async def grab(tenant):
+                await ctrl.acquire_grant(tenant, 4)
+                order.append(tenant)
+
+            await ctrl.acquire_grant("seed", 1)   # slot taken: park all
+            tasks = [asyncio.ensure_future(grab("v")) for _ in range(6)]
+            await asyncio.sleep(0)
+            tasks += [asyncio.ensure_future(grab("a")) for _ in range(6)]
+            await asyncio.sleep(0)
+            for _ in range(12):
+                ctrl.release_grant()
+                await asyncio.sleep(0)
+            await asyncio.gather(*tasks)
+            return order, ctrl
+
+        order, ctrl = asyncio.run(drive())
+        # quantum 4 x weight 2 serves v TWO 4-row batches per visit to
+        # a's one — strict 2:1 until v drains, then a's residue
+        assert order == ["v", "v", "a"] * 3 + ["a"] * 3
+        assert telemetry.counters()["serve_drr_grants"] == 12
+        assert ctrl.snapshot()["waiting_grants"] == 0
+
+    def test_uncontended_fast_path_skips_the_ring(self):
+        async def drive():
+            ctrl = _controller(queue_rows=1000)
+            await ctrl.acquire_grant("solo", 32)
+            ctrl.release_grant()
+            await ctrl.acquire_grant("solo", 32)
+            ctrl.release_grant()
+            return ctrl
+
+        ctrl = asyncio.run(drive())
+        assert "serve_drr_grants" not in telemetry.counters()
+        assert not ctrl._busy
+
+    def test_drain_waiters_fails_parked_grants(self):
+        async def drive():
+            ctrl = _controller(queue_rows=1000)
+            await ctrl.acquire_grant("seed", 1)
+            task = asyncio.ensure_future(ctrl.acquire_grant("t", 4))
+            await asyncio.sleep(0)
+            ctrl.drain_waiters(RuntimeError("shutdown"))
+            with pytest.raises(RuntimeError, match="shutdown"):
+                await task
+            return ctrl
+
+        ctrl = asyncio.run(drive())
+        assert ctrl.snapshot()["waiting_grants"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the burst fault: every shed path drillable without real load
+# ---------------------------------------------------------------------------
+
+class TestBurstFault:
+    def test_burst_registers_phantom_backlog_and_sheds(self):
+        ctrl = _controller(queue_rows=512)
+        with FaultInjector.plan("admission:m:enqueue:1=burst:600"):
+            with pytest.raises(ServeShed, match="admission bound"):
+                ctrl.admit("m", "default", 0)
+        assert telemetry.counters()["serve_burst_injected"] == 1
+        # the phantom spike DRAINS at the measured rate: after 2s at
+        # the 500 rows/s fallback the lane is clear again
+        ctrl._test_clock.tick(2.0)
+        ctrl.admit("m", "default", 0)
+
+    def test_burst_default_rows(self):
+        ctrl = _controller(queue_rows=512)
+        with FaultInjector.plan("admission:m:enqueue:1=burst"):
+            ctrl.admit("m", "default", 0)   # 256 phantom rows < 512
+        assert ctrl.snapshot()["pressure"] == 0.5
+
+    def test_burst_scopes_to_the_named_model(self):
+        ctrl = _controller(queue_rows=512)
+        with FaultInjector.plan("admission:other:enqueue:*=burst:600"):
+            ctrl.admit("m", "default", 0)   # different lane: no spike
+        assert "serve_burst_injected" not in telemetry.counters()
+
+
+# ---------------------------------------------------------------------------
+# server integration: noisy neighbor, metrics block, off-identity
+# ---------------------------------------------------------------------------
+
+class TestServerIntegration:
+    def test_noisy_neighbor_victim_keeps_bitwise_results(self, trained):
+        model, recs, pred = trained
+        server, client = serve_in_process(
+            {"m": model},
+            ServeConfig(max_wait_ms=5.0, sentinel=False,
+                        admission_control=AdmissionConfig(
+                            tenant_weights={"victim": 2.0,
+                                            "aggressor": 1.0},
+                            token_burst_seconds=2.0)))
+        try:
+            _warm_buckets(server, "m", recs)
+            victim_batch = [dict(r) for r in recs[:24]]
+            solo = client.score_many(victim_batch, tenant="victim")
+            # open-loop flood from the aggressor while the victim
+            # scores the SAME batch again
+            flood = [client.submit(dict(recs[i % 64]),
+                                   tenant="aggressor")
+                     for i in range(120)]
+            t0 = time.perf_counter()
+            under_load = client.score_many(victim_batch,
+                                           tenant="victim")
+            victim_elapsed = time.perf_counter() - t0
+            shed = 0
+            for f in flood:
+                try:
+                    f.result(timeout=60)
+                except ServeShed:
+                    shed += 1
+            # isolation: the victim's rows never moved a bit
+            for r0, r1 in zip(solo, under_load):
+                assert r0[pred] == r1[pred]
+            # and its batch completed in bounded time despite the flood
+            assert victim_elapsed < 30.0
+            snap = server.metrics_snapshot()["admission"]
+            assert snap["tenants"]["victim"]["weight"] == 2.0
+            assert snap["tenants"]["victim"]["shed"] == 0
+            assert snap["tenants"]["aggressor"]["admitted"] \
+                + shed == 120
+        finally:
+            server.stop()
+
+    def test_metrics_snapshot_admission_block(self, trained):
+        model, recs, _ = trained
+        server, client = serve_in_process(
+            {"m": model},
+            ServeConfig(max_wait_ms=5.0, sentinel=False,
+                        admission_control=AdmissionConfig(
+                            tenant_weights={"gold": 2.0},
+                            tenant_deadline_ms={"gold": 5000.0})))
+        try:
+            client.score_many([dict(r) for r in recs[:8]],
+                              tenant="gold")
+            snap = server.metrics_snapshot()
+            adm = snap["admission"]
+            assert adm["enabled"] is True
+            assert adm["state"] == OK
+            assert adm["queue_rows_limit"] >= 1
+            assert adm["quantum_rows"] >= 1
+            assert adm["drain_rows_per_s"] > 0
+            gold = adm["tenants"]["gold"]
+            assert gold["weight"] == 2.0
+            assert gold["admitted"] == 8 and gold["shed"] == 0
+            assert gold["deadline_ms"] == 5000.0
+            assert {d["knob"] for d in adm["decisions"]} == {
+                "serving.admission_queue_rows",
+                "serving.admission_quantum"}
+        finally:
+            server.stop()
+
+    def test_admission_off_is_absent_not_idle(self, trained):
+        """admission_control=None constructs NO controller: the
+        dispatch gate is the plain semaphore and the metrics block
+        says so — the --admission=off escape hatch."""
+        model, recs, pred = trained
+        offline = (ScoringPlan(model).compile()
+                   .with_guardrails(sentinel=False)
+                   .score_guarded([dict(r) for r in recs[:16]])
+                   .scored[pred])
+        server, client = serve_in_process(
+            {"m": model}, ServeConfig(max_wait_ms=5.0, sentinel=False))
+        try:
+            assert server._admission is None
+            rows = client.score_many([dict(r) for r in recs[:16]])
+            for i, row in enumerate(rows):
+                assert row[pred]["prediction"] == offline.data[i]
+            snap = server.metrics_snapshot()
+            assert snap["admission"] == {"enabled": False}
+            for c in ("serve_admitted", "serve_admission_sheds",
+                      "serve_drr_grants"):
+                assert c not in telemetry.counters()
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the TCP contract: shed answer shape, open connection, client retry
+# ---------------------------------------------------------------------------
+
+class TestShedOverTcp:
+    def _server(self, model):
+        server = ServingServer(ServeConfig(
+            max_wait_ms=5.0, sentinel=False,
+            admission_control=AdmissionConfig()))
+        server.add_model("m", model)
+        return server
+
+    def test_shed_answer_shape_and_connection_stays_open(
+            self, trained):
+        model, recs, pred = trained
+        from transmogrifai_tpu.cli.serve import serve_forever
+
+        async def drive():
+            server = self._server(model)
+            port_box = {}
+            task = asyncio.ensure_future(serve_forever(
+                server, "127.0.0.1", 0, max_requests=2,
+                ready_cb=lambda p: port_box.setdefault("p", p)))
+            while "p" not in port_box:
+                await asyncio.sleep(0.005)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port_box["p"])
+            line = (json.dumps({"record": recs[0], "model": "m",
+                                "id": "r-1"}) + "\n").encode()
+            with FaultInjector.plan("admission:m:enqueue:1=burst:520"):
+                writer.write(line)
+                await writer.drain()
+                first = json.loads(await reader.readline())
+                # SAME socket, next request after the phantom spike
+                # drains below the lane bound: a normal score answer
+                await asyncio.sleep(0.3)
+                writer.write(line)
+                await writer.drain()
+                second = json.loads(await reader.readline())
+            writer.close()
+            await task
+            return first, second
+
+        first, second = asyncio.run(drive())
+        assert first["ok"] is False and first["shed"] is True
+        assert first["request_id"] == "r-1"
+        assert isinstance(first["retry_after_ms"], int)
+        assert first["retry_after_ms"] >= 1
+        assert "RESOURCE_EXHAUSTED" in first["error"]
+        assert first["kind"] == "transient"
+        assert "draining" not in first
+        assert second["ok"] is True
+        assert "prediction" in second["result"][pred]
+
+    def test_client_honors_retry_after_ms(self, trained):
+        model, recs, pred = trained
+        from transmogrifai_tpu.cli.serve import serve_forever
+        from transmogrifai_tpu.runtime.retry import RetryPolicy
+        from transmogrifai_tpu.serving import TcpServingClient
+        server = self._server(model)
+        port_box = {}
+
+        def run():
+            asyncio.run(serve_forever(
+                server, "127.0.0.1", 0, max_requests=2,
+                ready_cb=lambda p: port_box.setdefault("p", p)))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        while "p" not in port_box:
+            time.sleep(0.005)
+        # the 520-row spike drains below the 512-row bound within
+        # ~16ms at the 500 rows/s fallback rate; the capped backoff
+        # (max_delay) comfortably outlasts it
+        retry = RetryPolicy(max_attempts=3, base_delay=0.01,
+                            max_delay=0.25)
+        with FaultInjector.plan("admission:m:enqueue:1=burst:520"):
+            with TcpServingClient("127.0.0.1", port_box["p"],
+                                  retry=retry) as client:
+                out = client.score(dict(recs[0]), model="m")
+        t.join(timeout=10)
+        # shed -> sleep the hint (capped at max_delay) -> resend on
+        # the SAME connection -> scored
+        assert out["ok"] is True
+        assert "prediction" in out["result"][pred]
+        counters = telemetry.counters()
+        assert counters["serve_client_shed_retries"] == 1
+        # distinct from drain retries and NOT a reconnect
+        assert "serve_client_drain_retries" not in counters
+        assert "serve_client_reconnects" not in counters
+
+
+# ---------------------------------------------------------------------------
+# tuning identity: TX_TUNE=off / empty store -> bitwise static knobs
+# ---------------------------------------------------------------------------
+
+class TestColdStartKnobs:
+    def test_tx_tune_off_lands_on_static_defaults(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TX_TUNE", "off")
+        from transmogrifai_tpu.tuning.policy import TuningPolicy
+        pol = TuningPolicy(path=str(tmp_path / "store.json"))
+        qd = pol.admission_queue_rows(256)
+        nd = pol.admission_quantum()
+        assert not qd.tuned() and not nd.tuned()
+        assert qd.chosen == STATIC_DEFAULTS[
+            "serving.admission_queue_rows"]
+        assert nd.chosen == STATIC_DEFAULTS[
+            "serving.admission_quantum"]
+        ctrl = AdmissionController(AdmissionConfig(clock=_Clock()),
+                                   tuning=pol)
+        assert ctrl.queue_rows == STATIC_DEFAULTS[
+            "serving.admission_queue_rows"]
+        assert ctrl.quantum == STATIC_DEFAULTS[
+            "serving.admission_quantum"]
+
+    def test_empty_store_lands_on_static_defaults(self, tmp_path):
+        from transmogrifai_tpu.tuning.policy import TuningPolicy
+        pol = TuningPolicy(path=str(tmp_path / "store.json"),
+                           enabled=True)
+        qd = pol.admission_queue_rows(256)
+        assert not qd.tuned()
+        assert qd.chosen == STATIC_DEFAULTS[
+            "serving.admission_queue_rows"]
+        ctrl = AdmissionController(AdmissionConfig(clock=_Clock()),
+                                   tuning=pol)
+        # no recorded score buckets: the drain seed is the fallback
+        assert ctrl.snapshot()["drain_rows_per_s"] == 500.0
+
+    def test_explicit_config_beats_the_knob(self):
+        ctrl = _controller(queue_rows=64, quantum_rows=8)
+        snap = ctrl.snapshot()
+        assert snap["queue_rows_limit"] == 64
+        assert snap["quantum_rows"] == 8

@@ -1248,6 +1248,109 @@ class TestR04TornStateWrite:
         assert findings == []
 
 
+class TestR05UnboundedQueue:
+    """TX-R05: a bare deque()/asyncio.Queue() bound to a request-queue
+    name in serving/ grows without limit under overload — queues must
+    be bounded and overflow shed at the admission edge
+    (docs/admission.md)."""
+
+    SRV = "transmogrifai_tpu/serving/myqueue.py"
+
+    def _lint(self, code, path=None):
+        return lint_source(textwrap.dedent(code), path or self.SRV)
+
+    def test_bare_deque_flagged(self):
+        findings = self._lint("""
+            import collections
+
+            class Lane:
+                def __init__(self):
+                    self.queue = collections.deque()
+        """)
+        assert "TX-R05" in _rules(findings)
+        f = [x for x in findings if x.rule_id == "TX-R05"][0]
+        assert f.severity == "error"
+        assert "admission edge" in (f.hint or "")
+
+    def test_bare_asyncio_queue_flagged(self):
+        findings = self._lint("""
+            import asyncio
+
+            def make_backlog():
+                backlog = asyncio.Queue()
+                return backlog
+        """)
+        assert "TX-R05" in _rules(findings)
+
+    def test_annotated_assign_flagged(self):
+        findings = self._lint("""
+            from collections import deque
+
+            class Lane:
+                def __init__(self):
+                    self.pending: deque = deque()
+        """)
+        assert "TX-R05" in _rules(findings)
+
+    def test_explicit_unbounded_values_flagged(self):
+        # maxlen=None and maxsize=0 are the unbounded spellings
+        findings = self._lint("""
+            import asyncio, collections
+
+            def build():
+                queue = collections.deque(maxlen=None)
+                pending = asyncio.Queue(maxsize=0)
+                return queue, pending
+        """)
+        assert len([f for f in findings
+                    if f.rule_id == "TX-R05"]) == 2
+
+    def test_bounded_constructions_legal(self):
+        findings = self._lint("""
+            import asyncio, collections
+
+            class Lane:
+                def __init__(self, limit):
+                    self.queue = collections.deque(maxlen=limit)
+                    self.backlog = asyncio.Queue(maxsize=64)
+                    self.pending = collections.deque([], 128)
+        """)
+        assert "TX-R05" not in _rules(findings)
+
+    def test_non_queue_names_legal(self):
+        # a deque used as a scratch buffer is not a request queue
+        findings = self._lint("""
+            import collections
+
+            def window(xs):
+                recent = collections.deque()
+                for x in xs:
+                    recent.append(x)
+                return list(recent)
+        """)
+        assert "TX-R05" not in _rules(findings)
+
+    def test_outside_serving_is_silent(self):
+        findings = self._lint("""
+            import collections
+
+            class Worker:
+                def __init__(self):
+                    self.queue = collections.deque()
+        """, path="transmogrifai_tpu/selector/pool.py")
+        assert "TX-R05" not in _rules(findings)
+
+    def test_inline_suppression(self, tmp_path):
+        d = tmp_path / "serving"
+        d.mkdir()
+        p = d / "lanes.py"
+        p.write_text("import collections\n"
+                     "queue = collections.deque()"
+                     "  # tx-lint: disable=TX-R05\n")
+        findings, _ = lint_paths([str(p)])
+        assert findings == []
+
+
 class TestJ08ShardClosure:
     """TX-J08: a shard_map/pjit body closing over an array-like value
     gets implicit full replication — arrays must enter through

@@ -331,16 +331,17 @@ class TestDrainInProcess:
 
 
 # ---------------------------------------------------------------------------
-# metrics: the process block (schema v3), field set pinned
+# metrics: the process block (schema v4), field set pinned
 # ---------------------------------------------------------------------------
 
 class TestProcessMetrics:
-    def test_process_block_fields_pinned_schema_v3(self, trained):
+    def test_process_block_fields_pinned(self, trained):
         model, _recs, _pred = trained
         server = ServingServer(ServeConfig(sentinel=False))
         server.add_model("m", model)
         snap = server.metrics_snapshot()
-        assert snap["schema"] == 3
+        # v4 added the "admission" block (docs/admission.md)
+        assert snap["schema"] == 4
         assert set(snap["process"]) == {
             "uptime_seconds", "restart_generation", "draining",
             "ready", "inflight", "last_snapshot_age_seconds"}

@@ -8,6 +8,16 @@ one JSON line per request, in order::
     <- {"ok": true, "request_id": "req-1a2b-3", "result": {...}}
     <- {"ok": false, "request_id": "...", "error": "...",
         "kind": "transient"}
+    <- {"ok": false, "request_id": "...", "shed": true,
+        "retry_after_ms": 12, "error": "...", "kind": "transient"}
+
+The ``"shed"`` answer is the overload admission controller refusing a
+request at the door (docs/admission.md): bounded lane queues, a
+cost-model deadline budget, per-tenant fair-queuing quotas and the
+brownout state machine all shed through it, the hint derived from
+predicted queue drain time. ``--admission=off`` removes the
+controller entirely (the pre-admission enqueue edge, byte-for-byte);
+``--tenant-weight``/``--tenant-deadline-ms`` shape it.
 
 Every response echoes a ``request_id`` — generated at admission, or
 the client's own ``"id"`` field when supplied — the same id that keys
@@ -88,6 +98,27 @@ def add_serve_parser(sub) -> None:
                          "guardrails (docs/serving_guardrails.md)")
     sv.add_argument("--no-sentinel", action="store_true",
                     help="disable the per-tenant drift sentinel")
+    sv.add_argument("--admission", choices=["on", "off"], default="on",
+                    help="overload admission control "
+                         "(docs/admission.md): bounded lane queues "
+                         "with retry_after_ms shed answers, cost-model "
+                         "deadline admission, per-tenant DRR fair "
+                         "queuing, brownout load shedding. "
+                         "--admission=off restores the pre-admission "
+                         "enqueue edge byte-for-byte")
+    sv.add_argument("--tenant-weight", action="append", default=None,
+                    metavar="NAME=W",
+                    help="fair-queuing weight / quota share for a "
+                         "tenant (repeatable; unlisted tenants weigh "
+                         "1.0; brownout sheds lower-weight tenants "
+                         "first)")
+    sv.add_argument("--tenant-deadline-ms", action="append",
+                    default=None, metavar="[NAME=]MS",
+                    help="per-request completion budget: a request "
+                         "whose predicted completion (queue wait + "
+                         "encode + dispatch) exceeds it is shed at "
+                         "the door (repeatable; a bare MS applies to "
+                         "every tenant)")
     sv.add_argument("--max-requests", type=int, default=None,
                     help="exit after answering N requests (smoke/CI)")
     sv.add_argument("--auto-retrain", action="store_true",
@@ -174,7 +205,7 @@ async def serve_forever(server, host: str, port: int,
     ``state_manager`` (serving/state.StateManager) arms snapshot
     writes — every ``snapshot_interval`` seconds and at shutdown."""
     from ..runtime.errors import classify_error
-    from ..serving.server import ServeDraining
+    from ..serving.server import ServeDraining, ServeShed
     await server.start()
     answered = {"n": 0}
     done = asyncio.Event()
@@ -235,6 +266,16 @@ async def serve_forever(server, host: str, port: int,
                                   + "\n").encode())
                     await writer.drain()
                     break
+                except ServeShed as e:
+                    # overload shed (docs/admission.md): unlike
+                    # draining, the server is healthy and the
+                    # connection STAYS OPEN — the client honors the
+                    # retry hint and resends on the same socket
+                    out = {"ok": False, "request_id": rid,
+                           "shed": True,
+                           "retry_after_ms": e.retry_after_ms,
+                           "error": f"{type(e).__name__}: {e}",
+                           "kind": classify_error(e)}
                 except Exception as e:
                     # a bad request/record answers with the classified
                     # error instead of dropping the connection
@@ -367,6 +408,29 @@ def run_serve(args) -> int:
             retrain_budget_seconds=args.retrain_budget,
             canary_rows=args.canary_rows,
             swap_policy=args.swap_policy)
+    admission_control = None
+    if getattr(args, "admission", "on") != "off":
+        # overload admission (docs/admission.md); --admission=off
+        # leaves this None -> the enqueue edge is byte-identical to
+        # a build without the controller
+        from ..serving.admission import AdmissionConfig
+        weights = {}
+        for spec in (getattr(args, "tenant_weight", None) or []):
+            name, _, w = spec.partition("=")
+            weights[name] = float(w or 1.0)
+        deadline = None
+        d = {}
+        for spec in (getattr(args, "tenant_deadline_ms", None) or []):
+            name, sep, ms = spec.partition("=")
+            if sep:
+                d[name] = float(ms)
+            else:
+                d["default"] = float(name)
+        if d:
+            deadline = (d["default"] if set(d) == {"default"}
+                        else d)
+        admission_control = AdmissionConfig(
+            tenant_weights=weights, tenant_deadline_ms=deadline)
     config = ServeConfig(
         max_wait_ms=args.max_wait_ms,
         target_batch=args.target_batch,
@@ -375,7 +439,8 @@ def run_serve(args) -> int:
         deadline_seconds=args.deadline_seconds,
         guardrails=not args.no_guardrails,
         sentinel=not args.no_sentinel,
-        lifecycle=lifecycle)
+        lifecycle=lifecycle,
+        admission_control=admission_control)
     server = ServingServer(config)
     for name, path in _parse_models(args.model):
         server.add_model(name, path)
@@ -409,6 +474,8 @@ def run_serve(args) -> int:
         banner_extra["tuned"] = {
             "target_batch": server._target_decision.chosen,
             "buckets": [d.chosen for d in server._bucket_decisions]}
+    if admission_control is not None:
+        banner_extra["admission"] = "on"
     try:
         return asyncio.run(serve_forever(
             server, args.host, args.port,

@@ -94,6 +94,14 @@ RULES: Dict[str, tuple] = {
                       "document if the process dies mid-write — write "
                       "through observability.store.atomic_write_json "
                       "(tmp file + os.replace)"),
+    "TX-R05": (ERROR, "unbounded request queue in serving/: a bare "
+                      "collections.deque() or asyncio.Queue() holding "
+                      "requests grows without limit under overload — "
+                      "first memory, then every queued request's "
+                      "latency; bound it (maxlen=/maxsize=) and shed "
+                      "overflow at the admission edge "
+                      "(serving/admission.py) with a retry_after_ms "
+                      "answer instead of queue-and-pray"),
     # -- tuning rules ------------------------------------------------------
     "TX-T01": (ERROR, "numeric literal default for a registered tunable "
                       "knob outside tuning/ — the knob's single source "

@@ -79,6 +79,17 @@ call) are caught here in milliseconds:
   be. Paths that mention ``tmp`` (a ``.tmp`` suffix concatenation, a
   ``tmp``-named variable, tempfile machinery) are the sanctioned
   staging idiom and stay legal; reads are untouched.
+- TX-R05 unbounded request queue (``serving/`` files only): a bare
+  ``collections.deque()`` / ``asyncio.Queue()`` (no ``maxlen=`` /
+  ``maxsize=``, or an explicit unbounded ``maxlen=None`` /
+  ``maxsize=0``) assigned to a request-queue-shaped name (``*queue*``,
+  ``*backlog*``, ``*pending*``). An unbounded lane queue is the
+  overload failure mode admission control exists to close
+  (docs/admission.md): a burst above capacity grows it without limit —
+  first memory, then every queued request's latency. Bound the
+  container and shed overflow at the enqueue edge with a
+  machine-readable ``retry_after_ms`` answer (serving/admission.py);
+  bounded constructions and non-queue names are untouched.
 - TX-O01 telemetry/trace emission inside a jitted function body:
   ``telemetry.event(...)``/``telemetry.count(...)``, a tracer span
   enter/exit (``trace.span``/``add_span``/``add_event``), or a
@@ -1041,6 +1052,75 @@ class _Visitor(ast.NodeVisitor):
                  "(stages to *.tmp, then os.replace — the live path "
                  "is never half-written)")
 
+    # -- TX-R05: unbounded request queues in serving/ ----------------------
+    _QUEUE_NAME_HINTS = ("queue", "backlog", "pending")
+
+    @staticmethod
+    def _queueish_name(target: ast.AST) -> Optional[str]:
+        """The request-queue-shaped name a store targets, or None —
+        a plain name or attribute (``self.queue = ...``) whose
+        lowercase spelling mentions queue/backlog/pending."""
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        if name is None:
+            return None
+        low = name.lower()
+        return name if any(h in low for h in
+                           _Visitor._QUEUE_NAME_HINTS) else None
+
+    def _check_unbounded_queue(self, targets, value) -> None:
+        """TX-R05: a bare ``deque()``/``Queue()`` bound to a request-
+        queue name in serving/ grows without limit under overload —
+        the exact failure mode the admission edge exists to close
+        (docs/admission.md). Bounded constructions (``maxlen=``, a
+        positive ``maxsize=``) pass."""
+        if not isinstance(value, ast.Call):
+            return
+        fn = value.func
+        ctor = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if ctor == "deque":
+            # deque(iterable, maxlen) — second positional IS the bound
+            cap = value.args[1] if len(value.args) >= 2 else None
+            for kw in value.keywords:
+                if kw.arg == "maxlen":
+                    cap = kw.value
+            unbounded = cap is None or (
+                isinstance(cap, ast.Constant) and cap.value is None)
+        elif ctor == "Queue":
+            # asyncio.Queue(maxsize=0) and Queue() are unbounded
+            cap = value.args[0] if value.args else None
+            for kw in value.keywords:
+                if kw.arg == "maxsize":
+                    cap = kw.value
+            unbounded = cap is None or (
+                isinstance(cap, ast.Constant) and cap.value in (0, None))
+        else:
+            return
+        if not unbounded:
+            return
+        for target in targets:
+            name = self._queueish_name(target)
+            if name is None:
+                continue
+            where = (f" in {self.fn_stack[-1].name!r}"
+                     if self.fn_stack else "")
+            self.add(
+                "TX-R05", value,
+                f"unbounded {ctor}() assigned to request queue "
+                f"{name!r}{where} — under overload it grows without "
+                f"limit: first memory, then every queued request's "
+                f"latency (no backpressure ever fires)",
+                ERROR,
+                hint="bound it (collections.deque(maxlen=...) / "
+                     "asyncio.Queue(maxsize=...)) and shed overflow "
+                     "at the admission edge with a retry_after_ms "
+                     "answer (serving/admission.py)")
+            return
+
     # -- TX-O01: telemetry/trace emission inside a jitted body -------------
     _CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "time_ns",
                     "perf_counter_ns", "monotonic_ns"}
@@ -1293,6 +1373,7 @@ class _Visitor(ast.NodeVisitor):
         if self.serving:
             for target in node.targets:
                 self._check_live_mutation(target)
+            self._check_unbounded_queue(node.targets, node.value)
         for target in node.targets:
             self._check_tunable_const(target, node.value)
         self.generic_visit(node)
@@ -1301,6 +1382,10 @@ class _Visitor(ast.NodeVisitor):
         # TX-T01 also covers the annotated form
         # (`DEFAULT_ETA: int = 3`) — same knob, same second source
         self._check_tunable_const(node.target, node.value)
+        if self.serving and node.value is not None:
+            # TX-R05 covers the annotated spelling too
+            # (`self.queue: deque = deque()`)
+            self._check_unbounded_queue([node.target], node.value)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:

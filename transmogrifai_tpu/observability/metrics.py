@@ -26,7 +26,12 @@ __all__ = ["METRICS_SCHEMA_VERSION", "LatencyHistogram", "ServeMetrics"]
 #:     draining/ready flags, in-flight count, last snapshot age) +
 #:     "plan_compiles" — the restart-drill contract
 #:     (docs/serving_restart.md)
-METRICS_SCHEMA_VERSION = 3
+#: v4: top-level "admission" block (overload admission state: brownout
+#:     state + transitions, pressure, lane bound / DRR quantum,
+#:     measured drain rate, per-tenant weight/admitted/shed counts,
+#:     knob decisions) — {"enabled": false} when the controller is off
+#:     (docs/admission.md)
+METRICS_SCHEMA_VERSION = 4
 
 
 class LatencyHistogram:

@@ -22,7 +22,8 @@ with the grammar ``scope:name:site:n=fault``:
   retrain/swap loop, docs/self_healing.md; name = the registered model
   name), ``state`` (the warm-restart snapshot path,
   docs/serving_restart.md; name = the registered model name or
-  ``server``).
+  ``server``), ``admission`` (the overload admission edge,
+  docs/admission.md; name = the registered model name).
 - ``name``   — exact match or ``*``.
 - ``site``   — where the probe sits: ``dispatch`` (per-family device
   eval or the serving plan's fused-program dispatch, once per retry
@@ -41,7 +42,11 @@ with the grammar ``scope:name:site:n=fault``:
   the document mid-write so the restore side's torn-tail detection is
   drillable) and ``restore`` (``state:<model>:restore`` — probed while
   rebuilding warm state on ``--resume-state`` boot; any fault must
-  degrade to a clean cold start, never a crash).
+  degrade to a clean cold start, never a crash), and ``enqueue``
+  (``admission:<model>:enqueue`` — probed on every admission check; a
+  ``burst`` fault registers a phantom arrival spike against the lane
+  so shed answers, retry hints and the brownout state machine are
+  drillable without generating real load).
 - ``n``      — fire at the Nth matching probe (1-based), or ``*`` for
   every one.
 - ``fault``  — ``oom`` (RESOURCE_EXHAUSTED-shaped — transient, then
@@ -51,7 +56,11 @@ with the grammar ``scope:name:site:n=fault``:
   quarantine layer deliberately does NOT absorb), ``nan`` (poison the
   metric matrix), ``torn`` (the snapshot writer truncates the
   document mid-serialization — a simulated crash between write and
-  rename), ``hang:<seconds>`` (sleep — the deadline test).
+  rename), ``hang:<seconds>`` (sleep — the deadline test),
+  ``burst[:<rows>]`` (an injected arrival spike of ``rows`` phantom
+  queued rows — default 256 — that the admission controller treats as
+  real backlog draining at the measured rate; caller-handled like
+  ``nan``/``torn``).
 
 Activate with the context manager (tests) or ``TX_FAULT_PLAN`` (bench,
 reproducing a field failure)::
@@ -119,7 +128,8 @@ class _Rule:
     name: str        # exact or "*"
     site: str
     nth: Optional[int]   # None = every occurrence
-    fault: str   # "oom"|"preempt"|"bug"|"kill"|"nan"|"torn"|"hang:<s>"
+    fault: str   # "oom"|"preempt"|"bug"|"kill"|"nan"|"torn"
+    #             |"hang:<s>"|"burst[:<rows>]"
 
 
 def _parse_plan(text: str) -> List[_Rule]:
@@ -203,6 +213,10 @@ class FaultInjector:
             _, _, secs = rule.fault.partition(":")
             time.sleep(float(secs or "60"))
             return None
+        if rule.fault.startswith("burst"):
+            # caller-handled (serving/admission.py): the controller
+            # parses the row count and queues a phantom backlog
+            return rule.fault
         raise ValueError(f"unknown fault {rule.fault!r} in plan "
                          f"{self.plan_text!r}")
 

@@ -21,6 +21,8 @@ from .sentinel import (DriftSentinel, DriftThresholds,
                        FeatureFingerprint, FingerprintSchemaError,
                        compute_fingerprints, load_fingerprint_doc,
                        load_fingerprints, save_fingerprints)
+from .admission import (AdmissionConfig, AdmissionController,
+                        ServeShed)
 from .server import (PlanCache, ServeConfig, ServeDraining,
                      ServeRejected, ServingClient, ServingServer,
                      serve_in_process)
@@ -37,7 +39,9 @@ __all__ = ["ScoringPlan", "EncodedScoreBatch", "PlanCoverage",
            "save_fingerprints", "load_fingerprints",
            "load_fingerprint_doc",
            "ServeConfig", "ServingServer", "ServingClient", "PlanCache",
-           "ServeRejected", "ServeDraining", "serve_in_process",
+           "ServeRejected", "ServeDraining", "ServeShed",
+           "AdmissionConfig", "AdmissionController",
+           "serve_in_process",
            "LifecycleConfig", "ModelLifecycle",
            "ServingStateSnapshot", "StateManager", "SNAPSHOT_SCHEMA",
            "TcpServingClient", "ServingUnavailable"]

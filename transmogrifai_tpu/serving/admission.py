@@ -1,0 +1,587 @@
+"""Overload-robust admission control for the serving loop
+(docs/admission.md).
+
+The coalescing loop (serving/server.py) survives crashes, bad rows and
+drift — but not *load*: before this module, lane queues grew without
+bound, a burst above device capacity stretched every queued request's
+latency, and one flooding tenant starved every other lane behind the
+shared dispatch semaphore. The overload-control literature's answer
+(PAPERS.md: SLO-aware serving admission a la Clipper/INFaaS) is to
+admit-or-shed AT THE DOOR using a predicted-cost budget — serve fewer
+requests on time instead of serving everyone late. Four mechanisms,
+one :class:`AdmissionController` on the enqueue edge:
+
+- **Bounded lanes + machine-readable shed.** Every (model, tenant)
+  lane queue is bounded at ``serving.admission_queue_rows`` (a tuning
+  knob). Overflow raises :class:`ServeShed`, which the TCP front end
+  turns into ``{"ok": false, "shed": true, "retry_after_ms": N}`` —
+  the hint derived from the CURRENT queue's predicted drain time, so
+  a well-behaved client (serving/client.py) backs off exactly as long
+  as the backlog needs.
+- **Cost-model deadline admission.** With a tenant deadline budget
+  configured, a request is admitted only if its predicted completion
+  — queue wait (backlog rows / measured drain rate) + coalesce wait +
+  predicted encode+dispatch for the target bucket (the PR-13
+  :class:`~..tuning.model.CostModel`) — fits the budget. Under
+  overload the loop sheds EARLY, at enqueue, instead of paying queue
+  time on a request that was already doomed to miss its SLO.
+- **Weighted deficit-round-robin fair queuing.** Dispatch grants are
+  scheduled across tenant lanes by classic DRR (deficit += quantum x
+  weight per round, a lane dispatches when its deficit covers the
+  batch's rows), with a per-tenant token bucket refilled at the
+  tenant's weighted share of the measured drain rate. The bucket is
+  enforced ONLY under contention — a lone tenant takes the whole
+  device (idle shares redistribute), a noisy neighbor is capped at
+  its share the moment a victim shows up.
+- **Brownout state machine.** Sustained pressure (busiest lane's
+  backlog vs its bound) walks ``ok -> brownout -> shed`` with
+  hysteresis dwells on every edge. Brownout cuts the coalescer's
+  max-wait (smaller, sooner batches: the loop trades occupancy for
+  latency headroom) and sheds the LOWEST-weight tenants first; shed
+  refuses all new work until pressure clears the exit threshold for
+  the exit dwell. Every transition lands in telemetry
+  (``serve_brownout_transitions``), a span (``serve.admission_state``)
+  and ``metrics_snapshot()["admission"]``.
+
+Determinism: the controller takes an injectable ``clock`` (the fake-
+clock hysteresis tests pin it), fires no timers of its own (state is
+re-evaluated on enqueue/dispatch events), and the ``burst`` fault
+(``TX_FAULT_PLAN="admission:<model>:enqueue:1=burst:512"``,
+runtime/faults.py) registers a phantom arrival spike against a lane so
+every shed/brownout path is drillable without real load.
+
+``ServeConfig(admission_control=None)`` — the default, and the
+``tx serve --admission=off`` escape hatch — constructs no controller:
+the enqueue edge, dispatch semaphore and answers are byte-identical
+to a build without this module.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ..observability import trace as _trace
+from ..runtime import telemetry as _telemetry
+from ..runtime.faults import maybe_inject
+
+__all__ = ["AdmissionConfig", "AdmissionController", "ServeShed",
+           "OK", "BROWNOUT", "SHED"]
+
+#: brownout states (docs/admission.md — the state machine)
+OK = "ok"
+BROWNOUT = "brownout"
+SHED = "shed"
+
+#: drain-rate fallback before any dispatch has been measured and the
+#: cost model has no score:b* records (rows/second; only shapes the
+#: retry_after_ms HINT, never an admit/shed verdict on its own)
+_FALLBACK_DRAIN_ROWS_PER_S = 500.0
+
+#: EWMA smoothing for the measured drain rate
+_DRAIN_ALPHA = 0.3
+
+#: retry_after_ms hint clamp
+_RETRY_MIN_MS, _RETRY_MAX_MS = 1, 5000
+
+#: per-lane shed-event log throttle (seconds): during a shed storm at
+#: most one serve_request_shed event per lane per window is formatted,
+#: carrying a ``suppressed`` count for the rest
+_SHED_LOG_INTERVAL_S = 0.25
+
+
+class ServeShed(RuntimeError):
+    """A request was shed by admission control (queue bound, deadline
+    budget, quota, or brownout). Carries the machine-readable retry
+    hint the TCP front end echoes (``"shed": true,
+    "retry_after_ms": N``). The message is RESOURCE_EXHAUSTED-shaped
+    so ``classify_error`` triages it transient — shed is the server
+    protecting its SLO, not a verdict on the request."""
+
+    def __init__(self, model: str, tenant: str, reason: str,
+                 retry_after_ms: int):
+        self.model = model
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_ms = int(retry_after_ms)
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: lane {model}/{tenant} shed under "
+            f"overload ({reason}); retry after "
+            f"{self.retry_after_ms}ms")
+
+
+@dataclass
+class AdmissionConfig:
+    """Knobs of the admission controller (docs/admission.md). ``None``
+    numeric fields resolve through the tuning policy
+    (tuning/registry.py + tuning/policy.py) — with an empty store or
+    ``TX_TUNE=off`` they land bitwise on the static defaults."""
+    #: tenant name -> DRR weight / quota share (unlisted tenants: 1.0)
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
+    #: per-request completion budget (ms): a float applies to every
+    #: tenant, a dict maps tenant -> budget (missing tenants
+    #: unbudgeted). None disables deadline admission.
+    tenant_deadline_ms: Union[None, float, Dict[str, float]] = None
+    #: per-lane queue bound in rows; None -> serving.admission_queue_rows
+    queue_rows: Optional[int] = None
+    #: DRR quantum in rows; None -> serving.admission_quantum
+    quantum_rows: Optional[int] = None
+    #: brownout enter: busiest-lane pressure >= this for enter_seconds
+    brownout_enter_ratio: float = 0.75
+    #: brownout exit: pressure <= this for exit_seconds (hysteresis)
+    brownout_exit_ratio: float = 0.35
+    #: shed escalation: pressure >= this (the lane bound itself)
+    shed_enter_ratio: float = 1.0
+    brownout_enter_seconds: float = 0.25
+    brownout_exit_seconds: float = 0.5
+    #: coalescer max-wait multiplier while browned out (smaller,
+    #: sooner batches)
+    brownout_wait_factor: float = 0.25
+    #: token-bucket burst, in multiples of the refill share per second
+    token_burst_seconds: float = 0.25
+    #: injectable time source (tests pin a fake clock)
+    clock: Optional[Callable[[], float]] = None
+
+
+class _TenantState:
+    """Per-tenant accounting: admitted/shed counters + token bucket."""
+
+    __slots__ = ("admitted", "shed", "tokens", "refilled_at")
+
+    def __init__(self, now: float):
+        self.admitted = 0
+        self.shed = 0
+        self.tokens: Optional[float] = None   # armed on first refill
+        self.refilled_at = now
+
+
+class AdmissionController:
+    """The enqueue-edge gatekeeper + dispatch-grant scheduler. One per
+    :class:`~.server.ServingServer`; every method runs on the server's
+    event loop (single-threaded — no locks needed)."""
+
+    def __init__(self, config: AdmissionConfig,
+                 tuning: Optional[Any] = None,
+                 max_batch: int = 256,
+                 max_wait_ms: float = 5.0):
+        self.config = config
+        self.clock = config.clock or time.monotonic
+        now = self.clock()
+        #: knob resolution (override -> model -> static); the decision
+        #: records surface in metrics_snapshot()["admission"]
+        self.decisions: List[Any] = []
+        queue_rows, quantum = config.queue_rows, config.quantum_rows
+        dispatch_s = None
+        if tuning is not None:
+            qd = tuning.admission_queue_rows(max_batch)
+            nd = tuning.admission_quantum()
+            self.decisions = [qd, nd]
+            if queue_rows is None:
+                queue_rows = int(qd.chosen)
+            if quantum is None:
+                quantum = int(nd.chosen)
+            known = tuning.model.recorded_buckets("score")
+            rates = [(b / max(e.execute or e.wall or 0.0, 1e-9), e)
+                     for b, e in known.items()
+                     if b <= max_batch and (e.execute or e.wall)]
+            if rates:
+                # seed the drain-rate estimate from cross-run history
+                rate, est = max(rates, key=lambda p: p[0])
+                self._drain_rows_per_s = rate
+                dispatch_s = est.execute or est.wall
+        if not hasattr(self, "_drain_rows_per_s"):
+            self._drain_rows_per_s = _FALLBACK_DRAIN_ROWS_PER_S
+        from ..tuning.registry import STATIC_DEFAULTS as _D
+        self.queue_rows = int(queue_rows if queue_rows is not None
+                              else _D["serving.admission_queue_rows"])
+        self.quantum = int(quantum if quantum is not None
+                           else _D["serving.admission_quantum"])
+        #: predicted per-batch encode+dispatch seconds (deadline math)
+        self._dispatch_seconds = dispatch_s
+        self.max_wait_ms = float(max_wait_ms)
+        #: brownout FSM
+        self.state = OK
+        self.transitions = 0
+        self._state_since = now
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+        self._pressure = 0.0
+        #: per-tenant accounting
+        self._tenants: Dict[str, _TenantState] = {}
+        #: burst-fault phantom backlog: lane key -> (rows, stamped at)
+        self._phantom: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        #: DRR dispatch-grant gate (replaces the off-path semaphore)
+        self._busy = False
+        self._waiters: "collections.OrderedDict[str, collections.deque]" \
+            = collections.OrderedDict()
+        self._ring: "collections.deque[str]" = collections.deque()
+        self._deficit: Dict[str, float] = {}
+        self._waiting = 0
+        #: has the ring head received its per-visit quantum credit yet
+        self._head_credited = False
+        #: wall-clock drain learning (note_dispatch): previous dispatch
+        #: completion stamp and the backlog that existed at it
+        self._prev_dispatch_at: Optional[float] = None
+        self._prev_backlog_rows = 0
+        #: shed-storm log throttle: lane -> (last event stamp,
+        #: sheds suppressed since) — a 10k/s shed storm must not turn
+        #: into 10k/s of event-log formatting on the event loop thread
+        self._shed_logged: Dict[Tuple[str, str], Tuple[float, int]] = {}
+
+    # -- weights / budgets -------------------------------------------------
+    def weight(self, tenant: str) -> float:
+        return float(self.config.tenant_weights.get(tenant, 1.0))
+
+    def _deadline_ms(self, tenant: str) -> Optional[float]:
+        d = self.config.tenant_deadline_ms
+        if d is None:
+            return None
+        if isinstance(d, dict):
+            v = d.get(tenant, d.get("default"))
+            return None if v is None else float(v)
+        return float(d)
+
+    def _tenant(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = self._tenants[tenant] = _TenantState(self.clock())
+        return st
+
+    # -- drain-rate / cost predictions -------------------------------------
+    def note_dispatch(self, rows: int, seconds: float,
+                      total_queued_rows: int = 0) -> None:
+        """Feed one completed dispatch: updates the measured drain
+        rate (EWMA) the retry hints and deadline math use, and
+        re-evaluates the brownout FSM as the backlog drains."""
+        now = self.clock()
+        if seconds > 1e-9 and rows > 0:
+            # while a backlog existed across the gap, the WALL time
+            # since the previous dispatch is the honest drain
+            # denominator — busy seconds alone ignore encode waits,
+            # grant waits, and host contention, so they overestimate
+            # capacity exactly when the loop is overloaded and the
+            # deadline gate most needs the truth
+            denom = seconds
+            if self._prev_dispatch_at is not None \
+                    and self._prev_backlog_rows > 0:
+                denom = max(seconds, now - self._prev_dispatch_at)
+            rate = rows / denom
+            self._drain_rows_per_s = (
+                (1 - _DRAIN_ALPHA) * self._drain_rows_per_s
+                + _DRAIN_ALPHA * rate)
+            if self._dispatch_seconds is None:
+                self._dispatch_seconds = seconds
+            else:
+                self._dispatch_seconds = (
+                    (1 - _DRAIN_ALPHA) * self._dispatch_seconds
+                    + _DRAIN_ALPHA * seconds)
+        self._prev_dispatch_at = now
+        self._prev_backlog_rows = int(total_queued_rows)
+        self._observe(total_queued_rows / max(self.queue_rows, 1))
+
+    def _drain_ms(self, rows: float) -> float:
+        return 1000.0 * max(rows, 0.0) \
+            / max(self._drain_rows_per_s, 1e-6)
+
+    def retry_after_ms(self, backlog_rows: float) -> int:
+        """Predicted time for ``backlog_rows`` to drain at the current
+        measured rate — the shed answer's machine-readable hint."""
+        return int(min(max(round(self._drain_ms(backlog_rows)),
+                           _RETRY_MIN_MS), _RETRY_MAX_MS))
+
+    def _phantom_rows(self, key: Tuple[str, str], now: float) -> float:
+        """Remaining rows of an injected ``burst`` spike against this
+        lane, draining at the measured rate since injection."""
+        rec = self._phantom.get(key)
+        if rec is None:
+            return 0.0
+        rows, t0 = rec
+        left = rows - (now - t0) * self._drain_rows_per_s
+        if left <= 0:
+            del self._phantom[key]
+            return 0.0
+        return left
+
+    # -- the brownout FSM --------------------------------------------------
+    def _observe(self, pressure: float) -> None:
+        """Walk ok -> brownout -> shed on sustained pressure (busiest
+        lane backlog / lane bound), with hysteresis dwells both ways.
+        Called on every enqueue attempt and dispatch completion — the
+        FSM owns no timer."""
+        now = self.clock()
+        self._pressure = pressure
+        cfg = self.config
+        if pressure >= cfg.brownout_enter_ratio:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            sustained = now - self._above_since \
+                >= cfg.brownout_enter_seconds
+            if self.state == OK and sustained:
+                self._set_state(BROWNOUT, now)
+            if self.state == BROWNOUT and sustained \
+                    and pressure >= cfg.shed_enter_ratio:
+                self._set_state(SHED, now)
+        elif pressure <= cfg.brownout_exit_ratio:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if self.state != OK and now - self._below_since \
+                    >= cfg.brownout_exit_seconds:
+                # recovery steps DOWN one level per dwell — shed
+                # re-enters brownout first, never snaps straight to ok
+                self._set_state(BROWNOUT if self.state == SHED else OK,
+                                now)
+                self._below_since = now
+        else:
+            # the hysteresis band: neither dwell accumulates
+            self._above_since = self._below_since = None
+
+    def _set_state(self, new_state: str, now: float) -> None:
+        old = self.state
+        if new_state == old:
+            return
+        if _trace.enabled():
+            _trace.add_span("serve.admission_state", self._state_since,
+                            now, attrs={"state": old, "to": new_state,
+                                        "pressure": round(
+                                            self._pressure, 4)})
+        self.state = new_state
+        self.transitions += 1
+        self._state_since = now
+        _telemetry.count("serve_brownout_transitions")
+        _telemetry.event("serve_brownout_transition", prev=old,
+                         state=new_state,
+                         pressure=round(self._pressure, 4))
+
+    def effective_max_wait_ms(self, base_ms: float) -> float:
+        """The coalescer's deadline under the current state: browned
+        out, the loop dispatches smaller batches sooner."""
+        if self.state == OK:
+            return base_ms
+        return base_ms * self.config.brownout_wait_factor
+
+    def _brownout_sheds(self, tenant: str) -> bool:
+        """Brownout sheds the LOWEST-priority tenants first: any
+        tenant weighted strictly below the heaviest registered weight.
+        With uniform weights no tenant outranks another and brownout
+        relies on the queue bound + deadline budget alone."""
+        weights = self.config.tenant_weights
+        if not weights:
+            return False
+        top = max(max(weights.values()), 1.0)
+        return self.weight(tenant) < top
+
+    # -- the enqueue-edge verdict ------------------------------------------
+    def admit(self, model: str, tenant: str, queued_rows: int,
+              tenant_backlog: Optional[Dict[str, int]] = None) -> None:
+        """Admit-or-shed for ONE arriving request. ``queued_rows`` is
+        this lane's current depth; ``tenant_backlog`` maps tenant ->
+        queued rows across all lanes (contention detection + quota
+        shares). Raises :class:`ServeShed` with the retry hint, or
+        returns None (admitted)."""
+        now = self.clock()
+        key = (model, tenant)
+        fault = maybe_inject("admission", model, "enqueue")
+        if fault and fault.startswith("burst"):
+            # an injected arrival spike: phantom rows queue against
+            # this lane so shed/brownout paths fire without real load
+            _, _, n = fault.partition(":")
+            rows = float(n or "256")
+            prev = self._phantom_rows(key, now)
+            self._phantom[key] = (prev + rows, now)
+            _telemetry.count("serve_burst_injected")
+            _telemetry.event("serve_burst_injected", model=model,
+                             tenant=tenant, rows=rows)
+        eff_rows = queued_rows + self._phantom_rows(key, now)
+        self._observe(eff_rows / max(self.queue_rows, 1))
+        st = self._tenant(tenant)
+        backlog = tenant_backlog or {}
+        # 1) brownout / shed state gating (lowest-priority first)
+        if self.state == SHED or (
+                self.state == BROWNOUT and self._brownout_sheds(tenant)):
+            self._shed(st, model, tenant,
+                       f"{self.state} state (pressure "
+                       f"{self._pressure:.2f})", eff_rows)
+        # 2) the lane queue bound
+        if eff_rows >= self.queue_rows:
+            self._shed(st, model, tenant,
+                       f"lane queue at its {self.queue_rows}-row "
+                       f"admission bound", eff_rows)
+        # 3) cost-model deadline budget
+        budget_ms = self._deadline_ms(tenant)
+        if budget_ms is not None:
+            wait_ms = self._drain_ms(eff_rows)
+            batch_ms = 1000.0 * (self._dispatch_seconds
+                                 if self._dispatch_seconds is not None
+                                 else self.max_wait_ms / 1000.0)
+            predicted = wait_ms + self.max_wait_ms + batch_ms
+            if predicted > budget_ms:
+                self._shed(st, model, tenant,
+                           f"predicted completion {predicted:.0f}ms "
+                           f"exceeds the {budget_ms:.0f}ms deadline "
+                           f"budget", eff_rows)
+        # 4) token-bucket quota — enforced only under contention
+        others = sum(v for t, v in backlog.items() if t != tenant)
+        if others > 0 and self._waiting + len(backlog) > 1:
+            share = self.weight(tenant) / max(
+                sum(self.weight(t) for t, v in backlog.items()
+                    if v > 0 or t == tenant), 1e-9)
+            rate = share * self._drain_rows_per_s
+            burst = max(rate * self.config.token_burst_seconds, 1.0)
+            if st.tokens is None:
+                st.tokens = burst
+            else:
+                st.tokens = min(
+                    burst,
+                    st.tokens + (now - st.refilled_at) * rate)
+            st.refilled_at = now
+            if st.tokens < 1.0:
+                self._shed(st, model, tenant,
+                           f"tenant over its {share:.0%} quota share "
+                           f"under contention",
+                           max(eff_rows, 1.0 / max(rate, 1e-6)
+                               * self._drain_rows_per_s))
+            st.tokens -= 1.0
+        else:
+            # no contention: the bucket re-arms at full burst — the
+            # idle tenants' unused share redistributes to whoever is
+            # actually sending
+            st.tokens = None
+            st.refilled_at = now
+        st.admitted += 1
+        _telemetry.count("serve_admitted")
+
+    def _shed(self, st: _TenantState, model: str, tenant: str,
+              reason: str, backlog_rows: float) -> None:
+        st.shed += 1
+        hint = self.retry_after_ms(backlog_rows)
+        _telemetry.count("serve_admission_sheds")
+        # loud but bounded: the FIRST shed of a storm logs immediately;
+        # repeats within the throttle window aggregate into the next
+        # event's ``suppressed`` count (the counter above still counts
+        # every shed) — per-request log formatting would otherwise eat
+        # the very drain capacity shedding is meant to protect
+        key = (model, tenant)
+        now = self.clock()
+        last, pent = self._shed_logged.get(key, (None, 0))
+        if last is None or now - last >= _SHED_LOG_INTERVAL_S:
+            _telemetry.event("serve_request_shed", model=model,
+                             tenant=tenant, reason=reason,
+                             retry_after_ms=hint, state=self.state,
+                             suppressed=pent)
+            self._shed_logged[key] = (now, 0)
+        else:
+            self._shed_logged[key] = (last, pent + 1)
+        raise ServeShed(model, tenant, reason, hint)
+
+    # -- the DRR dispatch-grant gate ---------------------------------------
+    async def acquire_grant(self, tenant: str, rows: int) -> None:
+        """Take the single dispatch slot (the admission-on twin of the
+        server's ``_dispatch_sem``). Uncontended lanes pass straight
+        through; under contention waiters are served by weighted
+        deficit round-robin, batch cost = its rows."""
+        if not self._busy and self._waiting == 0:
+            self._busy = True
+            return
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        q = self._waiters.get(tenant)
+        if q is None:
+            q = self._waiters[tenant] = collections.deque()
+            self._ring.append(tenant)
+            self._deficit.setdefault(tenant, 0.0)
+        q.append((max(int(rows), 1), fut))
+        self._waiting += 1
+        await fut
+
+    def release_grant(self) -> None:
+        """Release the dispatch slot; hands it to the next DRR waiter
+        (the slot stays busy) or parks it free."""
+        fut = self._next_waiter()
+        if fut is None:
+            self._busy = False
+        else:
+            fut.set_result(None)
+
+    def _next_waiter(self):
+        """Classic DRR over tenants with queued waiters: arriving at a
+        tenant credits quantum x weight ONCE, then its batches are
+        served (one per release) while the deficit covers their rows;
+        when it runs short the ring rotates to the next tenant. A
+        tenant leaving the active set forfeits its residue — only
+        ACTIVE tenants split the device, so idle shares redistribute
+        and a heavier weight drains proportionally more rows per
+        round."""
+        while self._ring:
+            tenant = self._ring[0]
+            q = self._waiters.get(tenant)
+            if not q:
+                self._ring.popleft()
+                self._waiters.pop(tenant, None)
+                self._deficit.pop(tenant, None)
+                self._head_credited = False
+                continue
+            if not self._head_credited:
+                self._deficit[tenant] = self._deficit.get(tenant, 0.0) \
+                    + self.quantum * self.weight(tenant)
+                self._head_credited = True
+            cost, fut = q[0]
+            if self._deficit[tenant] >= cost:
+                q.popleft()
+                self._waiting -= 1
+                self._deficit[tenant] -= cost
+                if not q:
+                    self._ring.popleft()
+                    self._waiters.pop(tenant, None)
+                    self._deficit.pop(tenant, None)
+                    self._head_credited = False
+                if fut.cancelled():
+                    continue
+                _telemetry.count("serve_drr_grants")
+                return fut
+            self._ring.rotate(-1)
+            self._head_credited = False
+        return None
+
+    def drain_waiters(self, exc: Optional[BaseException] = None) -> None:
+        """Fail (or release) every parked grant waiter at shutdown."""
+        for q in self._waiters.values():
+            for _cost, fut in q:
+                if not fut.done():
+                    if exc is not None:
+                        fut.set_exception(exc)
+                    else:
+                        fut.cancel()
+        self._waiters.clear()
+        self._ring.clear()
+        self._deficit.clear()
+        self._waiting = 0
+        self._head_credited = False
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self, queue_depth: Optional[Dict[str, int]] = None
+                 ) -> dict:
+        """The ``"admission"`` block of ``metrics_snapshot()`` (schema
+        4, docs/observability.md)."""
+        return {
+            "enabled": True,
+            "state": self.state,
+            "pressure": round(self._pressure, 4),
+            "transitions": self.transitions,
+            "queue_rows_limit": self.queue_rows,
+            "quantum_rows": self.quantum,
+            "drain_rows_per_s": round(self._drain_rows_per_s, 1),
+            "waiting_grants": self._waiting,
+            "tenants": {
+                t: {
+                    "weight": self.weight(t),
+                    "admitted": st.admitted,
+                    "shed": st.shed,
+                    "deadline_ms": self._deadline_ms(t),
+                } for t, st in sorted(self._tenants.items())},
+            "queue_depth": dict(queue_depth or {}),
+            "decisions": [d.to_json() for d in self.decisions],
+        }

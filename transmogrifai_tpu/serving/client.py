@@ -20,7 +20,13 @@ gracefully-stopping server sends (docs/serving_restart.md): that is a
 "retry against the next incarnation" instruction, not a verdict on
 the request, so the client closes, backs off, and resends — which is
 what makes a rolling restart invisible to callers
-(``serve_client_drain_retries`` counts them).
+(``serve_client_drain_retries`` counts them). An overload shed answer
+(``{"ok": false, "shed": true, "retry_after_ms": N}``,
+docs/admission.md) is likewise a "come back later" instruction — but
+from a server that is ALIVE: the client keeps the connection, sleeps
+the server-provided hint (capped at the policy's ``max_delay``) and
+resends, counting ``serve_client_shed_retries``; the last attempt
+returns the shed answer to the caller as the verdict.
 
 >>> with TcpServingClient("127.0.0.1", 8190) as client:
 ...     row = client.score({"x": 1.0}, model="m")
@@ -117,8 +123,10 @@ class TcpServingClient:
         """One request/response round trip. A transport failure closes
         the socket, reconnects under backoff, and RESENDS; an answered
         ``{"ok": false}`` is returned as-is (application errors are not
-        transport errors) — EXCEPT the ``"draining"`` answer, which is
-        the server telling us to come back after its restart."""
+        transport errors) — EXCEPT the ``"draining"`` answer (come
+        back after the restart: reconnect + resend) and the ``"shed"``
+        answer (come back in ``retry_after_ms``: sleep + resend on the
+        live connection)."""
         line = json.dumps(payload, default=float) + "\n"
         last: Optional[Exception] = None
         for attempt in range(1, self.retry.max_attempts + 1):
@@ -134,6 +142,19 @@ class TcpServingClient:
                     _telemetry.count("serve_client_drain_retries")
                     raise ConnectionError(
                         "server is draining for restart")
+                if isinstance(doc, dict) and doc.get("shed"):
+                    # overload shed (docs/admission.md): the server is
+                    # ALIVE and told us exactly when to come back —
+                    # honor retry_after_ms on the SAME connection (no
+                    # reconnect), distinct from drain retries
+                    if attempt >= self.retry.max_attempts:
+                        return doc
+                    _telemetry.count("serve_client_shed_retries")
+                    hint_s = float(doc.get("retry_after_ms", 0) or 0) \
+                        / 1000.0
+                    time.sleep(min(max(hint_s, 0.0),
+                                   self.retry.max_delay))
+                    continue
                 return doc
             except (OSError, ConnectionError, json.JSONDecodeError) as e:
                 last = e

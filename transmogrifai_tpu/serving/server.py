@@ -67,6 +67,7 @@ import numpy as np
 from ..observability import trace as _trace
 from ..observability.metrics import ServeMetrics
 from ..runtime import telemetry as _telemetry
+from .admission import AdmissionConfig, AdmissionController, ServeShed
 from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
                     GuardReason, OutputGuard, SchemaGuard,
                     _invalidate_rows)
@@ -75,7 +76,8 @@ from .plan import EncodedScoreBatch, ScoringPlan
 _log = logging.getLogger(__name__)
 
 __all__ = ["ServeConfig", "ServingServer", "ServingClient", "PlanCache",
-           "ServeRejected", "ServeDraining", "serve_in_process"]
+           "ServeRejected", "ServeDraining", "ServeShed",
+           "AdmissionConfig", "AdmissionController", "serve_in_process"]
 
 from ..tuning.registry import STATIC_DEFAULTS as _TUNABLES
 
@@ -139,6 +141,11 @@ class ServeConfig:
     #: None (the default) disables drift-triggered retraining entirely
     #: — the loop behaves byte-identically to a build without it
     lifecycle: Any = None
+    #: overload admission control (serving/admission.AdmissionConfig);
+    #: None (the default, and `tx serve --admission=off`) constructs
+    #: no controller — the enqueue edge, dispatch semaphore and every
+    #: answer are byte-identical to a build without docs/admission.md
+    admission_control: Optional[AdmissionConfig] = None
 
 
 @dataclass
@@ -363,10 +370,15 @@ class PlanCache:
 class _Lane:
     """One (model, tenant) coalescing queue + its collector task."""
 
-    def __init__(self, model_name: str, tenant: str):
+    def __init__(self, model_name: str, tenant: str,
+                 queue_limit: int = 4096):
         self.model_name = model_name
         self.tenant = tenant
-        self.queue: "collections.deque[_Request]" = collections.deque()
+        #: bounded at the backpressure limit (TX-R05): the enqueue edge
+        #: rejects BEFORE append, so the maxlen never silently drops —
+        #: it is the structural backstop, not the admission policy
+        self.queue: "collections.deque[_Request]" = collections.deque(
+            maxlen=max(int(queue_limit), 1))
         self.wakeup: Optional[asyncio.Event] = None   # built on the loop
         self.full: Optional[asyncio.Event] = None
         #: the collector's current deadline-or-full threshold; the
@@ -487,6 +499,15 @@ class ServingServer:
             (lo_d.chosen, hi_d.chosen)
             if (lo_d.tuned() or hi_d.tuned()) else (None, None))
         self._bucket_decisions = (lo_d, hi_d)
+        #: overload admission (docs/admission.md) — None when
+        #: ``config.admission_control`` is None: every path below
+        #: byte-identical to a build without the controller
+        self._admission: Optional[AdmissionController] = None
+        if self.config.admission_control is not None:
+            self._admission = AdmissionController(
+                self.config.admission_control, tuning=self.tuning,
+                max_batch=self.config.max_batch,
+                max_wait_ms=self.config.max_wait_ms)
 
     # -- registry ----------------------------------------------------------
     def add_model(self, name: str, model_or_dir: Any,
@@ -610,6 +631,14 @@ class ServingServer:
             raise ServeRejected(
                 f"lane {name}/{tenant} queue is at its backpressure "
                 f"limit ({self.config.queue_limit})")
+        if self._admission is not None:
+            # the overload gatekeeper (docs/admission.md): raises
+            # ServeShed with a retry_after_ms hint, or admits
+            backlog: Dict[str, int] = {}
+            for (_m, t), ln in self._lanes.items():
+                backlog[t] = backlog.get(t, 0) + len(ln.queue)
+            self._admission.admit(name, tenant, len(lane.queue),
+                                  backlog)
         loop = asyncio.get_running_loop()
         req = _Request(record=record, future=loop.create_future(),
                        arrived=time.monotonic(),
@@ -633,7 +662,9 @@ class ServingServer:
         key = (model_name, tenant)
         lane = self._lanes.get(key)
         if lane is None:
-            lane = self._lanes[key] = _Lane(model_name, tenant)
+            lane = self._lanes[key] = _Lane(
+                model_name, tenant,
+                queue_limit=self.config.queue_limit)
             lane.wakeup = asyncio.Event()
             lane.full = asyncio.Event()
             lane.task = asyncio.get_running_loop().create_task(
@@ -679,7 +710,12 @@ class ServingServer:
             await lane.wakeup.wait()
             if not self._running:
                 return []
-        deadline = lane.queue[0].arrived + self.config.max_wait_ms / 1000.0
+        wait_ms = self.config.max_wait_ms
+        if self._admission is not None:
+            # browned out, the coalescer dispatches smaller batches
+            # sooner — occupancy traded for latency headroom
+            wait_ms = self._admission.effective_max_wait_ms(wait_ms)
+        deadline = lane.queue[0].arrived + wait_ms / 1000.0
         while len(lane.queue) < lane.target:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -717,7 +753,14 @@ class ServingServer:
                 prep = await loop.run_in_executor(
                     self._encode_pool, self._prepare_batch, lane, batch)
                 target = self._target_batch(prep.entry.plan)
-                await self._dispatch_sem.acquire()
+                if self._admission is not None:
+                    # the DRR fair-queuing twin of the semaphore:
+                    # contended grants are served by weighted deficit
+                    # round-robin across tenants (docs/admission.md)
+                    await self._admission.acquire_grant(
+                        lane.tenant, len(prep.requests))
+                else:
+                    await self._dispatch_sem.acquire()
                 loop.create_task(self._dispatch_resolve(prep))
             except asyncio.CancelledError:
                 raise
@@ -806,7 +849,10 @@ class ServingServer:
                 if not req.future.done():
                     req.future.set_exception(e)
         finally:
-            self._dispatch_sem.release()
+            if self._admission is not None:
+                self._admission.release_grant()
+            else:
+                self._dispatch_sem.release()
 
     def _emit_request_spans(self, prep: _PreparedBatch, resolved: float,
                             error: Optional[str] = None) -> None:
@@ -934,6 +980,12 @@ class ServingServer:
         self.stats["batches"] += 1
         self.stats["rows"] += len(prep.requests)
         self.stats["dispatch_seconds"] += now - t0
+        if self._admission is not None:
+            # measured drain rate + brownout recovery as backlogs clear
+            self._admission.note_dispatch(
+                len(prep.requests), now - t0,
+                max(len(ln.queue) for ln in self._lanes.values())
+                if self._lanes else 0)
         if self._first_dispatch_at is None:
             self._first_dispatch_at = t0
         self._last_dispatch_at = now
@@ -1049,6 +1101,8 @@ class ServingServer:
 
     async def shutdown(self) -> None:
         self._running = False
+        if self._admission is not None:
+            self._admission.drain_waiters()
         for lane in self._lanes.values():
             if lane.wakeup is not None:
                 lane.wakeup.set()
@@ -1209,6 +1263,11 @@ class ServingServer:
                 self.stats["orphaned_dispatches"]),
             "queue_depth": {"/".join(k): len(lane.queue)
                             for k, lane in sorted(self._lanes.items())},
+            "admission": (self._admission.snapshot(
+                {"/".join(k): len(lane.queue)
+                 for k, lane in sorted(self._lanes.items())})
+                if self._admission is not None
+                else {"enabled": False}),
             "latency_ms": self.metrics.latency_json(),
             "plan_cache": {"budget": self.plans.budget,
                            "resident": len(self.plans._entries),

@@ -250,6 +250,57 @@ class TuningPolicy:
             f"predicted {comp:.2f}s compile bill out of first-request "
             f"latency")
 
+    def admission_queue_rows(self, max_batch: int = 256
+                             ) -> TuningDecision:
+        """Per-lane admission bound (rows) for the overload controller
+        (serving/admission.py): the largest power of two whose backlog
+        drains within ~250ms at the store's recorded dispatch rate, so
+        the shed edge engages where queue wait would start dominating
+        the SLO instead of at an arbitrary depth."""
+        name = "serving.admission_queue_rows"
+        default = int(STATIC_DEFAULTS[name])
+        ov = self._override(name)
+        if ov is not None:
+            return TuningDecision(
+                name, int(ov), default, None, None, "recorded",
+                SOURCE_OVERRIDE,
+                f"pinned by tx tune --set (store {self.path})")
+        known = self.model.recorded_buckets("score") if self.enabled \
+            else {}
+        rates = [(b / max(e.execute or e.wall or 0.0, 1e-9), b)
+                 for b, e in known.items()
+                 if b <= max(int(max_batch), 1)
+                 and (e.execute or e.wall)]
+        if not rates:
+            return self._static(
+                name, "no score:b* records in the store yet")
+        rate, _bucket = max(rates)
+        budget_s = 0.25
+        rows = 1
+        while rows * 2 <= rate * budget_s:
+            rows *= 2
+        chosen = max(min(rows, 4 * default), int(max_batch))
+        return TuningDecision(
+            name, chosen, default, chosen / rate, default / rate,
+            "recorded", SOURCE_MODEL,
+            f"recorded drain rate ~{rate:.0f} rows/s: a {chosen}-row "
+            f"backlog clears in {chosen / rate * 1e3:.0f}ms "
+            f"(~{budget_s * 1e3:.0f}ms budget; {len(known)} recorded "
+            f"buckets)")
+
+    def admission_quantum(self) -> TuningDecision:
+        """DRR quantum for the admission dispatch-grant ring
+        (override-only: the model keeps the static granularity)."""
+        name = "serving.admission_quantum"
+        ov = self._override(name)
+        if ov is not None:
+            return TuningDecision(
+                name, int(ov), STATIC_DEFAULTS[name], None, None,
+                "recorded", SOURCE_OVERRIDE,
+                f"pinned by tx tune --set (store {self.path})")
+        return self._static(
+            name, "model keeps the static fairness granularity")
+
     # -- search ------------------------------------------------------------
     def _schedule_cost(self, eta: int, mf: float,
                        compile_s: float, execute_s: float) -> float:
@@ -379,6 +430,8 @@ class TuningPolicy:
         out = [self.target_batch(max_wait_ms, max_batch)]
         out.extend(self.bucket_range(max_batch))
         out.append(self.prewarm_buckets(max_batch))
+        out.append(self.admission_queue_rows(max_batch))
+        out.append(self.admission_quantum())
         _eta, _mf, racing = self.racing_schedule()
         out.extend(racing)
         out.append(self.placement_margin())

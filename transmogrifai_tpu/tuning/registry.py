@@ -72,6 +72,25 @@ KNOBS: Tuple[Knob, ...] = (
                      "policy fills it from the store's recorded "
                      "dispatch shapes",
          const_names=(), param_names=()),
+    Knob(name="serving.admission_queue_rows", default=512,
+         consumer="serving/admission.py AdmissionController",
+         kind="int",
+         description="per-(model, tenant) lane admission bound in "
+                     "queued rows — arrivals beyond it are shed with "
+                     "a retry_after_ms hint; the policy sizes it so "
+                     "the worst-case backlog drains in ~250ms at the "
+                     "recorded dispatch rate",
+         const_names=("DEFAULT_ADMISSION_QUEUE_ROWS",),
+         param_names=()),
+    Knob(name="serving.admission_quantum", default=32,
+         consumer="serving/admission.py AdmissionController",
+         kind="int",
+         description="deficit-round-robin quantum in rows credited "
+                     "per tenant visit of the dispatch-grant ring — "
+                     "larger favors batch throughput, smaller favors "
+                     "fairness granularity",
+         const_names=("DEFAULT_ADMISSION_QUANTUM",),
+         param_names=()),
     Knob(name="search.eta", default=3,
          consumer="selector/racing.py RacingCrossValidation",
          kind="int",
