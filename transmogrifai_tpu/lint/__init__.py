@@ -21,11 +21,15 @@ pre-flight), and the programmatic API below. Rule catalog and
 suppression syntax: docs/lint.md.
 """
 from .baseline import Baseline, DEFAULT_BASELINE_NAME
-from .engine import (format_json, format_text, lint_model, lint_paths,
+from .callgraph import (CallGraph, analyze_file, analyze_source,
+                        build_graph)
+from .engine import (LintCache, build_project_graph, default_cache_path,
+                     format_json, format_text, lint_model, lint_paths,
                      lint_workflow, summarize)
 from .findings import ERROR, RULES, WARNING, LintError, LintFinding
 from .rules_dag import lint_dag
 from .rules_jax import abstract_probe, lint_file, lint_source
+from .rules_xproc import lint_cross_procedure
 
 __all__ = [
     "LintFinding", "LintError", "RULES", "ERROR", "WARNING",
@@ -33,4 +37,7 @@ __all__ = [
     "lint_dag", "lint_source", "lint_file", "abstract_probe",
     "lint_paths", "lint_workflow", "lint_model",
     "format_text", "format_json", "summarize",
+    "CallGraph", "analyze_source", "analyze_file", "build_graph",
+    "lint_cross_procedure", "LintCache", "build_project_graph",
+    "default_cache_path",
 ]

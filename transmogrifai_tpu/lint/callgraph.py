@@ -1,0 +1,767 @@
+"""Project-wide symbol table and call graph for the cross-procedure
+lint rules (lint/rules_xproc.py).
+
+The per-file rules in rules_jax.py see one function body at a time, so
+a one-level helper defeats every "no X inside Y" rule.  This module
+builds the whole-program view those rules need:
+
+- **Symbol table** — every module-level ``def``/``async def`` and every
+  method, under a dotted qualname (``pkg.mod.Class.method``; nested
+  defs as ``outer.inner``).
+- **Call graph** — edges resolved through imports (absolute, relative,
+  one-hop ``__init__`` re-exports), ``self.method()``, receiver-class
+  heuristics (parameter annotations, ``x = ClassName(...)`` locals,
+  ``self.attr = ClassName(...)`` constructor hints, unique-method-name
+  fallback), and ``functools.partial`` unwrapping.
+- **Submission edges** — ``executor.submit(fn)``,
+  ``loop.run_in_executor(pool, fn)`` and ``threading.Thread(target=fn)``
+  mark ``fn`` as *executor-thread* work; ``call_soon_threadsafe(fn)``
+  and ``create_task(coro())`` mark *event-loop* work.  These are the
+  edges the TX-X03 race detector colors contexts with — a plain call
+  crosses no thread boundary, a submission does.
+- **Async/sync coloring + reachability** — BFS with parent pointers so
+  every finding carries the full call chain that proves it.
+
+Per-file analysis results are plain JSON-able dicts (``FileSummary``)
+so the engine's incremental cache can persist them keyed by content
+hash; the graph itself is relinked from summaries on every run (pure
+dict work, milliseconds for this repo).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["analyze_source", "analyze_file", "build_graph", "CallGraph",
+           "FuncInfo", "Edge", "module_name_for", "SUMMARY_SCHEMA"]
+
+#: bump when the FileSummary shape changes — the cache invalidates itself
+SUMMARY_SCHEMA = 1
+
+#: ``obj.meth()`` unique-name fallback never applies to these: they are
+#: overwhelmingly builtin-container / stdlib methods and would create
+#: bogus edges into whichever project class happens to share the name.
+_COMMON_METHODS = frozenset({
+    "append", "add", "get", "put", "pop", "update", "extend", "close",
+    "write", "read", "items", "keys", "values", "join", "start", "run",
+    "result", "set", "clear", "copy", "submit", "send", "recv", "sort",
+    "split", "strip", "format", "encode", "decode", "load", "loads",
+    "dump", "dumps", "wait", "cancel", "done", "count", "index",
+    "remove", "insert", "flush", "seek", "tell", "mkdir", "exists",
+    "popleft", "appendleft", "acquire", "release", "setdefault",
+})
+
+#: writes inside these methods are the sanctioned hot-swap channel
+#: (PlanCache.swap_entry/rollback/commit — lint rule TX-R03's contract)
+_BLESSED_METHODS = frozenset({"swap_entry", "rollback", "commit"})
+
+#: call targets that are themselves blessed sinks: reachability passes
+#: stop at the call instead of descending into the implementation,
+#: whose internals (tmp files, lock files, trace-time clock reads) ARE
+#: the sanctioned machinery, not violations
+BLESSED_PERSIST_SINKS = ("atomic_write_json",)
+BLESSED_TRACE_SINKS = ("compile_time.section",)
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name, walking up while ``__init__.py`` exists so
+    ``.../transmogrifai_tpu/serving/server.py`` maps to
+    ``transmogrifai_tpu.serving.server`` regardless of the scan root.
+    Loose files (test fixtures in a tmp dir) map to their stem."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:  # filesystem root
+            break
+        d = parent
+    parts.reverse()
+    if parts and parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts) or "<anonymous>"
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - malformed node
+        return ""
+
+
+def _mentions(node: ast.AST, needles: Tuple[str, ...]) -> bool:
+    text = _expr_text(node).lower()
+    return any(n in text for n in needles)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute chain as a dotted string, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _FileVisitor(ast.NodeVisitor):
+    """One pass over a module: symbols, raw (unresolved) call specs,
+    submission sites, self-attribute writes, blocking/host-call/open
+    sites, and the receiver-type hints the linker resolves with."""
+
+    def __init__(self, modname: str, relpath: str):
+        self.mod = modname
+        self.relpath = relpath
+        self.imports: Dict[str, str] = {}
+        self.classes: Dict[str, dict] = {}
+        self.funcs: Dict[str, dict] = {}
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        self.jit_assigns: List[str] = []
+        self._scope: List[str] = []       # enclosing def qualnames
+        self._class: List[str] = []       # enclosing class names
+        self._cur: Optional[dict] = None  # current func record
+        self._awaited: Set[int] = set()
+        self._lockdepth = 0
+        self._compiletime = 0
+
+    # -- imports -----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:
+            pkg = self.mod.split(".")
+            # strip the module leaf, then (level-1) more packages
+            keep = len(pkg) - node.level
+            if self.relpath.endswith("__init__.py"):
+                keep += 1
+            pkg = pkg[:max(keep, 0)]
+            base = ".".join(pkg + ([base] if base else []))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = (
+                f"{base}.{a.name}" if base else a.name)
+
+    # -- symbols -----------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        bases = [b for b in (_dotted(x) for x in node.bases) if b]
+        self.classes[node.name] = {"bases": bases, "line": node.lineno}
+        self.attr_types.setdefault(node.name, {})
+        self._class.append(node.name)
+        prev, self._cur = self._cur, None
+        for c in node.body:
+            self.visit(c)
+        self._cur = prev
+        self._class.pop()
+
+    def _enter_func(self, node, is_async: bool) -> None:
+        if self._scope:
+            qual = f"{self._scope[-1]}.{node.name}"
+        elif self._class:
+            qual = f"{self._class[-1]}.{node.name}"
+        else:
+            qual = node.name
+        jitted = self._is_jit_decorated(node)
+        rec = {
+            "line": node.lineno, "async": is_async,
+            "cls": self._class[-1] if self._class else None,
+            "jitted": jitted, "calls": [], "submits": [], "writes": [],
+            "blocking": [], "hostcalls": [], "openw": [],
+            "var_types": {}, "assigns": {},
+        }
+        for arg in (list(node.args.posonlyargs) + list(node.args.args)
+                    + list(node.args.kwonlyargs)):
+            if arg.annotation is not None:
+                t = _annotation_class(arg.annotation)
+                if t:
+                    rec["var_types"][arg.arg] = t
+        self.funcs[qual] = rec
+        prev, self._cur = self._cur, rec
+        self._scope.append(qual)
+        pcls = self._class
+        self._class = []  # a nested class inside a def: out of scope
+        for c in node.body:
+            self.visit(c)
+        self._class = pcls
+        self._scope.pop()
+        self._cur = prev
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_func(node, is_async=True)
+
+    def _is_jit_decorated(self, node) -> bool:
+        for d in node.decorator_list:
+            txt = _expr_text(d)
+            if txt in ("jit", "jax.jit") or txt.startswith(
+                    ("jax.jit(", "jit(", "partial(jax.jit",
+                     "functools.partial(jax.jit")):
+                return True
+        return False
+
+    # -- statements inside functions ---------------------------------------
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._with(node)
+
+    def _with(self, node) -> None:
+        locked = any(_mentions(i.context_expr, ("lock", "mutex"))
+                     for i in node.items)
+        ct = any(_mentions(i.context_expr, ("compile_time",))
+                 for i in node.items)
+        for i in node.items:
+            self.visit(i.context_expr)  # `with helper():` is a call
+        self._lockdepth += locked
+        self._compiletime += ct
+        for c in node.body:
+            self.visit(c)
+        self._lockdepth -= locked
+        self._compiletime -= ct
+
+    def _record_write(self, target: ast.AST, line: int) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self._record_write(t, line)
+            return
+        node = target
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and self._cur is not None):
+            method = self._scope[-1].rsplit(".", 1)[-1] if self._scope \
+                else ""
+            blessed = bool(self._lockdepth) or method in _BLESSED_METHODS \
+                or method == "__init__"
+            self._cur["writes"].append([node.attr, line, blessed])
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._cur is not None:
+            for t in node.targets:
+                self._record_write(t, node.lineno)
+            self._collect_type_hint(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self._cur is not None:
+            self._record_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if self._cur is not None:
+            self._record_write(node.target, node.lineno)
+            if isinstance(node.target, ast.Name):
+                t = _annotation_class(node.annotation)
+                if t:
+                    self._cur["var_types"][node.target.id] = t
+        self.generic_visit(node)
+
+    def _collect_type_hint(self, node: ast.Assign) -> None:
+        """``x = ClassName(...)`` / ``self.a = ClassName(...)`` receiver
+        hints for the linker's method resolution."""
+        if not isinstance(node.value, ast.Call):
+            return
+        cname = _dotted(node.value.func)
+        if not cname:
+            return
+        leaf = cname.rsplit(".", 1)[-1]
+        if not leaf or not leaf[0].isupper():
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Name) and self._cur is not None:
+                self._cur["var_types"][t.id] = leaf
+            elif (isinstance(t, ast.Attribute)
+                  and isinstance(t.value, ast.Name)
+                  and t.value.id == "self" and self._class):
+                self.attr_types[self._class[-1]][t.attr] = leaf
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        line = node.lineno
+        dotted = _dotted(node.func)
+        # any jax.jit(fn) call marks fn jitted — module level,
+        # method body, or a nested-closure compile (`jax.jit(run)`)
+        if dotted in ("jax.jit", "jit") and node.args:
+            target = _dotted(node.args[0])
+            if target:
+                leaf = target.rsplit(".", 1)[-1]
+                if self._scope:
+                    self.jit_assigns.append(
+                        f"{self._scope[-1]}.{leaf}")
+                self.jit_assigns.append(leaf)
+        if self._cur is None:
+            return
+        rec = self._cur
+        self._classify_special(node, dotted, line)
+        if self._is_submission(node, dotted, line):
+            return
+        # plain call edge specs, resolved by the linker
+        if isinstance(node.func, ast.Name):
+            rec["calls"].append(["n", node.func.id, line])
+        elif isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            recv = node.func.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                rec["calls"].append(["s", meth, line])
+            elif dotted and dotted.count(".") >= 1:
+                rec["calls"].append(["d", dotted, line])
+            else:
+                rec["calls"].append(["m", meth, line])
+        # functools.partial(fn, ...) binds fn — keep a plain edge to it
+        if dotted in ("functools.partial", "partial") and node.args:
+            inner = _dotted(node.args[0])
+            if inner:
+                self._spec_for_target(node.args[0], line, "calls", "call")
+
+    def _spec_for_target(self, tnode: ast.AST, line: int,
+                         into: str, channel: str) -> None:
+        """Record a reference to a function OBJECT (submit target,
+        partial subject) as a call/submit spec."""
+        rec = self._cur
+        if isinstance(tnode, ast.Call):  # create_task(self._foo(...))
+            tnode = tnode.func
+        if isinstance(tnode, ast.Call):  # pragma: no cover - nested
+            return
+        d = _dotted(tnode)
+        if d in ("functools.partial", "partial"):
+            return
+        if isinstance(tnode, ast.Name):
+            spec = ["n", tnode.id, line]
+        elif isinstance(tnode, ast.Attribute):
+            recv = tnode.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                spec = ["s", tnode.attr, line]
+            elif d and d.count(".") >= 1:
+                spec = ["d", d, line]
+            else:
+                spec = ["m", tnode.attr, line]
+        else:
+            return
+        if into == "calls":
+            rec["calls"].append(spec)
+        else:
+            rec["submits"].append(spec + [channel])
+
+    def _is_submission(self, node: ast.Call, dotted: Optional[str],
+                       line: int) -> bool:
+        """Executor/thread/loop submission sites become channel-tagged
+        edges instead of plain calls."""
+        func = node.func
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+        target: Optional[ast.AST] = None
+        channel = "thread"
+        if attr == "run_in_executor" and len(node.args) >= 2:
+            target = node.args[1]
+            # partial(fn, ...) as the submitted callable
+            if isinstance(target, ast.Call):
+                target = target.args[0] if target.args else None
+        elif attr == "submit" and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Call):
+                target = target.args[0] if target.args else None
+        elif dotted and dotted.rsplit(".", 1)[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+        elif attr in ("call_soon_threadsafe", "call_soon", "call_later"):
+            channel = "loop"
+            target = node.args[0] if node.args else None
+            if attr == "call_later" and len(node.args) >= 2:
+                target = node.args[1]
+        elif attr in ("create_task", "ensure_future",
+                      "run_coroutine_threadsafe", "run_until_complete") \
+                or dotted in ("asyncio.run",):
+            channel = "loop"
+            target = node.args[0] if node.args else None
+        else:
+            return False
+        if target is not None:
+            self._spec_for_target(target, line, "submits", channel)
+        return True
+
+    def _classify_special(self, node: ast.Call, dotted: Optional[str],
+                          line: int) -> None:
+        """Blocking / host-transfer / file-write site collection."""
+        rec = self._cur
+        awaited = id(node) in self._awaited
+        leaf = (dotted or "").rsplit(".", 1)[-1]
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else None
+        # blocking primitives (TX-X01)
+        if dotted in ("time.sleep",) or (leaf == "sleep" and not awaited):
+            rec["blocking"].append(["sleep", line])
+        elif attr == "block_until_ready":
+            rec["blocking"].append(["block_until_ready", line])
+            rec["hostcalls"].append(["block_until_ready", line])
+        elif dotted == "open" or (isinstance(node.func, ast.Name)
+                                  and node.func.id == "open"):
+            rec["blocking"].append(["open", line])
+            self._classify_open(node, line)
+        # host transfer / clock / telemetry (TX-X02)
+        if self._compiletime:
+            return
+        if attr == "item" and not node.args:
+            rec["hostcalls"].append(["item", line])
+        elif dotted in ("time.time", "time.perf_counter",
+                        "time.monotonic", "time.process_time"):
+            rec["hostcalls"].append([dotted, line])
+        elif attr in ("event", "count") and _mentions(
+                node.func, ("telemetry",)):
+            rec["hostcalls"].append([f"telemetry.{attr}", line])
+        elif attr == "span" and _mentions(node.func, ("trace", "tracer")):
+            rec["hostcalls"].append(["trace.span", line])
+
+    def _classify_open(self, node: ast.Call, line: int) -> None:
+        """Write-mode ``open()`` for TX-X04, with the tmp-/lock-marked
+        exemptions (one level of local-assignment resolution)."""
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if not (isinstance(mode, str) and any(c in mode for c in "waxWAX")):
+            return
+        path_arg = node.args[0] if node.args else None
+        if path_arg is None:
+            return
+        exempt_markers = ("tmp", "temp", ".lock", "staging")
+        if _mentions(path_arg, exempt_markers):
+            return
+        if isinstance(path_arg, ast.Name) and self._cur is not None:
+            src = self._cur["assigns"].get(path_arg.id)
+            if src and any(m in src.lower() for m in exempt_markers):
+                return
+        self._cur["openw"].append([line, mode])
+
+    def generic_visit(self, node: ast.AST) -> None:
+        # remember local `name = <expr>` text for the open() path
+        # resolution above, before descending
+        if (isinstance(node, ast.Assign) and self._cur is not None
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            self._cur["assigns"][node.targets[0].id] = \
+                _expr_text(node.value)[:200]
+        super().generic_visit(node)
+
+
+def _annotation_class(node: ast.AST) -> Optional[str]:
+    """'ClassName' from a parameter annotation (`x: Foo`, `x: "Foo"`,
+    `x: Optional[Foo]`)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value.strip().rsplit(".", 1)[-1]
+        return name if name[:1].isupper() or name[:1] == "_" else None
+    d = _dotted(node)
+    if d:
+        leaf = d.rsplit(".", 1)[-1]
+        return leaf if leaf[:1].isupper() or leaf[:1] == "_" else None
+    if isinstance(node, ast.Subscript):  # Optional[Foo] / List[Foo]
+        return _annotation_class(node.slice)
+    return None
+
+
+def analyze_source(source: str, path: str,
+                   relpath: Optional[str] = None) -> dict:
+    """Parse one file into its JSON-able ``FileSummary``. A syntax
+    error yields a summary with no symbols (rules_jax's TX-E00 already
+    reports the parse failure)."""
+    rel = relpath or path
+    mod = module_name_for(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return {"mod": mod, "path": rel, "imports": {}, "classes": {},
+                "funcs": {}, "attr_types": {}, "jit_assigns": []}
+    v = _FileVisitor(mod, rel)
+    v.visit(tree)
+    for rec in v.funcs.values():
+        rec.pop("assigns", None)
+    return {"mod": mod, "path": rel, "imports": v.imports,
+            "classes": v.classes, "funcs": v.funcs,
+            "attr_types": v.attr_types, "jit_assigns": v.jit_assigns}
+
+
+def analyze_file(path: str, relpath: Optional[str] = None) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        return analyze_source(fh.read(), path, relpath=relpath)
+
+
+# ---------------------------------------------------------------------------
+# the linked graph
+# ---------------------------------------------------------------------------
+
+class FuncInfo:
+    __slots__ = ("gid", "mod", "qual", "path", "line", "is_async",
+                 "cls", "jitted", "writes", "blocking", "hostcalls",
+                 "openw")
+
+    def __init__(self, gid: str, mod: str, qual: str, rec: dict,
+                 path: str):
+        self.gid = gid
+        self.mod = mod
+        self.qual = qual
+        self.path = path
+        self.line = rec["line"]
+        self.is_async = rec["async"]
+        self.cls = rec["cls"]
+        self.jitted = rec["jitted"]
+        self.writes = rec["writes"]
+        self.blocking = rec["blocking"]
+        self.hostcalls = rec["hostcalls"]
+        self.openw = rec["openw"]
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+    def label(self) -> str:
+        kind = "async " if self.is_async else ""
+        return f"{kind}{self.mod}.{self.qual} ({self.path}:{self.line})"
+
+
+class Edge:
+    __slots__ = ("src", "dst", "kind", "line")
+
+    def __init__(self, src: str, dst: str, kind: str, line: int):
+        self.src = src      # caller gid
+        self.dst = dst      # callee gid
+        self.kind = kind    # "call" | "thread" | "loop"
+        self.line = line
+
+
+class CallGraph:
+    """Linked whole-program view over a set of ``FileSummary`` dicts."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, FuncInfo] = {}
+        self.out: Dict[str, List[Edge]] = {}
+        self._by_method: Dict[str, List[str]] = {}
+        self._class_mods: Dict[str, List[str]] = {}
+
+    # -- queries -----------------------------------------------------------
+    def edges_from(self, gid: str) -> List[Edge]:
+        return self.out.get(gid, [])
+
+    def lookup(self, needle: str) -> List[FuncInfo]:
+        """Symbols whose dotted name contains ``needle`` (for
+        ``tx lint --graph``)."""
+        hits = [f for f in self.functions.values()
+                if needle in f"{f.mod}.{f.qual}"]
+        return sorted(hits, key=lambda f: (f.mod, f.qual))
+
+    def reachable(self, roots: Sequence[str], *,
+                  follow_async: bool = True,
+                  kinds: Tuple[str, ...] = ("call",),
+                  stop_at: Tuple[str, ...] = (),
+                  ) -> Dict[str, List[str]]:
+        """BFS over edges of the given kinds. Returns
+        ``{gid: [root_gid, ..., gid]}`` — the shortest call chain that
+        reaches each function.  ``stop_at`` names blessed sinks the
+        walk refuses to enter (matched against the function name and
+        ``module_leaf.name``)."""
+        chains: Dict[str, List[str]] = {}
+        frontier: List[str] = []
+        for r in roots:
+            if r in self.functions and r not in chains:
+                chains[r] = [r]
+                frontier.append(r)
+        while frontier:
+            nxt: List[str] = []
+            for gid in frontier:
+                for e in self.out.get(gid, ()):
+                    if e.kind not in kinds or e.dst in chains:
+                        continue
+                    dst = self.functions.get(e.dst)
+                    if dst is None:
+                        continue
+                    if not follow_async and dst.is_async:
+                        continue
+                    if stop_at and (
+                            dst.name in stop_at
+                            or f"{dst.mod.rsplit('.', 1)[-1]}"
+                               f".{dst.name}" in stop_at):
+                        continue
+                    chains[e.dst] = chains[gid] + [e.dst]
+                    nxt.append(e.dst)
+            frontier = nxt
+        return chains
+
+    def contexts(self) -> Tuple[Dict[str, List[str]],
+                                Dict[str, List[str]]]:
+        """Execution-context coloring: ``(loop, thread)`` maps of
+        ``gid -> chain``.
+
+        *Event-loop context*: every ``async def`` (a coroutine only ever
+        runs on a loop), everything plain-called from one, and targets
+        of ``call_soon_threadsafe``/``create_task``.  *Executor-thread
+        context*: sync targets of ``submit``/``run_in_executor``/
+        ``Thread(target=)`` plus their sync transitive callees.  An
+        async def never acquires thread context — submitting a
+        coroutine builder to a thread runs the builder, not the body."""
+        loop_roots = [g for g, f in self.functions.items() if f.is_async]
+        loop_cb = [e.dst for es in self.out.values() for e in es
+                   if e.kind == "loop"]
+        loop = self.reachable(loop_roots + loop_cb, follow_async=True)
+        thread_roots = [
+            e.dst for es in self.out.values() for e in es
+            if e.kind == "thread"
+            and e.dst in self.functions
+            and not self.functions[e.dst].is_async]
+        thread = self.reachable(thread_roots, follow_async=False)
+        return loop, thread
+
+    def chain_labels(self, chain: Sequence[str]) -> List[str]:
+        return [self.functions[g].label() for g in chain
+                if g in self.functions]
+
+
+def build_graph(summaries: Sequence[dict]) -> CallGraph:
+    """Link per-file summaries into one :class:`CallGraph`."""
+    g = CallGraph()
+    by_mod: Dict[str, dict] = {}
+    for s in summaries:
+        by_mod[s["mod"]] = s
+        for qual, rec in s["funcs"].items():
+            gid = f"{s['mod']}.{qual}"
+            g.functions[gid] = FuncInfo(gid, s["mod"], qual, rec,
+                                        s["path"])
+            g.out[gid] = []
+        for cname in s["classes"]:
+            g._class_mods.setdefault(cname, []).append(s["mod"])
+    # `jax.jit(f)` anywhere marks f jitted — candidates are recorded
+    # as both `enclosing_scope.f` (nested closures) and bare `f`
+    for s in summaries:
+        for target in s["jit_assigns"]:
+            gid = f"{s['mod']}.{target}"
+            if gid in g.functions:
+                g.functions[gid].jitted = True
+    # method-name index for the unique-name fallback
+    for gid, f in g.functions.items():
+        if f.cls is not None:
+            g._by_method.setdefault(f.name, []).append(gid)
+
+    def resolve_import(mod: str, sym: str, depth: int = 0
+                       ) -> Optional[str]:
+        """symbol target "pkg.mod.sym" -> gid, following one-hop
+        __init__ re-exports."""
+        s = by_mod.get(mod)
+        if s is None:
+            return None
+        if sym in s["funcs"]:
+            return f"{mod}.{sym}"
+        if sym in s["classes"]:
+            return None  # constructor call, not a function edge
+        if depth < 4 and sym in s["imports"]:
+            tgt = s["imports"][sym]
+            m2, _, s2 = tgt.rpartition(".")
+            return resolve_import(m2, s2, depth + 1) if m2 else None
+        return None
+
+    def class_method(cname: str, meth: str, seen: Optional[Set[str]]
+                     = None) -> Optional[str]:
+        seen = seen or set()
+        if cname in seen:
+            return None
+        seen.add(cname)
+        for mod in g._class_mods.get(cname, ()):
+            gid = f"{mod}.{cname}.{meth}"
+            if gid in g.functions:
+                return gid
+            bases = by_mod[mod]["classes"][cname]["bases"]
+            for b in bases:
+                hit = class_method(b.rsplit(".", 1)[-1], meth, seen)
+                if hit:
+                    return hit
+        return None
+
+    def unique_method(meth: str) -> Optional[str]:
+        if meth.startswith("__") or meth in _COMMON_METHODS:
+            return None
+        hits = g._by_method.get(meth, ())
+        return hits[0] if len(hits) == 1 else None
+
+    def resolve(s: dict, qual: str, spec: List[Any]
+                ) -> Optional[str]:
+        kind = spec[0]
+        rec = s["funcs"][qual]
+        if kind == "n":
+            name = spec[1]
+            # nested def of this function, then enclosing scopes
+            parts = qual.split(".")
+            for i in range(len(parts), 0, -1):
+                cand = ".".join(parts[:i] + [name])
+                if cand in s["funcs"]:
+                    return f"{s['mod']}.{cand}"
+            if name in s["funcs"]:
+                return f"{s['mod']}.{name}"
+            if name in s["imports"]:
+                tgt = s["imports"][name]
+                mod, _, sym = tgt.rpartition(".")
+                return resolve_import(mod, sym) if mod else None
+            return None
+        if kind == "s":
+            cls = rec["cls"]
+            if cls:
+                hit = class_method(cls, spec[1])
+                if hit:
+                    return hit
+            return unique_method(spec[1])
+        if kind == "d":
+            dotted = spec[1]
+            head, rest = dotted.split(".", 1)
+            if head == "self" and rec["cls"]:
+                # self.attr.meth() via the constructor hints
+                if rest.count(".") == 1:
+                    attr, meth = rest.split(".")
+                    t = s["attr_types"].get(rec["cls"], {}).get(attr)
+                    if t:
+                        hit = class_method(t, meth)
+                        if hit:
+                            return hit
+                return unique_method(dotted.rsplit(".", 1)[-1])
+            if head in rec["var_types"] and rest.count(".") == 0:
+                hit = class_method(rec["var_types"][head], rest)
+                if hit:
+                    return hit
+            if head in s["imports"]:
+                base = s["imports"][head]
+                mod, _, sym = (base + "." + rest).rpartition(".")
+                hit = resolve_import(mod, sym)
+                if hit:
+                    return hit
+            return unique_method(dotted.rsplit(".", 1)[-1])
+        if kind == "m":
+            return unique_method(spec[1])
+        return None
+
+    for s in summaries:
+        for qual, rec in s["funcs"].items():
+            src = f"{s['mod']}.{qual}"
+            for spec in rec["calls"]:
+                dst = resolve(s, qual, spec)
+                if dst and dst != src:
+                    g.out[src].append(Edge(src, dst, "call", spec[-1]))
+            for spec in rec["submits"]:
+                channel = spec[-1]
+                dst = resolve(s, qual, spec[:-1])
+                if dst and dst != src:
+                    g.out[src].append(
+                        Edge(src, dst, channel, spec[-2]))
+    return g

@@ -41,6 +41,73 @@ def add_lint_parser(sub) -> None:
                            "and exit 0")
     lint.add_argument("--list-rules", action="store_true",
                       help="print the rule catalog and exit")
+    lint.add_argument("--graph", metavar="SYMBOL", default=None,
+                      help="dump the call graph around SYMBOL "
+                           "(substring match on the dotted qualname: "
+                           "node, coloring, outgoing edges) and exit")
+    lint.add_argument("--changed", action="store_true",
+                      help="report only findings touching files "
+                           "changed vs git HEAD (+ untracked); the "
+                           "whole-tree analysis still runs, through "
+                           "the incremental cache, so cross-procedure "
+                           "rules see every call edge")
+    lint.add_argument("--cache", default=None, metavar="FILE",
+                      help="incremental cache file (default: "
+                           "TX_LINT_CACHE env or a per-target file "
+                           "under the system tempdir; 'off' disables)")
+
+
+def _git_changed_files() -> list:
+    """Files changed vs HEAD plus untracked .py files — the PR-style
+    lint scope for ``--changed``."""
+    import subprocess
+    out: list = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(
+                f"--changed needs git ({' '.join(cmd)} failed: {e})")
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"--changed: {' '.join(cmd)} exited "
+                f"{res.returncode}: {res.stderr.strip()}")
+        out.extend(ln.strip() for ln in res.stdout.splitlines()
+                   if ln.strip().endswith(".py"))
+    return sorted(set(out))
+
+
+def _dump_graph(paths, symbol: str, cache_path) -> int:
+    from .engine import build_project_graph
+    g = build_project_graph(paths, cache_path=cache_path)
+    hits = g.lookup(symbol)
+    if not hits:
+        print(f"no symbol matching {symbol!r}")
+        return 1
+    loop_ctx, thread_ctx = g.contexts()
+    for f in hits:
+        tags = []
+        if f.is_async:
+            tags.append("async")
+        if f.jitted:
+            tags.append("jitted")
+        if f.gid in loop_ctx:
+            tags.append("event-loop")
+        if f.gid in thread_ctx:
+            tags.append("executor-thread")
+        print(f"{f.mod}.{f.qual}  ({f.path}:{f.line})"
+              f"{'  [' + ', '.join(tags) + ']' if tags else ''}")
+        for e in g.edges_from(f.gid):
+            dst = g.functions.get(e.dst)
+            if dst is None:  # pragma: no cover - dangling edge
+                continue
+            kind = {"call": "calls", "thread": "submits-to-thread",
+                    "loop": "schedules-on-loop"}[e.kind]
+            print(f"    {kind:18s} {dst.mod}.{dst.qual} "
+                  f"(line {e.line})")
+    return 0
 
 
 def run_lint(args) -> int:
@@ -50,21 +117,33 @@ def run_lint(args) -> int:
                 print(f"{rid}  {sev:7s}  {summary}")
             return 0
         paths = args.paths or [_PKG_ROOT]
+        cache_path = args.cache
+        if cache_path == "off":
+            cache_path = ""
+        if args.graph:
+            return _dump_graph(paths, args.graph, cache_path)
+        changed = _git_changed_files() if args.changed else None
         baseline_path = args.baseline
         if baseline_path is None and os.path.exists(DEFAULT_BASELINE_NAME):
             baseline_path = DEFAULT_BASELINE_NAME
         baseline = Baseline.load(baseline_path) if baseline_path else None
         if args.write_baseline:
-            findings, _ = lint_paths(paths, baseline=None)
+            findings, _ = lint_paths(paths, baseline=None,
+                                     cache_path=cache_path)
             out = args.baseline or DEFAULT_BASELINE_NAME
             Baseline.write(out, findings)
             print(f"baseline written: {out} "
                   f"({len(findings)} finding(s) recorded)")
             return 0
-        findings, stale = lint_paths(paths, baseline=baseline)
+        findings, stale = lint_paths(paths, baseline=baseline,
+                                     cache_path=cache_path,
+                                     changed=changed)
         if args.format == "json":
             print(format_json(findings, stale))
         else:
+            if changed is not None:
+                print(f"changed-scope lint: {len(changed)} file(s) "
+                      f"vs git HEAD")
             print(format_text(findings, stale))
         return 1 if findings else 0
     except BrokenPipeError:  # pragma: no cover

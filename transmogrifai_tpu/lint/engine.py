@@ -3,7 +3,9 @@
 Front doors:
 
 - :func:`lint_paths` — AST/JAX rules over source trees (what
-  ``python -m transmogrifai_tpu.cli lint`` runs).
+  ``python -m transmogrifai_tpu.cli lint`` runs), followed by the
+  whole-program cross-procedure pass (rules_xproc) over the linked
+  call graph.
 - :func:`lint_workflow` — DAG rules over a constructed (un-run)
   ``Workflow``; what ``Workflow.train(validate=...)`` calls pre-flight.
 - :func:`lint_model` — DAG rules over a fitted ``WorkflowModel``
@@ -11,41 +13,173 @@ Front doors:
 
 All return plain ``LintFinding`` lists after applying inline
 ``# tx-lint: disable=...`` comments and the optional baseline file.
+
+Incremental cache: per-file local findings and call-graph summaries
+are persisted keyed by content hash (sha1), so a warm repo-wide run
+re-parses only edited files — the graph relink and the cross-procedure
+rules are pure dict work and rerun every time.  ``TX_LINT_CACHE``
+overrides the cache file path; ``TX_LINT_CACHE=off`` disables it.
+A cache document that fails schema or per-entry checksum validation
+is treated as POISONED: it is discarded whole, the run falls back to
+a full re-analysis, and the ``poisoned`` counter in the run stats is
+raised loudly (stderr warning).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import List, Optional, Sequence, Tuple
+import sys
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .baseline import Baseline, is_suppressed_inline
+from .callgraph import SUMMARY_SCHEMA, analyze_source
 from .findings import ERROR, LintFinding
 from .rules_dag import lint_dag
-from .rules_jax import lint_file
+from .rules_jax import lint_source
+from .rules_xproc import lint_cross_procedure
 
 __all__ = ["lint_paths", "lint_workflow", "lint_model", "iter_py_files",
-           "format_text", "format_json", "summarize"]
+           "format_text", "format_json", "summarize", "LintCache",
+           "default_cache_path", "build_project_graph"]
+
+_SKIP_DIRS = ("__pycache__", ".git", ".jax_cache", "node_modules")
 
 
 def iter_py_files(paths: Sequence[str]) -> List[str]:
-    """Expand files/directories into a sorted list of .py files."""
+    """Expand files/directories into a sorted list of .py files.
+
+    Follows directory symlinks but skips symlink LOOPS (a directory
+    whose realpath was already visited) and deduplicates files reached
+    through more than one link. A path that vanishes between listing
+    and the existence check (deleted-file race) raises a clear
+    ``FileNotFoundError`` instead of surfacing a low-level OSError
+    later."""
     out: List[str] = []
+    seen_real: set = set()
     for p in paths:
         if os.path.isdir(p):
-            for root, dirs, files in os.walk(p):
-                dirs[:] = [d for d in dirs
-                           if d not in ("__pycache__", ".git",
-                                        ".jax_cache", "node_modules")]
+            for root, dirs, files in os.walk(p, followlinks=True):
+                rp = os.path.realpath(root)
+                if rp in seen_real:
+                    dirs[:] = []  # symlink loop / revisit: skip subtree
+                    continue
+                seen_real.add(rp)
+                dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
                 out.extend(os.path.join(root, f) for f in files
                            if f.endswith(".py"))
         elif p.endswith(".py"):
             out.append(p)
         else:
             raise FileNotFoundError(f"not a .py file or directory: {p}")
-    missing = [p for p in out if not os.path.exists(p)]
+    by_real: Dict[str, str] = {}
+    for f in sorted(set(out)):
+        by_real.setdefault(os.path.realpath(f), f)
+    missing = [f for f in by_real.values() if not os.path.exists(f)]
     if missing:
-        raise FileNotFoundError(f"no such file: {missing[0]}")
-    return sorted(set(out))
+        raise FileNotFoundError(
+            f"file vanished while scanning (deleted mid-lint?): "
+            f"{missing[0]}")
+    return sorted(by_real.values())
+
+
+# ---------------------------------------------------------------------------
+# incremental cache
+# ---------------------------------------------------------------------------
+
+def default_cache_path(paths: Sequence[str]) -> str:
+    """Stable per-target cache location under the system tempdir
+    (``TX_LINT_CACHE`` overrides)."""
+    env = os.environ.get("TX_LINT_CACHE")
+    if env:
+        return env
+    key = "|".join(sorted(os.path.abspath(p) for p in paths))
+    h = hashlib.sha1(key.encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"txlint-{h}.json")
+
+
+def _entry_checksum(entry: dict) -> str:
+    raw = json.dumps({k: entry[k] for k in ("hash", "summary",
+                                            "findings")},
+                     sort_keys=True)
+    return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+class LintCache:
+    """On-disk per-file cache: content hash -> (local findings,
+    call-graph summary). Self-invalidating on schema bumps; a
+    checksum mismatch on ANY entry poisons the whole document."""
+
+    SCHEMA = 1
+
+    def __init__(self, path: Optional[str]):
+        self.path = path  # None = disabled
+        self.entries: Dict[str, dict] = {}
+        self.stats = {"files": 0, "hits": 0, "misses": 0, "poisoned": 0}
+
+    def load(self) -> None:
+        if not self.path or not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            self._poison("unreadable/corrupt JSON")
+            return
+        if (not isinstance(doc, dict)
+                or doc.get("schema") != self.SCHEMA
+                or doc.get("summary_schema") != SUMMARY_SCHEMA):
+            # a schema bump is routine invalidation, not poisoning
+            return
+        entries = doc.get("files")
+        if not isinstance(entries, dict):
+            self._poison("missing file table")
+            return
+        for key, entry in entries.items():
+            if (not isinstance(entry, dict)
+                    or entry.get("sum") != _entry_checksum(entry)):
+                self._poison(f"checksum mismatch for {key}")
+                return
+        self.entries = entries
+
+    def _poison(self, why: str) -> None:
+        self.entries = {}
+        self.stats["poisoned"] += 1
+        print(f"tx-lint: WARNING: cache poisoned ({why}) — "
+              f"discarding {self.path} and re-analyzing everything",
+              file=sys.stderr)
+
+    def get(self, abspath: str, content_hash: str) -> Optional[dict]:
+        entry = self.entries.get(abspath)
+        if entry is not None and entry.get("hash") == content_hash:
+            self.stats["hits"] += 1
+            return entry
+        self.stats["misses"] += 1
+        return None
+
+    def put(self, abspath: str, content_hash: str, summary: dict,
+            findings: List[LintFinding]) -> dict:
+        entry = {"hash": content_hash, "summary": summary,
+                 "findings": [f.to_json() for f in findings]}
+        entry["sum"] = _entry_checksum(entry)
+        self.entries[abspath] = entry
+        return entry
+
+    def save(self, keep: Sequence[str]) -> None:
+        if not self.path:
+            return
+        doc = {"schema": self.SCHEMA,
+               "summary_schema": SUMMARY_SCHEMA,
+               "files": {k: self.entries[k] for k in keep
+                         if k in self.entries}}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self.path)
+        except OSError:  # pragma: no cover - read-only tempdir
+            pass
 
 
 def _apply_inline_suppressions(findings: List[LintFinding]
@@ -70,13 +204,96 @@ def _apply_inline_suppressions(findings: List[LintFinding]
     return kept
 
 
-def lint_paths(paths: Sequence[str],
-               baseline: Optional[Baseline] = None
-               ) -> Tuple[List[LintFinding], List[str]]:
-    """(findings, stale baseline fingerprints) for the source rules."""
+def _analyze_files(files: Sequence[str], cache: LintCache
+                   ) -> Tuple[List[LintFinding], List[dict]]:
+    """Per-file pass: local rules + call-graph summary, through the
+    cache."""
     findings: List[LintFinding] = []
-    for path in iter_py_files(paths):
-        findings.extend(lint_file(path))
+    summaries: List[dict] = []
+    for path in files:
+        abspath = os.path.abspath(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            raise FileNotFoundError(
+                f"file vanished during lint (deleted mid-run?): "
+                f"{path} ({e})") from e
+        content_hash = hashlib.sha1(source.encode()).hexdigest()
+        entry = cache.get(abspath, content_hash)
+        if entry is None:
+            local = lint_source(source, path)
+            summary = analyze_source(source, path, relpath=path)
+            entry = cache.put(abspath, content_hash, summary, local)
+            findings.extend(local)
+        else:
+            findings.extend(LintFinding.from_json(d)
+                            for d in entry["findings"])
+        summaries.append(entry["summary"])
+    cache.stats["files"] = len(files)
+    return findings, summaries
+
+
+def build_project_graph(paths: Sequence[str],
+                        cache_path: Optional[str] = None):
+    """Linked :class:`~.callgraph.CallGraph` for ``paths`` (what
+    ``tx lint --graph`` inspects), through the incremental cache."""
+    from .callgraph import build_graph
+    files = iter_py_files(paths)
+    cache = LintCache(_resolve_cache_path(paths, cache_path))
+    cache.load()
+    _, summaries = _analyze_files(files, cache)
+    cache.save(keep=[os.path.abspath(f) for f in files])
+    return build_graph(summaries)
+
+
+def _resolve_cache_path(paths: Sequence[str],
+                        cache_path: Optional[str]) -> Optional[str]:
+    if cache_path is not None:
+        return cache_path or None
+    env = os.environ.get("TX_LINT_CACHE")
+    if env in ("off", "0"):
+        return None
+    return default_cache_path(paths)
+
+
+def lint_paths(paths: Sequence[str],
+               baseline: Optional[Baseline] = None,
+               *,
+               cache_path: Optional[str] = None,
+               changed: Optional[Sequence[str]] = None,
+               stats_out: Optional[dict] = None,
+               ) -> Tuple[List[LintFinding], List[str]]:
+    """(findings, stale baseline fingerprints) for the source rules —
+    the per-file AST rules plus the cross-procedure call-graph pass.
+
+    ``cache_path``: explicit incremental-cache file ('' disables;
+    default: ``TX_LINT_CACHE`` env or a per-target tempdir file).
+    ``changed``: restrict REPORTING to these files (the analysis still
+    covers the whole tree so call-graph rules see every edge): local
+    findings in a changed file, plus cross-procedure findings whose
+    call chain touches one.
+    ``stats_out``: dict that receives the cache counters
+    (files/hits/misses/poisoned).
+    """
+    files = iter_py_files(paths)
+    cache = LintCache(_resolve_cache_path(paths, cache_path))
+    cache.load()
+    findings, summaries = _analyze_files(files, cache)
+    findings.extend(lint_cross_procedure(summaries))
+    cache.save(keep=[os.path.abspath(f) for f in files])
+    if stats_out is not None:
+        stats_out.update(cache.stats)
+    if changed is not None:
+        want = {os.path.abspath(c) for c in changed}
+
+        def _touches(f: LintFinding) -> bool:
+            if f.path and os.path.abspath(f.path) in want:
+                return True
+            return any(os.path.abspath(frame.rsplit("(", 1)[-1]
+                                       .split(":")[0]) in want
+                       for frame in f.chain if "(" in frame)
+        findings = [f for f in findings if _touches(f)]
     findings = _apply_inline_suppressions(findings)
     if baseline is not None:
         return baseline.split(findings)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 __all__ = ["LintFinding", "LintError", "RULES", "ERROR", "WARNING",
            "rule_severity"]
@@ -102,6 +102,31 @@ RULES: Dict[str, tuple] = {
                       "overflow at the admission edge "
                       "(serving/admission.py) with a retry_after_ms "
                       "answer instead of queue-and-pray"),
+    # -- cross-procedure rules (whole-program call graph) ------------------
+    "TX-X01": (ERROR, "blocking primitive (time.sleep, sync open() "
+                      "file I/O, .block_until_ready(), un-awaited "
+                      "sleep) reachable from a serving/ async handler "
+                      "through any chain of sync helpers — "
+                      "interprocedural TX-J10; the finding carries "
+                      "the full call chain"),
+    "TX-X02": (ERROR, "host transfer (.item(), .block_until_ready()) "
+                      "or clock/telemetry emission reachable from "
+                      "inside a jitted body through helper calls — "
+                      "interprocedural TX-J01/TX-O01; it executes at "
+                      "trace time and bakes into the program"),
+    "TX-X03": (ERROR, "event-loop/thread race: an attribute of a "
+                      "serving/ class written both from event-loop "
+                      "context (coroutines + helpers they call) and "
+                      "from executor-thread context (run_in_executor/"
+                      "Thread/submit targets) without a blessed "
+                      "channel (call_soon_threadsafe, the swap/"
+                      "rollback/commit API, atomic_write_json, a "
+                      "shared Lock) — both conflicting call chains "
+                      "reported"),
+    "TX-X04": (ERROR, "raw open(w/a/x) to a live path reachable from "
+                      "a snapshot/fingerprint/profile persistence "
+                      "entry point — interprocedural TX-R04: a crash "
+                      "mid-write tears the document"),
     # -- tuning rules ------------------------------------------------------
     "TX-T01": (ERROR, "numeric literal default for a registered tunable "
                       "knob outside tuning/ — the knob's single source "
@@ -132,6 +157,10 @@ class LintFinding:
     #: DAG findings: the offending feature/stage uid (location stand-in)
     subject: Optional[str] = None
     hint: Optional[str] = None
+    #: cross-procedure findings: the call chain that proves
+    #: reachability, outermost entry point first, violating site last
+    #: (a tuple of human-readable frames). Empty for local findings.
+    chain: Tuple[str, ...] = ()
 
     def location(self) -> str:
         if self.path:
@@ -147,7 +176,7 @@ class LintFinding:
         return hashlib.sha1(raw.encode()).hexdigest()[:16]
 
     def to_json(self) -> dict:
-        return {
+        doc = {
             "rule": self.rule_id,
             "severity": self.severity,
             "location": self.location(),
@@ -158,11 +187,30 @@ class LintFinding:
             "hint": self.hint,
             "fingerprint": self.fingerprint(),
         }
+        if self.chain:
+            # only present for cross-procedure findings — existing
+            # --format json consumers see an unchanged document
+            doc["chain"] = list(self.chain)
+        return doc
+
+    @classmethod
+    def from_json(cls, d: dict) -> "LintFinding":
+        """Inverse of :meth:`to_json` (the incremental cache persists
+        findings through this round trip)."""
+        return cls(rule_id=d["rule"], message=d["message"],
+                   severity=d.get("severity", ERROR),
+                   path=d.get("path"), line=int(d.get("line") or 0),
+                   subject=d.get("subject"), hint=d.get("hint"),
+                   chain=tuple(d.get("chain") or ()))
 
     def __str__(self) -> str:
         hint = f"  [{self.hint}]" if self.hint else ""
-        return (f"{self.location()}: {self.severity}: "
+        body = (f"{self.location()}: {self.severity}: "
                 f"{self.rule_id}: {self.message}{hint}")
+        if self.chain:
+            body += "".join(f"\n    {'-> ' if i else 'via '}{frame}"
+                            for i, frame in enumerate(self.chain))
+        return body
 
 
 class LintError(ValueError):

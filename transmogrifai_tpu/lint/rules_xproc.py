@@ -1,0 +1,208 @@
+"""Cross-procedure lint rules over the whole-program call graph.
+
+Where rules_jax.py asks "does this call appear *directly* inside the
+guarded scope", these rules ask "is it reachable *on any call path*":
+
+- **TX-X01** — a blocking primitive (``time.sleep``, sync ``open()``
+  file I/O, ``.block_until_ready()``, an un-awaited ``sleep``)
+  reachable from a ``serving/`` async handler through any chain of
+  sync helpers.  Interprocedural TX-J10.
+- **TX-X02** — a host transfer (``.item()``,
+  ``.block_until_ready()``) or clock/telemetry emission reachable
+  from inside a jitted body through helper calls.  Interprocedural
+  TX-J01/TX-O01.
+- **TX-X03** — the event-loop/thread race detector: an attribute of a
+  ``serving/`` class written both from event-loop context and from
+  executor-thread context without a blessed channel
+  (``call_soon_threadsafe``, the ``swap_entry``/``rollback``/
+  ``commit`` hot-swap API, ``atomic_write_json``, an explicit
+  ``Lock`` guard).  The finding carries BOTH conflicting chains.
+- **TX-X04** — a raw ``open(w/a/x)`` to a live (non-tmp, non-lock)
+  path reachable from any snapshot/fingerprint/profile-persist entry
+  point.  Interprocedural TX-R04.
+
+Findings anchor at the violating call site (so inline
+``# tx-lint: disable=TX-X0n`` works there) and carry the full call
+chain in ``LintFinding.chain``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from .callgraph import (BLESSED_PERSIST_SINKS, BLESSED_TRACE_SINKS,
+                        CallGraph, FuncInfo, build_graph)
+from .findings import LintFinding, rule_severity
+
+__all__ = ["lint_cross_procedure", "PERSIST_ENTRY_MARKERS"]
+
+#: a function whose dotted name contains one of these is a persistence
+#: entry point for TX-X04 (ServingStateSnapshot.capture,
+#: save_fingerprints, persist_process_profiles, ...)
+PERSIST_ENTRY_MARKERS = ("snapshot", "fingerprint", "persist")
+
+
+def _is_serving(path: str) -> bool:
+    return "serving" in path.replace("\\", "/").split("/")
+
+
+def _finding(rule: str, f: FuncInfo, line: int, message: str,
+             chain: Sequence[str], hint: str) -> LintFinding:
+    return LintFinding(
+        rule_id=rule, severity=rule_severity(rule), path=f.path,
+        line=line, message=message, hint=hint, chain=tuple(chain))
+
+
+def _site_chain(g: CallGraph, chain: List[str], f: FuncInfo,
+                desc: str, line: int) -> List[str]:
+    return g.chain_labels(chain) + [f"{desc} ({f.path}:{line})"]
+
+
+# ---------------------------------------------------------------------------
+# TX-X01 — blocking work reachable from a serving async handler
+# ---------------------------------------------------------------------------
+
+def _rule_x01(g: CallGraph) -> List[LintFinding]:
+    roots = [gid for gid, f in g.functions.items()
+             if f.is_async and _is_serving(f.path)]
+    chains = g.reachable(roots, follow_async=True, kinds=("call",))
+    out: List[LintFinding] = []
+    for gid, chain in chains.items():
+        f = g.functions[gid]
+        if f.is_async or len(chain) < 2:
+            continue  # direct sites in the handler are TX-J10's
+        root = g.functions[chain[0]]
+        for desc, line in f.blocking:
+            out.append(_finding(
+                "TX-X01", f, line,
+                f"blocking {desc}() in {f.qual} is reachable from "
+                f"serving async handler {root.qual} through "
+                f"{len(chain) - 1} call(s) — it stalls the event loop "
+                f"for every in-flight request",
+                _site_chain(g, chain, f, desc, line),
+                "route the blocking work through "
+                "loop.run_in_executor(...) or make the chain async"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TX-X02 — host transfer / clock / telemetry reachable from a jitted body
+# ---------------------------------------------------------------------------
+
+def _rule_x02(g: CallGraph) -> List[LintFinding]:
+    roots = [gid for gid, f in g.functions.items() if f.jitted]
+    chains = g.reachable(roots, follow_async=False, kinds=("call",),
+                         stop_at=BLESSED_TRACE_SINKS)
+    out: List[LintFinding] = []
+    for gid, chain in chains.items():
+        f = g.functions[gid]
+        if len(chain) < 2 or f.jitted:
+            continue  # local sites are TX-J01/TX-O01's
+        root = g.functions[chain[0]]
+        for desc, line in f.hostcalls:
+            out.append(_finding(
+                "TX-X02", f, line,
+                f"{desc} in {f.qual} executes at TRACE time of jitted "
+                f"{root.qual} ({len(chain) - 1} call(s) away): a host "
+                f"transfer forces a device sync per trace, a clock or "
+                f"telemetry emission records compilation and bakes "
+                f"into the program",
+                _site_chain(g, chain, f, desc, line),
+                "hoist the host work out of the traced call tree (or "
+                "wrap a deliberate trace-cost probe in "
+                "compile_time.section)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TX-X03 — event-loop vs executor-thread attribute races
+# ---------------------------------------------------------------------------
+
+def _rule_x03(g: CallGraph) -> List[LintFinding]:
+    loop_ctx, thread_ctx = g.contexts()
+    # (class, attr) -> [(func, line, blessed, context, chain)]
+    writes: Dict[Tuple[str, str], List[tuple]] = {}
+    for gid, f in g.functions.items():
+        if f.cls is None or not _is_serving(f.path) or not f.writes:
+            continue
+        in_loop = gid in loop_ctx
+        in_thread = gid in thread_ctx
+        if not (in_loop or in_thread):
+            continue
+        for attr, line, blessed in f.writes:
+            sites = writes.setdefault((f.cls, attr), [])
+            if in_loop:
+                sites.append((f, line, blessed, "loop", loop_ctx[gid]))
+            if in_thread:
+                sites.append((f, line, blessed, "thread",
+                              thread_ctx[gid]))
+    out: List[LintFinding] = []
+    for (cls, attr), sites in sorted(writes.items()):
+        loops = [s for s in sites if s[3] == "loop"]
+        threads = [s for s in sites if s[3] == "thread"]
+        if not loops or not threads:
+            continue
+        if all(s[2] for s in sites):
+            continue  # every write is lock-guarded / blessed — safe
+        # anchor at an unblessed site, preferring the event-loop side
+        anchor = next((s for s in loops if not s[2]),
+                      next((s for s in threads if not s[2]), loops[0]))
+        lf, lline = loops[0][0], loops[0][1]
+        tf, tline = threads[0][0], threads[0][1]
+        chain = (["[event-loop path]"]
+                 + _site_chain(g, loops[0][4], lf,
+                               f"write {cls}.{attr}", lline)
+                 + ["[executor-thread path]"]
+                 + _site_chain(g, threads[0][4], tf,
+                               f"write {cls}.{attr}", tline))
+        out.append(_finding(
+            "TX-X03", anchor[0], anchor[1],
+            f"attribute {cls}.{attr} is written from event-loop "
+            f"context ({lf.qual}, {lf.path}:{lline}) AND from "
+            f"executor-thread context ({tf.qual}, {tf.path}:{tline}) "
+            f"without a blessed channel — a torn/stale read is a "
+            f"matter of scheduling",
+            chain,
+            "marshal the write through loop.call_soon_threadsafe, "
+            "the PlanCache swap/rollback/commit API, or guard BOTH "
+            "sides with the same threading.Lock"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TX-X04 — raw open(w/a/x) reachable from a persistence entry point
+# ---------------------------------------------------------------------------
+
+def _rule_x04(g: CallGraph) -> List[LintFinding]:
+    roots = [gid for gid, f in g.functions.items()
+             if any(m in f.qual.lower() for m in PERSIST_ENTRY_MARKERS)]
+    chains = g.reachable(roots, follow_async=True, kinds=("call",),
+                         stop_at=BLESSED_PERSIST_SINKS)
+    out: List[LintFinding] = []
+    for gid, chain in chains.items():
+        f = g.functions[gid]
+        root = g.functions[chain[0]]
+        for line, mode in f.openw:
+            out.append(_finding(
+                "TX-X04", f, line,
+                f"raw open(mode={mode!r}) in {f.qual} is reachable "
+                f"from persistence entry point {root.qual}"
+                + (f" through {len(chain) - 1} call(s)"
+                   if len(chain) > 1 else "")
+                + " — a crash mid-write leaves a TORN document",
+                _site_chain(g, chain, f, f"open(..., {mode!r})", line),
+                "write through observability.store.atomic_write_json "
+                "(tmp file + os.replace), or stage into a "
+                "tmp-marked path"))
+    return out
+
+
+def lint_cross_procedure(summaries: Sequence[dict]
+                         ) -> List[LintFinding]:
+    """Run TX-X01..TX-X04 over the linked call graph of per-file
+    summaries (callgraph.analyze_file). Deterministic order: rule id,
+    then path, then line."""
+    g = build_graph(summaries)
+    findings = (_rule_x01(g) + _rule_x02(g) + _rule_x03(g)
+                + _rule_x04(g))
+    findings.sort(key=lambda f: (f.rule_id, f.path or "", f.line))
+    return findings

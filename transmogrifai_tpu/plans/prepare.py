@@ -591,9 +591,7 @@ def _build_segment_fn(step_pos, k_in: int):
             outs.append(o * (mask[:, None] if o.ndim == 2 else mask))
         return tuple(outs)
 
-    # one jit per SEGMENT, cached across trains via the state
-    # fingerprint — per-call recompiles cannot happen here
-    return jax.jit(run), trace_seconds  # tx-lint: disable=TX-J02
+    return jax.jit(run), trace_seconds  # tx-lint: disable=TX-J02 (one jit per SEGMENT, cached across trains via the state fingerprint)
 
 
 def _raw_features(result_features: Sequence[Feature]) -> List[Feature]:

@@ -372,9 +372,7 @@ class ScoringPlan:
         if self.donate is None:
             self.donate = jax.default_backend() != "cpu"
         donate = (0,) if self.donate else ()
-        # one jit per PLAN (compile() runs once per model) — per-call
-        # recompiles cannot happen here, each bucket shape is cached
-        self._device_fn = jax.jit(run, donate_argnums=donate)  # tx-lint: disable=TX-J02,TX-J06
+        self._device_fn = jax.jit(run, donate_argnums=donate)  # tx-lint: disable=TX-J02,TX-J06 (one jit per PLAN: compile() runs once per model, each bucket shape cached)
 
     # -- guardrails --------------------------------------------------------
     def with_guardrails(self, admission: Optional[AdmissionPolicy] = None,
