@@ -49,6 +49,18 @@ def _isolated_profile_store(tmp_path, monkeypatch):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _isolated_audit_cache(tmp_path, monkeypatch):
+    """Hermetic audit cache: the plan auditor (analysis/cache.py) and
+    the save/load fingerprint hooks default to a shared per-checkout
+    cache file under the system tempdir — tests must not read or seed
+    it. Tests that assert hit/miss behavior pass cache_path
+    explicitly (wins over the env)."""
+    monkeypatch.setenv("TX_AUDIT_CACHE",
+                       str(tmp_path / "audit_cache.json"))
+    yield
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -2170,3 +2170,83 @@ class TestLintCli:
              "--cache", "off"])
         assert run_lint(args) == 1
         assert "no symbol matching" in capsys.readouterr().out
+
+    def test_graph_json_wire_format_omits_empty(self, tmp_path,
+                                                capsys):
+        """The --graph JSON convention matches LintFinding.to_json's
+        chain handling: empty collections are OMITTED, never emitted
+        as [] — a leaf node carries no "calls" key, an untagged node
+        no "tags" key (satellite fix: the omit-when-empty wire
+        contract)."""
+        import json as _json
+        from transmogrifai_tpu.lint.cli import _dump_graph
+        (tmp_path / "mod.py").write_text(
+            "def leaf_fn():\n    return 1\n\n\n"
+            "def caller_fn():\n    return leaf_fn()\n")
+        assert _dump_graph([str(tmp_path)], "caller_fn", "",
+                           fmt="json") == 0
+        caller_doc = _json.loads(capsys.readouterr().out)
+        (node,) = caller_doc["nodes"]
+        assert node["name"].endswith("mod.caller_fn")
+        assert [c["target"].split(".")[-1] for c in node["calls"]] \
+            == ["leaf_fn"]
+        assert "tags" not in node            # untagged: key omitted
+        assert _dump_graph([str(tmp_path)], "leaf_fn", "",
+                           fmt="json") == 0
+        leaf_doc = _json.loads(capsys.readouterr().out)
+        (leaf,) = leaf_doc["nodes"]
+        assert "calls" not in leaf           # leaf: no empty [] key
+        assert "tags" not in leaf
+        assert set(leaf) == {"name", "path", "line"}
+
+    def test_graph_json_unknown_symbol_document(self, capsys):
+        import json as _json
+        from transmogrifai_tpu.lint.cli import _dump_graph
+        assert _dump_graph([PKG], "definitely_not_a_symbol_xyz",
+                           "", fmt="json") == 1
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc == {"symbol": "definitely_not_a_symbol_xyz",
+                       "nodes": []}
+
+
+class TestRepoGateAudit:
+    """The HLO-level repo gate (docs/plan_audit.md): the shipped demo
+    plans — scoring buckets AND prepare segments — lower with ZERO
+    TX-P findings, inside the cold/warm budgets. Shares one audit
+    cache across the class so the warm test exercises the real
+    cache path."""
+
+    @pytest.fixture(scope="class")
+    def audit_cache(self, tmp_path_factory):
+        return str(tmp_path_factory.mktemp("txaudit") / "gate.json")
+
+    def test_demo_audit_cold_clean_within_budget(self, audit_cache):
+        import time as _time
+        from transmogrifai_tpu.analysis import audit_demo, lint_audits
+        t0 = _time.monotonic()
+        result = audit_demo(cache_path=audit_cache)
+        cold = _time.monotonic() - t0
+        assert cold < 15.0, f"cold demo audit took {cold:.1f}s"
+        assert {a.plan for a in result.audits} == {"score", "prepare"}
+        assert all(a.fusions >= 0 for a in result.audits)
+        findings = result.findings + lint_audits(result.audits)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_demo_audit_warm_within_budget(self, audit_cache):
+        import time as _time
+        from transmogrifai_tpu.analysis import audit_demo
+        audit_demo(cache_path=audit_cache)          # ensure warm
+        t0 = _time.monotonic()
+        result = audit_demo(cache_path=audit_cache)
+        warm = _time.monotonic() - t0
+        assert warm < 2.0, f"warm demo audit took {warm:.2f}s"
+        assert result.stats["misses"] == 0
+        assert result.stats["hits"] == 2            # score + prepare
+        assert result.findings == []
+
+    def test_warm_audits_bitwise_match_cold(self, audit_cache):
+        from transmogrifai_tpu.analysis import audit_demo
+        a1 = audit_demo(cache_path=audit_cache)
+        a2 = audit_demo(cache_path=audit_cache)
+        assert [a.to_json() for a in a1.audits] == \
+            [a.to_json() for a in a2.audits]

@@ -1,0 +1,630 @@
+"""Plan auditor tests (transmogrifai_tpu/analysis/, docs/plan_audit.md).
+
+Covers the StableHLO walker, the canonical fingerprint (bitwise
+stability + sensitivity to kernel edits), the TX-P rule family with a
+positive AND a negative fixture per rule, the content-keyed audit
+cache (exactly-N-miss contracts, kernel-edit invalidation, poisoning),
+the save/load fingerprint sidecar with its ``plan_fingerprint_drift``
+telemetry, the PreparePlan audit handles, and the ``tx audit`` CLI
+exit-code contract.
+"""
+import json
+import os
+import re
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.analysis import (AuditCache, PlanAudit,
+                                        audit_findings, audit_model,
+                                        audit_prepare_plan,
+                                        audit_scoring_plan,
+                                        canonical_fingerprint,
+                                        kernel_source_hash,
+                                        occupancy_findings, parse_module,
+                                        plan_fingerprint,
+                                        verify_classification)
+from transmogrifai_tpu.analysis.audit import (AUDIT_SIDECAR,
+                                              _audit_lowered,
+                                              verify_plan_fingerprint)
+from transmogrifai_tpu.observability.store import ProfileStore
+from transmogrifai_tpu.runtime import telemetry
+from transmogrifai_tpu.serving import ScoringPlan
+
+
+def _rules(findings):
+    return sorted(f.rule_id for f in findings)
+
+
+@pytest.fixture(scope="module")
+def demo(tmp_path_factory):
+    """One trained tiny pipeline per module: (model, prepare plan,
+    saved model dir). Saving runs the fingerprint hook, so the dir
+    carries the plan-fingerprint.json sidecar."""
+    from transmogrifai_tpu.cli.score import _tiny_pipeline
+    from transmogrifai_tpu.plans.prepare import last_prepare_plan
+    model, _records = _tiny_pipeline(n_rows=160)
+    prep = last_prepare_plan()
+    mdir = str(tmp_path_factory.mktemp("audit-model") / "model")
+    model.save(mdir)
+    return model, prep, mdir
+
+
+def _lower(fn, *avals):
+    return jax.jit(fn).lower(*avals)
+
+
+def _aval(shape, dtype=np.float64):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# the StableHLO walker
+# ---------------------------------------------------------------------------
+
+class TestHloParser:
+    def test_byte_accounting_from_real_lowering(self):
+        low = _lower(lambda x, y: (x @ y).sum(),
+                     _aval((8, 2)), _aval((2,)))
+        stats = parse_module(low.as_text())
+        assert stats.parameter_bytes == 8 * 2 * 8 + 2 * 8
+        assert stats.output_bytes == 8          # f64 scalar
+        assert stats.op_histogram.get("stablehlo.dot_general", 0) >= 1
+        assert stats.n_ops == sum(stats.op_histogram.values())
+        assert stats.host_transfer_ops == []
+        assert stats.dynamic_shape_ops == []
+
+    def test_host_transfer_and_dynamic_detection(self):
+        text = """module @m {
+  func.func public @main(%arg0: tensor<4xf32>) -> tensor<4xf32> {
+    %0 = stablehlo.constant dense<1.0> : tensor<4xf32>
+    %1 = stablehlo.custom_call @xla_python_cpu_callback(%arg0) : (tensor<4xf32>) -> tensor<4xf32>
+    %2 = stablehlo.custom_call @Sharding(%arg0) : (tensor<4xf32>) -> tensor<4xf32>
+    %3 = stablehlo.dynamic_broadcast_in_dim %arg0 : tensor<?xf32>
+    return %1 : tensor<4xf32>
+  }
+}"""
+        stats = parse_module(text)
+        assert stats.host_transfer_ops == [
+            "stablehlo.custom_call@xla_python_cpu_callback"]
+        assert "stablehlo.dynamic_broadcast_in_dim" \
+            in stats.dynamic_shape_ops
+        assert stats.constant_bytes == 16
+        assert stats.parameter_bytes == 16
+        assert stats.output_bytes == 16
+
+    def test_normalization_strips_only_noise(self):
+        base = ('module @jit_f {\n'
+                '  func.func public @main(%arg0: tensor<2xf64>)'
+                ' -> tensor<2xf64> {\n'
+                '    %0 = stablehlo.multiply %arg0, %arg0 :'
+                ' tensor<2xf64>\n    return %0 : tensor<2xf64>\n  }\n}')
+        noisy = base.replace(
+            "module @jit_f", "module @jit_g").replace(
+            " : tensor<2xf64>\n    return",
+            ' : tensor<2xf64> loc("k.py":3:0)\n    return')
+        assert canonical_fingerprint(base, "0.4.37", "cpu") == \
+            canonical_fingerprint(noisy, "0.4.37", "cpu")
+        # a CONSTANT/op change is identity, not noise
+        changed = base.replace("multiply", "add")
+        assert canonical_fingerprint(changed, "0.4.37", "cpu") != \
+            canonical_fingerprint(base, "0.4.37", "cpu")
+        # ...and so is the environment key
+        assert canonical_fingerprint(base, "0.4.38", "cpu") != \
+            canonical_fingerprint(base, "0.4.37", "cpu")
+
+    def test_planaudit_json_round_trip(self):
+        low = _lower(lambda x: x * 2.0, _aval((8,)))
+        aud = _audit_lowered(low, plan="score", label="b8", bucket=8,
+                             stages=["S"], compiled=False)
+        assert PlanAudit.from_json(
+            json.loads(json.dumps(aud.to_json()))).to_json() \
+            == aud.to_json()
+
+
+# ---------------------------------------------------------------------------
+# fingerprint stability (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+class TestFingerprintStability:
+    def test_bitwise_stable_across_recompiles(self, demo):
+        model = demo[0]
+        runs = []
+        for _ in range(2):
+            plan = ScoringPlan(model, min_bucket=8,
+                               max_bucket=16).compile()
+            runs.append(audit_scoring_plan(plan, compiled=False))
+        assert [a.to_json() for a in runs[0]] == \
+            [a.to_json() for a in runs[1]]
+        assert all(re.fullmatch(r"xla:\w+:jax-[\w.+-]+:[0-9a-f]{32}",
+                                a.fingerprint) for a in runs[0])
+
+    def test_fingerprint_moves_on_kernel_edit(self, demo, monkeypatch):
+        model = demo[0]
+        plan = ScoringPlan(model, min_bucket=8, max_bucket=8).compile()
+        base = audit_scoring_plan(plan, buckets=[8],
+                                  compiled=False)[0].fingerprint
+        stage = plan._device_steps[0][0]
+        cls = type(stage)
+        orig = cls.transform_arrays
+        monkeypatch.setattr(
+            cls, "transform_arrays",
+            lambda self, arrays: orig(self, arrays) * 2.0)
+        edited_plan = ScoringPlan(model, min_bucket=8,
+                                  max_bucket=8).compile()
+        edited = audit_scoring_plan(edited_plan, buckets=[8],
+                                    compiled=False)[0].fingerprint
+        assert edited != base
+
+    def test_plan_fingerprint_env_keyed(self, demo):
+        fp = plan_fingerprint(demo[0])
+        assert fp.startswith(
+            f"xla:{jax.default_backend()}:jax-{jax.__version__}:")
+        assert fp == plan_fingerprint(demo[0])
+
+
+# ---------------------------------------------------------------------------
+# TX-P01 / TX-P02 (IR rules) — positive and negative fixtures
+# ---------------------------------------------------------------------------
+
+class TestRuleP01HostTransfer:
+    def _callback_audit(self, plan_name):
+        def bad(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a) * 2.0,
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        low = _lower(bad, _aval((8,)))
+        return _audit_lowered(low, plan=plan_name, label="b8", bucket=8,
+                              stages=["Bad"], compiled=False)
+
+    def test_fires_on_callback_in_scoring_program(self):
+        aud = self._callback_audit("score")
+        assert aud.host_transfer_ops      # IR ground truth
+        findings = audit_findings([aud])
+        assert _rules(findings) == ["TX-P01"]
+        assert findings[0].severity == "error"
+        assert "host" in findings[0].message
+
+    def test_silent_on_clean_program(self):
+        low = _lower(lambda x: jnp.tanh(x) * 2.0, _aval((8,)))
+        aud = _audit_lowered(low, plan="score", label="b8", bucket=8,
+                             stages=[], compiled=False)
+        assert aud.host_transfer_ops == []
+        assert audit_findings([aud]) == []
+
+    def test_scoped_to_scoring_plans(self):
+        # prepare segments MAY legitimately stage through host phases;
+        # the serving-program rule must not fire on them
+        aud = self._callback_audit("prepare")
+        assert aud.host_transfer_ops
+        assert audit_findings([aud]) == []
+
+
+class TestRuleP02Widening:
+    def test_fires_on_widening_beyond_inputs(self):
+        low = _lower(lambda x: x.astype(jnp.float64) * 2.0,
+                     _aval((8,), np.float32))
+        aud = _audit_lowered(low, plan="score", label="b8", bucket=8,
+                             stages=[], compiled=False)
+        assert aud.param_widths["float"] == 32
+        assert aud.body_widths["float"] == 64
+        findings = audit_findings([aud])
+        assert _rules(findings) == ["TX-P02"]
+        assert findings[0].severity == "warning"
+
+    def test_silent_when_inputs_already_wide(self):
+        # an all-f64 pipeline under x64 is the NORM in this repo —
+        # width is judged against the inputs, not against f32
+        low = _lower(lambda x: jnp.tanh(x) + 1.0, _aval((8,)))
+        aud = _audit_lowered(low, plan="score", label="b8", bucket=8,
+                             stages=[], compiled=False)
+        assert aud.param_widths["float"] == 64
+        assert audit_findings([aud]) == []
+
+
+# ---------------------------------------------------------------------------
+# TX-P03 / TX-P04 (occupancy rules) — positive and negative fixtures
+# ---------------------------------------------------------------------------
+
+def _ladder():
+    return [PlanAudit(plan="score", label=f"b{b}", bucket=b)
+            for b in (8, 16, 32, 64)]
+
+
+class TestOccupancyRules:
+    def _store(self, tmp_path, records):
+        store = ProfileStore(str(tmp_path / "occupancy_store.json"))
+        store.record_profiles(records)
+        return store
+
+    def test_p03_fires_on_uncovered_recorded_bucket(self, tmp_path):
+        store = self._store(tmp_path,
+                            {"score:b7": {"calls": 3, "rows": 10}})
+        findings = occupancy_findings(_ladder(), store=store)
+        assert _rules(findings) == ["TX-P03"]
+        assert findings[0].subject == "score:b7"
+        assert findings[0].severity == "warning"
+
+    def test_p03_silent_when_ladder_covers_traffic(self, tmp_path):
+        store = self._store(tmp_path,
+                            {"score:b8": {"calls": 3, "rows": 20}})
+        assert occupancy_findings(_ladder(), store=store) == []
+
+    def test_p04_fires_above_waste_ceiling(self, tmp_path):
+        # 100 dispatches of bucket 64 carrying 100 real rows total:
+        # waste = 100*64/100 = 64x > 16x default ceiling
+        store = self._store(tmp_path,
+                            {"score:b64": {"calls": 100, "rows": 100}})
+        findings = occupancy_findings(_ladder(), store=store)
+        assert _rules(findings) == ["TX-P04"]
+        assert findings[0].severity == "error"
+        assert "64.0x" in findings[0].message
+
+    def test_p04_ceiling_is_the_registered_knob(self, tmp_path):
+        from transmogrifai_tpu.tuning.registry import STATIC_DEFAULTS
+        assert STATIC_DEFAULTS["audit.waste_ceiling"] == 16.0
+        store = self._store(tmp_path,
+                            {"score:b64": {"calls": 100, "rows": 100}})
+        # an explicit ceiling above the measured waste silences it
+        assert occupancy_findings(_ladder(), store=store,
+                                  waste_ceiling=100.0) == []
+
+    def test_p04_silent_without_occupancy_data(self, tmp_path):
+        store = self._store(tmp_path,
+                            {"score:b64": {"calls": 0, "rows": 0}})
+        assert occupancy_findings(_ladder(), store=store) == []
+
+    def test_vacuously_clean_without_store(self):
+        assert occupancy_findings(_ladder(), store=None) == []
+
+
+# ---------------------------------------------------------------------------
+# TX-P05 (classification drift) — positive and negative fixtures
+# ---------------------------------------------------------------------------
+
+class _FakePlan:
+    _device_steps = ()
+
+    def __init__(self, steps):
+        self._steps = steps
+
+    def compile(self):
+        return self
+
+
+class _FakeStep:
+    def __init__(self, stage, reason):
+        self.stage = stage
+        self.out_name = "out"
+        self.phase = "pre"
+        self.reason = reason
+
+
+class TestRuleP05ClassificationDrift:
+    def test_fires_on_stale_no_array_kernel_reason(self):
+        class GrewAKernel:
+            def supports_arrays(self):
+                return True
+        plan = _FakePlan([_FakeStep(
+            GrewAKernel(), "no array kernel (transform_arrays)")])
+        findings = verify_classification(plan)
+        assert _rules(findings) == ["TX-P05"]
+        assert findings[0].severity == "warning"
+        assert "stale" in findings[0].message
+
+    def test_silent_when_fallback_reason_still_true(self):
+        class StillNoKernel:
+            def supports_arrays(self):
+                return False
+        plan = _FakePlan([_FakeStep(
+            StillNoKernel(), "no array kernel (transform_arrays)")])
+        assert verify_classification(plan) == []
+
+    def test_fires_when_device_stage_cannot_lower(self, demo,
+                                                  monkeypatch):
+        plan = ScoringPlan(demo[0], min_bucket=8,
+                           max_bucket=8).compile()
+        stage = plan._device_steps[0][0]
+
+        def broken(arrays):
+            raise TypeError("kernel drifted")
+        monkeypatch.setattr(stage, "transform_arrays", broken,
+                            raising=False)
+        findings = verify_classification(plan)
+        assert "TX-P05" in _rules(findings)
+        assert "device" in findings[0].message
+
+    def test_silent_on_shipped_plan(self, demo):
+        plan = ScoringPlan(demo[0], min_bucket=8,
+                           max_bucket=8).compile()
+        assert verify_classification(plan) == []
+
+
+# ---------------------------------------------------------------------------
+# audit cache: exactly-N-miss contracts (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestAuditModelCache:
+    def test_exact_miss_then_hit(self, demo, tmp_path):
+        model, _prep, mdir = demo
+        cp = str(tmp_path / "audit.json")
+        r1 = audit_model(model, model_dir=mdir, min_bucket=8,
+                         max_bucket=16, cache_path=cp)
+        assert r1.stats == {"hits": 0, "misses": 1, "poisoned": 0}
+        r2 = audit_model(model, model_dir=mdir, min_bucket=8,
+                         max_bucket=16, cache_path=cp)
+        assert r2.stats == {"hits": 1, "misses": 0, "poisoned": 0}
+        assert [a.to_json() for a in r1.audits] == \
+            [a.to_json() for a in r2.audits]
+
+    def test_kernel_edit_invalidates_exactly_once(self, demo, tmp_path,
+                                                  monkeypatch):
+        model, _prep, mdir = demo
+        cp = str(tmp_path / "audit.json")
+        audit_model(model, model_dir=mdir, min_bucket=8, max_bucket=8,
+                    cache_path=cp)                      # seed
+        # a kernel-source edit changes the transitive hash -> the
+        # cached audit of every plan composing it is stale
+        import transmogrifai_tpu.analysis.audit as audit_mod
+        monkeypatch.setattr(audit_mod, "kernel_source_hash",
+                            lambda *a, **k: "edited-kernel-tree")
+        r_edit = audit_model(model, model_dir=mdir, min_bucket=8,
+                             max_bucket=8, cache_path=cp)
+        assert r_edit.stats["misses"] == 1 \
+            and r_edit.stats["hits"] == 0
+        # second run under the SAME edited tree: exactly 0 misses
+        r_warm = audit_model(model, model_dir=mdir, min_bucket=8,
+                             max_bucket=8, cache_path=cp)
+        assert r_warm.stats["misses"] == 0 \
+            and r_warm.stats["hits"] == 1
+
+    def test_tampered_cache_poisons_and_recovers(self, demo, tmp_path):
+        model, _prep, mdir = demo
+        cp = str(tmp_path / "audit.json")
+        audit_model(model, model_dir=mdir, min_bucket=8, max_bucket=8,
+                    cache_path=cp)
+        with open(cp, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        label = next(iter(doc["audits"]))
+        doc["audits"][label]["doc"]["audits"][0]["fusions"] = 999
+        with open(cp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        r = audit_model(model, model_dir=mdir, min_bucket=8,
+                        max_bucket=8, cache_path=cp)
+        assert r.stats["poisoned"] == 1 and r.stats["misses"] == 1
+        assert all(a.fusions != 999 for a in r.audits)
+
+
+class TestKernelSourceHash:
+    def _tree(self, root):
+        (root / "kern.py").write_text(
+            "from helper import aux\n\n\ndef kernel(x):\n"
+            "    return aux(x) + 1\n")
+        (root / "helper.py").write_text(
+            "def aux(x):\n    return x * 2\n")
+        (root / "other.py").write_text(
+            "def unrelated():\n    return 3\n")
+
+    def test_closure_tracks_transitive_kernel_edits(self, tmp_path):
+        self._tree(tmp_path)
+        lint_cache = str(tmp_path / "lint_cache.json")
+
+        def h():
+            return kernel_source_hash([str(tmp_path)], ["kern"],
+                                      lint_cache_path=lint_cache)
+        h1 = h()
+        # editing a transitively-called helper moves the hash ...
+        (tmp_path / "helper.py").write_text(
+            "def aux(x):\n    return x * 3\n")
+        h2 = h()
+        assert h2 != h1
+        # ... while an unrelated module is OUTSIDE the closure
+        (tmp_path / "other.py").write_text(
+            "def unrelated():\n    return 4\n")
+        assert h() == h2
+
+    def test_whole_tree_fallback_is_conservative(self, tmp_path):
+        self._tree(tmp_path)
+        lint_cache = str(tmp_path / "lint_cache.json")
+        # unknown stage modules resolve to no closure -> every file
+        # under the root counts, so the unrelated edit DOES move it
+        h1 = kernel_source_hash([str(tmp_path)], ["no_such_module"],
+                                lint_cache_path=lint_cache)
+        (tmp_path / "other.py").write_text(
+            "def unrelated():\n    return 5\n")
+        h2 = kernel_source_hash([str(tmp_path)], ["no_such_module"],
+                                lint_cache_path=lint_cache)
+        assert h2 != h1
+
+
+# ---------------------------------------------------------------------------
+# the save/load fingerprint sidecar (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestFingerprintSidecar:
+    def test_save_writes_sidecar(self, demo):
+        sidecar = os.path.join(demo[2], AUDIT_SIDECAR)
+        with open(sidecar, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert doc["fingerprint"].startswith("xla:")
+        assert doc["platform"] == jax.default_backend()
+        assert doc["jax"] == jax.__version__
+
+    def test_clean_load_verifies_without_drift(self, demo):
+        from transmogrifai_tpu.workflow.persistence import load_model
+        before = telemetry.counters().get("plan_fingerprint_drift", 0)
+        loaded = load_model(demo[2])
+        assert verify_plan_fingerprint(loaded, demo[2]) is True
+        assert telemetry.counters().get(
+            "plan_fingerprint_drift", 0) == before
+
+    def test_drift_bumps_counter_but_load_succeeds(self, demo,
+                                                   tmp_path):
+        from transmogrifai_tpu.workflow.persistence import load_model
+        tampered = str(tmp_path / "tampered-model")
+        shutil.copytree(demo[2], tampered)
+        sidecar = os.path.join(tampered, AUDIT_SIDECAR)
+        with open(sidecar, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        doc["fingerprint"] = "xla:cpu:jax-0.0.0:" + "0" * 32
+        with open(sidecar, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        before = telemetry.counters().get("plan_fingerprint_drift", 0)
+        mark = telemetry.events_mark()
+        loaded = load_model(tampered)           # drift is NOT an error
+        assert loaded is not None
+        assert telemetry.counters().get(
+            "plan_fingerprint_drift", 0) == before + 1
+        assert any(e.get("event") == "plan_fingerprint_drift"
+                   for e in telemetry.events_since(mark))
+
+    def test_env_kill_switch(self, demo, monkeypatch):
+        from transmogrifai_tpu.workflow.persistence import load_model
+        monkeypatch.setenv("TX_PLAN_FINGERPRINT", "off")
+        before = telemetry.counters().get("plan_fingerprint_drift", 0)
+        loaded = load_model(demo[2])
+        assert verify_plan_fingerprint(loaded, demo[2]) is None
+        assert telemetry.counters().get(
+            "plan_fingerprint_drift", 0) == before
+
+    def test_missing_sidecar_is_silent(self, demo, tmp_path):
+        bare = str(tmp_path / "bare-model")
+        shutil.copytree(demo[2], bare)
+        os.remove(os.path.join(bare, AUDIT_SIDECAR))
+        from transmogrifai_tpu.workflow.persistence import load_model
+        before = telemetry.counters().get("plan_fingerprint_drift", 0)
+        loaded = load_model(bare)
+        assert verify_plan_fingerprint(loaded, bare) is None
+        assert telemetry.counters().get(
+            "plan_fingerprint_drift", 0) == before
+
+
+# ---------------------------------------------------------------------------
+# PreparePlan audit handles + IR-feature persistence
+# ---------------------------------------------------------------------------
+
+class TestPrepareAudit:
+    def test_segments_are_capturable(self, demo):
+        prep = demo[1]
+        assert prep is not None and prep.audit_handles
+        handle = prep.audit_handles[0]
+        assert handle["label"] == "seg0"
+        assert handle["buckets"] == sorted(handle["buckets"])
+        assert handle["stages"] and handle["stage_modules"]
+
+    def test_prepare_audits_are_stable(self, demo):
+        a1 = audit_prepare_plan(demo[1], compiled=False)
+        a2 = audit_prepare_plan(demo[1], compiled=False)
+        assert a1 and [a.to_json() for a in a1] == \
+            [a.to_json() for a in a2]
+        assert all(a.plan == "prepare" and
+                   re.fullmatch(r"seg\d+:b\d+", a.label) for a in a1)
+
+    def test_ir_features_land_in_profiles(self, demo, tmp_path):
+        plan = ScoringPlan(demo[0], min_bucket=8,
+                           max_bucket=16).compile()
+        audit_scoring_plan(plan, compiled=False)
+        from transmogrifai_tpu.analysis.audit import process_ir_features
+        feats = process_ir_features()
+        assert {"score:b8", "score:b16"} <= set(feats)
+        store = ProfileStore(str(tmp_path / "ir_store.json"))
+        store.record_profiles({"score:b8": {"calls": 2, "rows": 9}})
+        store.record_ir_features(feats)
+        rec = store.profiles()["score:b8"]
+        assert rec["calls"] == 2                # accumulators intact
+        assert rec["ir"]["fingerprint"].startswith("xla:")
+        assert rec["ir"]["ops"] > 0
+        # overwrite (not accumulate) semantics for the IR block
+        store.record_ir_features({"score:b8": {"ops": 1,
+                                               "fingerprint": "x"}})
+        assert store.profiles()["score:b8"]["ir"] == \
+            {"ops": 1, "fingerprint": "x"}
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract
+# ---------------------------------------------------------------------------
+
+def _audit_args(*argv):
+    import argparse
+    from transmogrifai_tpu.cli.audit import add_audit_parser
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers()
+    add_audit_parser(sub)
+    return parser.parse_args(["audit", *argv])
+
+
+class TestAuditCli:
+    def test_no_target_is_internal_error(self, capsys):
+        from transmogrifai_tpu.cli.audit import run_audit
+        assert run_audit(_audit_args()) == 2
+        assert "MODEL_DIR" in capsys.readouterr().err
+
+    def test_clean_model_dir_exits_zero(self, demo, tmp_path, capsys):
+        from transmogrifai_tpu.cli.audit import run_audit
+        rc = run_audit(_audit_args(
+            demo[2], "--no-compile", "--no-persist",
+            "--cache", str(tmp_path / "cli_cache.json")))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "clean:" in out and "score:b8" in out
+
+    def test_json_document_shape(self, demo, tmp_path, capsys):
+        from transmogrifai_tpu.cli.audit import run_audit
+        rc = run_audit(_audit_args(
+            demo[2], "--no-compile", "--no-persist", "--format",
+            "json", "--cache", str(tmp_path / "cli_cache.json")))
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["summary"]["programs"] == len(doc["audits"]) > 0
+        assert doc["summary"]["findings"] == 0
+        assert all(a["fingerprint"].startswith("xla:")
+                   for a in doc["audits"])
+
+    def test_fingerprint_flag(self, demo, capsys):
+        from transmogrifai_tpu.cli.audit import run_audit
+        assert run_audit(_audit_args(demo[2], "--fingerprint")) == 0
+        assert capsys.readouterr().out.startswith("xla:")
+
+    def test_occupancy_finding_exits_one(self, demo, tmp_path, capsys):
+        from transmogrifai_tpu.cli.audit import run_audit
+        store_path = str(tmp_path / "cli_store.json")
+        ProfileStore(store_path).record_profiles(
+            {"score:b3": {"calls": 5, "rows": 9}})
+        rc = run_audit(_audit_args(
+            demo[2], "--no-compile", "--no-persist",
+            "--store", store_path,
+            "--cache", str(tmp_path / "cli_cache.json")))
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "TX-P03" in out
+
+    def test_tune_override_moves_the_waste_ceiling(self, demo,
+                                                   tmp_path, capsys):
+        """A persisted ``tx tune --set audit.waste_ceiling=...``
+        override is the CLI's default ceiling when --waste-ceiling
+        is not given."""
+        from transmogrifai_tpu.cli.audit import run_audit
+        store_path = str(tmp_path / "cli_store.json")
+        store = ProfileStore(store_path)
+        # 64 padded rows per real row: far above the 16x default
+        store.record_profiles(
+            {"score:b64": {"calls": 100, "rows": 100}})
+        base = _audit_args(
+            demo[2], "--no-compile", "--no-persist",
+            "--store", store_path,
+            "--cache", str(tmp_path / "cli_cache.json"))
+        assert run_audit(base) == 1
+        assert "TX-P04" in capsys.readouterr().out
+        store.set_tuning_override("audit.waste_ceiling", 1000.0)
+        assert run_audit(base) == 0
+        assert "TX-P04" not in capsys.readouterr().out
+
+    def test_bad_model_dir_is_internal_error(self, tmp_path):
+        from transmogrifai_tpu.cli.audit import run_audit
+        assert run_audit(_audit_args(
+            str(tmp_path / "nope"), "--no-compile")) == 2

@@ -1,0 +1,28 @@
+"""Static analysis of COMPILED plans — the HLO-level counterpart of
+the AST-level ``lint`` package.
+
+``tx lint`` judges the Python a developer wrote; this package judges
+the StableHLO/HLO programs XLA will actually run: every plan bucket
+program is AOT-lowered (``jax.jit(...).lower()`` — no execution, no
+device) and audited for op/fusion/byte features, host transfers,
+precision widening and padding waste, plus the canonical IR
+fingerprint that keys saved-model artifact identity. See
+docs/plan_audit.md.
+"""
+from .audit import (AuditResult, PlanAudit, audit_demo, audit_model,
+                    audit_prepare_plan, audit_scoring_plan,
+                    plan_fingerprint, process_ir_features)
+from .cache import AuditCache, kernel_source_hash, model_content_hash
+from .hlo import ModuleStats, canonical_fingerprint, normalize_module, \
+    parse_module
+from .rules import audit_findings, lint_audits, occupancy_findings, \
+    verify_classification
+
+__all__ = [
+    "AuditCache", "AuditResult", "ModuleStats", "PlanAudit",
+    "audit_demo", "audit_findings", "audit_model",
+    "audit_prepare_plan", "audit_scoring_plan", "canonical_fingerprint",
+    "kernel_source_hash", "lint_audits", "model_content_hash",
+    "normalize_module", "occupancy_findings", "parse_module",
+    "plan_fingerprint", "process_ir_features", "verify_classification",
+]

@@ -1,0 +1,236 @@
+"""Incremental audit cache + transitive kernel-source hashing.
+
+Audits are pure functions of (model content, the transitive kernel
+sources the plan composes, jax version, platform) — so they cache
+exactly like file lints (lint/engine.LintCache): content-hash keyed,
+checksummed per entry, poisoned whole on tamper, schema-bumped on
+format change. A warm ``tx audit`` run re-lowers NOTHING.
+
+The kernel-source half reuses the lint layer wholesale: file summaries
+come through :class:`~..lint.engine.LintCache` (already warm after any
+lint run) and the transitive closure walks
+:mod:`~..lint.callgraph` call edges from the plan's stage modules —
+editing a kernel in ``ops/`` (or any helper it calls) changes the hash
+and invalidates the cached audit of every plan that uses it, while an
+edit to an unrelated module invalidates nothing.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = ["AuditCache", "kernel_source_hash", "default_cache_path",
+           "model_content_hash"]
+
+#: the package root — the default kernel-source search tree
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_cache_path() -> str:
+    """Stable audit-cache location under the system tempdir
+    (``TX_AUDIT_CACHE`` overrides; ``off``/``0`` disables)."""
+    env = os.environ.get("TX_AUDIT_CACHE")
+    if env:
+        return env
+    h = hashlib.sha1(_PKG_ROOT.encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"txaudit-{h}.json")
+
+
+def resolve_cache_path(cache_path: Optional[str]) -> Optional[str]:
+    if cache_path is not None:
+        return cache_path or None
+    env = os.environ.get("TX_AUDIT_CACHE")
+    if env in ("off", "0"):
+        return None
+    return default_cache_path()
+
+
+def _entry_checksum(entry: dict) -> str:
+    raw = json.dumps({k: entry[k] for k in ("key", "doc")},
+                     sort_keys=True)
+    return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+class AuditCache:
+    """On-disk audit cache: label -> (content key, audit document).
+    Same integrity contract as the lint cache: schema bumps are
+    routine invalidation, a checksum mismatch on ANY entry poisons
+    the whole document (discard + loud stderr + ``poisoned`` stat)."""
+
+    SCHEMA = 1
+
+    def __init__(self, path: Optional[str]):
+        self.path = path            # None = disabled
+        self.entries: Dict[str, dict] = {}
+        self.stats = {"hits": 0, "misses": 0, "poisoned": 0}
+
+    def load(self) -> None:
+        if not self.path or not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            self._poison("unreadable/corrupt JSON")
+            return
+        if not isinstance(doc, dict) or doc.get("schema") != self.SCHEMA:
+            return
+        entries = doc.get("audits")
+        if not isinstance(entries, dict):
+            self._poison("missing audit table")
+            return
+        for label, entry in entries.items():
+            if (not isinstance(entry, dict)
+                    or entry.get("sum") != _entry_checksum(entry)):
+                self._poison(f"checksum mismatch for {label}")
+                return
+        self.entries = entries
+
+    def _poison(self, why: str) -> None:
+        self.entries = {}
+        self.stats["poisoned"] += 1
+        print(f"tx-audit: WARNING: cache poisoned ({why}) — "
+              f"discarding {self.path} and re-lowering everything",
+              file=sys.stderr)
+
+    def get(self, label: str, key: str) -> Optional[dict]:
+        entry = self.entries.get(label)
+        if entry is not None and entry.get("key") == key:
+            self.stats["hits"] += 1
+            return entry["doc"]
+        self.stats["misses"] += 1
+        return None
+
+    def put(self, label: str, key: str, doc: dict) -> None:
+        entry = {"key": key, "doc": doc}
+        entry["sum"] = _entry_checksum(entry)
+        self.entries[label] = entry
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        doc = {"schema": self.SCHEMA, "audits": self.entries}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self.path)
+        except OSError:  # pragma: no cover - read-only tempdir
+            pass
+
+
+# ---------------------------------------------------------------------------
+# transitive kernel-source hashing (reuses lint callgraph summaries)
+# ---------------------------------------------------------------------------
+
+def _file_hashes(roots: Sequence[str]) -> Dict[str, str]:
+    """relpath -> sha1(content) for every .py file under ``roots``."""
+    from ..lint.engine import iter_py_files
+    out: Dict[str, str] = {}
+    for f in iter_py_files(list(roots)):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(f, os.path.commonpath(
+            [os.path.abspath(r) for r in roots]) if roots else f)
+        out[rel] = hashlib.sha1(src.encode()).hexdigest()
+    return out
+
+
+def _closure_files(roots: Sequence[str], stage_modules: Iterable[str],
+                   cache_path: Optional[str] = None) -> List[str]:
+    """Source files transitively reachable (via call edges) from any
+    function defined in ``stage_modules`` — the kernel closure of a
+    plan. Module names match on suffix so both ``ops.numeric`` and
+    ``transmogrifai_tpu.ops.numeric`` spellings resolve."""
+    from ..lint.engine import build_project_graph
+    graph = build_project_graph(list(roots), cache_path=cache_path)
+    mods = {m.split(".")[-1]: m for m in stage_modules}
+    want = set()
+    for f in graph.functions.values():
+        fm = f.mod
+        for short, full in mods.items():
+            if fm == full or fm.endswith("." + short) or fm == short \
+                    or full.endswith("." + fm):
+                want.add(f.gid)
+    # BFS over outgoing call edges
+    seen = set(want)
+    frontier = list(want)
+    while frontier:
+        gid = frontier.pop()
+        for e in graph.edges_from(gid):
+            if e.dst not in seen and e.dst in graph.functions:
+                seen.add(e.dst)
+                frontier.append(e.dst)
+    return sorted({graph.functions[g].path for g in seen
+                   if g in graph.functions})
+
+
+#: memoized whole-package hash (the no-argument fast path save/load
+#: fingerprinting hits on EVERY save_model/load_model): the installed
+#: package's sources do not change mid-process, so hash once
+_DEFAULT_HASH: List[str] = []
+
+
+def kernel_source_hash(roots: Optional[Sequence[str]] = None,
+                       stage_modules: Optional[Iterable[str]] = None,
+                       lint_cache_path: Optional[str] = None) -> str:
+    """Content hash of the transitive kernel sources.
+
+    With ``stage_modules`` (the plan's stage classes' modules) the hash
+    covers exactly the call-graph closure of those modules — the files
+    whose edits can change the lowered program. Without it (or when the
+    closure resolves to nothing, e.g. stages defined in a test body)
+    the hash conservatively covers every file under ``roots``."""
+    default_call = roots is None and not stage_modules
+    if default_call and _DEFAULT_HASH:
+        return _DEFAULT_HASH[0]
+    roots = list(roots) if roots else [_PKG_ROOT]
+    hashes = _file_hashes(roots)
+    files: Optional[List[str]] = None
+    if stage_modules:
+        try:
+            closure = _closure_files(roots, stage_modules,
+                                     cache_path=lint_cache_path)
+            if closure:
+                rels = set()
+                common = os.path.commonpath(
+                    [os.path.abspath(r) for r in roots])
+                for p in closure:
+                    rels.add(os.path.relpath(os.path.abspath(p), common))
+                files = sorted(r for r in rels if r in hashes)
+        except Exception:       # closure is an optimization, not truth
+            files = None
+    if not files:
+        files = sorted(hashes)
+    h = hashlib.sha1()
+    for rel in files:
+        h.update(rel.encode())
+        h.update(hashes[rel].encode())
+    digest = h.hexdigest()
+    if default_call:
+        _DEFAULT_HASH[:] = [digest]
+    return digest
+
+
+def model_content_hash(model_dir: str) -> str:
+    """sha1 over the model's identity files (``op-model.json`` +
+    ``arrays.npz``) — sidecars (drift fingerprints, the audit
+    fingerprint itself) deliberately excluded so writing them does not
+    move the key."""
+    h = hashlib.sha1()
+    for name in ("op-model.json", "arrays.npz"):
+        p = os.path.join(model_dir, name)
+        try:
+            with open(p, "rb") as fh:
+                h.update(name.encode())
+                h.update(fh.read())
+        except OSError:
+            h.update(f"{name}:absent".encode())
+    return h.hexdigest()

@@ -1,0 +1,207 @@
+"""Lowered-module text analysis: the StableHLO walker behind the plan
+auditor (docs/plan_audit.md).
+
+``jax.jit(fn).lower(*avals)`` emits a StableHLO module as TEXT — a
+stable, line-oriented MLIR dialect — without executing anything and
+without a device ("A Learned Performance Model for TPUs" uses exactly
+these module-level features as its cost-model inputs). This module
+parses that text into :class:`ModuleStats` (op-kind histogram, dtype
+census, parameter/constant/output byte sizes, host-transfer and
+dynamic-shape inventories) and computes the **canonical IR
+fingerprint**: a content hash of the normalized module keyed by jax
+version + platform — the artifact-identity key the ROADMAP AOT item
+needs, replacing the positional pickle fingerprint of
+``plans/prepare._state_fingerprint`` for identity purposes.
+
+Normalization strips only NON-SEMANTIC noise (location metadata and
+the pointer-valued ``backend_config`` blobs host callbacks embed), so
+two lowerings of the same program in the same environment hash
+bitwise-identically, and ANY kernel-source change that alters the
+emitted program changes the key.
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+__all__ = ["ModuleStats", "parse_module", "normalize_module",
+           "canonical_fingerprint", "DTYPE_BYTES"]
+
+#: element byte widths of the dtypes jax lowers to (i1 rounds up to a
+#: byte — XLA packs predicates per-byte on every real backend)
+DTYPE_BYTES: Dict[str, int] = {
+    "i1": 1, "i2": 1, "i4": 1, "i8": 1, "ui8": 1,
+    "i16": 2, "ui16": 2, "bf16": 2, "f16": 2,
+    "i32": 4, "ui32": 4, "f32": 4,
+    "i64": 8, "ui64": 8, "f64": 8, "c64": 8,
+    "c128": 16, "index": 8,
+    "f8E4M3FN": 1, "f8E5M2": 1, "f8E4M3B11FNUZ": 1,
+}
+
+_TENSOR_RE = re.compile(r"tensor<([^<>]*)>")
+_OP_RE = re.compile(r"^(?:%[\w.#:]+(?:,\s*%[\w.#:]+)*\s*=\s*)?"
+                    r"([a-z_]+\.[a-z0-9_]+)\b")
+_ARG_RE = re.compile(r"%arg\d+: tensor<([^<>]*)>")
+_TARGET_RE = re.compile(r"custom_call\s+@([\w.$-]+)"
+                        r"|call_target_name\s*=\s*\"([^\"]+)\"")
+_LOC_RE = re.compile(r"\s*loc\([^()]*\)")
+_BACKEND_CFG_RE = re.compile(r"backend_config\s*=\s*\"[0-9]+\"")
+_MODULE_NAME_RE = re.compile(r"^module @\S+")
+
+#: custom_call targets that ARE host transfers (python callbacks,
+#: host send/recv shims) — a plain custom_call (e.g. a sharding
+#: annotation or an XLA library kernel) is device-side and stays out
+_HOST_TARGET_RE = re.compile(r"callback|host|py_func|infeed|outfeed",
+                             re.IGNORECASE)
+#: op names that move data across the host boundary by definition
+_HOST_OPS = ("stablehlo.infeed", "stablehlo.outfeed",
+             "stablehlo.send", "stablehlo.recv")
+#: shape-dynamic stablehlo ops (result extent depends on runtime
+#: values); dynamic_slice/dynamic_update_slice are static-SHAPE and
+#: deliberately excluded
+_DYNAMIC_OPS = ("stablehlo.dynamic_reshape", "stablehlo.dynamic_pad",
+                "stablehlo.dynamic_broadcast_in_dim",
+                "stablehlo.dynamic_iota", "stablehlo.dynamic_gather",
+                "stablehlo.real_dynamic_slice",
+                "stablehlo.dynamic_conv")
+
+
+@dataclass
+class ModuleStats:
+    """Everything the auditor reads out of one lowered module."""
+    op_histogram: Dict[str, int] = field(default_factory=dict)
+    dtype_census: Dict[str, int] = field(default_factory=dict)
+    parameter_bytes: int = 0
+    constant_bytes: int = 0
+    output_bytes: int = 0
+    host_transfer_ops: List[str] = field(default_factory=list)
+    dynamic_shape_ops: List[str] = field(default_factory=list)
+    #: max float / int element width (bits) seen among PARAMETERS vs
+    #: anywhere in the body — the TX-P02 widening comparison inputs
+    param_widths: Dict[str, int] = field(default_factory=dict)
+    body_widths: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def n_ops(self) -> int:
+        return sum(self.op_histogram.values())
+
+
+def _tensor_bytes(spec: str) -> Tuple[int, str, bool]:
+    """(byte size, dtype token, is_dynamic) for one ``tensor<...>``
+    spec like ``8x3xf64`` / ``f32`` / ``?x4xf32``."""
+    parts = spec.split("x")
+    dtype = parts[-1]
+    dynamic = False
+    n = 1
+    for d in parts[:-1]:
+        if d == "?":
+            dynamic = True
+            continue
+        try:
+            n *= int(d)
+        except ValueError:
+            return 0, dtype, dynamic
+    return n * DTYPE_BYTES.get(dtype, 4), dtype, dynamic
+
+
+def _width_class(dtype: str) -> Tuple[str, int]:
+    """("float"|"int"|"", bits) for the TX-P02 widening comparison."""
+    m = re.match(r"^(bf|f|c)(\d+)", dtype)
+    if m:
+        return "float", int(m.group(2))
+    m = re.match(r"^(ui|i)(\d+)$", dtype)
+    if m and dtype != "i1":     # predicates are not arithmetic values
+        return "int", int(m.group(2))
+    return "", 0
+
+
+def _note_width(widths: Dict[str, int], dtype: str) -> None:
+    cls, bits = _width_class(dtype)
+    if cls:
+        widths[cls] = max(widths.get(cls, 0), bits)
+
+
+def parse_module(text: str) -> ModuleStats:
+    """Walk one StableHLO module's text into :class:`ModuleStats`."""
+    stats = ModuleStats()
+    in_main = False
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("func.func"):
+            # parameter/output bytes come from the PUBLIC entry only;
+            # private helper funcs would double-count
+            in_main = "public" in line
+            if in_main:
+                for m in _ARG_RE.finditer(line):
+                    b, dt, _ = _tensor_bytes(m.group(1))
+                    stats.parameter_bytes += b
+                    _note_width(stats.param_widths, dt)
+                arrow = line.rfind("->")
+                if arrow != -1:
+                    for m in _TENSOR_RE.finditer(line[arrow:]):
+                        b, _, _ = _tensor_bytes(m.group(1))
+                        stats.output_bytes += b
+            continue
+        m = _OP_RE.match(line)
+        if m is None:
+            continue
+        op = m.group(1)
+        if op in ("func.return", "stablehlo.return"):
+            continue
+        stats.op_histogram[op] = stats.op_histogram.get(op, 0) + 1
+
+        # dtype census + widening signal: the op's RESULT type is the
+        # last tensor spec on the line
+        specs = _TENSOR_RE.findall(line)
+        if specs:
+            b, dtype, dynamic = _tensor_bytes(specs[-1])
+            stats.dtype_census[dtype] = \
+                stats.dtype_census.get(dtype, 0) + 1
+            _note_width(stats.body_widths, dtype)
+            if op == "stablehlo.constant":
+                stats.constant_bytes += b
+            if dynamic or any("?" in s for s in specs):
+                stats.dynamic_shape_ops.append(op)
+
+        if op in _DYNAMIC_OPS and op not in stats.dynamic_shape_ops:
+            stats.dynamic_shape_ops.append(op)
+        if op in _HOST_OPS:
+            stats.host_transfer_ops.append(op)
+        elif "custom_call" in op:
+            tm = _TARGET_RE.search(line)
+            target = (tm.group(1) or tm.group(2)) if tm else ""
+            if _HOST_TARGET_RE.search(target or ""):
+                stats.host_transfer_ops.append(f"{op}@{target}")
+    return stats
+
+
+def normalize_module(text: str) -> str:
+    """Canonical form for fingerprinting: location metadata, pointer-
+    valued backend configs and the module's display name are noise;
+    everything else (ops, shapes, dtypes, constant DATA) is identity."""
+    out: List[str] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#loc"):
+            continue
+        prev = None
+        while prev != line:      # loc() can nest one level per pass
+            prev = line
+            line = _LOC_RE.sub("", line)
+        line = _BACKEND_CFG_RE.sub('backend_config = "<ptr>"', line)
+        line = _MODULE_NAME_RE.sub("module @m", line)
+        out.append(line)
+    return "\n".join(out)
+
+
+def canonical_fingerprint(text: str, jax_version: str,
+                          platform: str) -> str:
+    """The canonical artifact key: ``xla:<platform>:jax-<version>:
+    <sha256/32>`` over the normalized module. Same program + same
+    environment = same key, bitwise, across processes; ANY kernel
+    change that alters the emitted program changes it."""
+    digest = hashlib.sha256(
+        normalize_module(text).encode()).hexdigest()[:32]
+    return f"xla:{platform}:jax-{jax_version}:{digest}"

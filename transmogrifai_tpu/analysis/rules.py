@@ -1,0 +1,217 @@
+"""The TX-P rule family: lint findings over lowered plan IR.
+
+AST rules (lint/rules_jax.py) see the Python a developer wrote; these
+rules see the StableHLO program XLA will actually run. Both families
+emit the same :class:`~..lint.findings.LintFinding` records through the
+same catalog, severities and exit codes — ``tx audit`` fails a CI gate
+exactly like ``tx lint`` does.
+
+- **TX-P01** host transfer in a lowered scoring program (IR ground
+  truth behind TX-J01/TX-X02).
+- **TX-P02** precision widening inside a kernel composition — the body
+  computes at a wider float/int width than any parameter carries
+  (invisible to AST rule TX-J04).
+- **TX-P03** bucket-lattice coverage gap vs the ProfileStore's
+  recorded occupancy (a shape that forces an unplanned serve-time
+  compile).
+- **TX-P04** padding-waste bound: per-bucket ``padded_rows/real_rows``
+  against recorded occupancy, ERROR above the ``audit.waste_ceiling``
+  tuning knob.
+- **TX-P05** classification drift: ``lowering_reason``
+  (plans/common.py) disagrees with what actually lowers.
+
+TX-P01/P02/P05 are pure functions of the (cacheable) audits/plan;
+TX-P03/P04 read LIVE ProfileStore occupancy and are always evaluated
+fresh — recorded traffic must never be masked by an audit cache hit.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..lint.findings import LintFinding, rule_severity
+
+__all__ = ["lint_audits", "audit_findings", "verify_classification",
+           "occupancy_findings"]
+
+
+def _finding(rule_id: str, subject: str, message: str,
+             hint: Optional[str] = None) -> LintFinding:
+    return LintFinding(rule_id=rule_id, message=message,
+                       severity=rule_severity(rule_id),
+                       subject=subject, hint=hint)
+
+
+# ---------------------------------------------------------------------------
+# audit-only rules (TX-P01 / TX-P02)
+# ---------------------------------------------------------------------------
+
+def audit_findings(audits: Sequence) -> List[LintFinding]:
+    """TX-P01 + TX-P02 over a batch of :class:`PlanAudit` records —
+    deterministic functions of the lowered IR alone."""
+    out: List[LintFinding] = []
+    for a in audits:
+        subject = f"{a.plan}:{a.label}"
+        if a.plan == "score" and a.host_transfer_ops:
+            ops = ", ".join(sorted(set(a.host_transfer_ops)))
+            out.append(_finding(
+                "TX-P01", subject,
+                f"lowered scoring program for bucket {a.bucket} "
+                f"contains host-transfer op(s): {ops} — every dispatch "
+                f"of this bucket round-trips through the host",
+                hint="replace the callback/infeed with an array kernel "
+                     "(transform_arrays) or demote the stage to an "
+                     "explicit host fallback phase"))
+        for cls in ("float", "int"):
+            pw = a.param_widths.get(cls, 0)
+            bw = a.body_widths.get(cls, 0)
+            if pw and bw > pw:
+                out.append(_finding(
+                    "TX-P02", subject,
+                    f"program body computes at {cls}{bw} while no "
+                    f"parameter is wider than {cls}{pw} — a kernel "
+                    f"composition widens intermediates beyond the "
+                    f"input precision (bucket {a.bucket})",
+                    hint=f"pin the intermediate dtype (e.g. "
+                         f".astype(inputs' dtype)) inside the kernel, "
+                         f"or lower the constant that forces the "
+                         f"{cls}{bw} upcast"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# occupancy rules (TX-P03 / TX-P04) — live ProfileStore, never cached
+# ---------------------------------------------------------------------------
+
+def _recorded_score_buckets(store) -> Dict[int, dict]:
+    """bucket -> accumulated occupancy record, from the store's
+    normalized ``score:b<N>`` profile keys."""
+    out: Dict[int, dict] = {}
+    for key, rec in (store.profiles() or {}).items():
+        if not key.startswith("score:b"):
+            continue
+        try:
+            bucket = int(key[len("score:b"):])
+        except ValueError:
+            continue
+        out[bucket] = rec
+    return out
+
+
+def occupancy_findings(audits: Sequence, store=None,
+                       waste_ceiling: Optional[float] = None
+                       ) -> List[LintFinding]:
+    """TX-P03 + TX-P04: the plan's bucket ladder (from the score
+    audits) judged against the ProfileStore's RECORDED dispatch
+    occupancy. No store / no recorded traffic = vacuously clean."""
+    if waste_ceiling is None:
+        from ..tuning.registry import STATIC_DEFAULTS
+        waste_ceiling = float(STATIC_DEFAULTS["audit.waste_ceiling"])
+    ladder = sorted({a.bucket for a in audits if a.plan == "score"})
+    if store is None or not ladder:
+        return []
+    try:
+        recorded = _recorded_score_buckets(store)
+    except Exception:               # store unreadable: occupancy unknown
+        return []
+    out: List[LintFinding] = []
+    for bucket in sorted(recorded):
+        rec = recorded[bucket]
+        calls = int(rec.get("calls", 0) or 0)
+        rows = int(rec.get("rows", 0) or 0)
+        if bucket not in ladder:
+            out.append(_finding(
+                "TX-P03", f"score:b{bucket}",
+                f"recorded dispatch occupancy at bucket {bucket} "
+                f"({calls} calls) but this plan's ladder is "
+                f"{ladder} — that batch shape forces an unplanned "
+                f"XLA compile at serve time",
+                hint="widen the plan's [min_bucket, max_bucket] range "
+                     "(tuning knobs serving.min_bucket/max_bucket) to "
+                     "cover the recorded shape, or chunk the batch"))
+            continue
+        if calls <= 0 or rows <= 0:
+            continue                # occupancy unknown — no bound
+        waste = (calls * bucket) / rows
+        if waste > waste_ceiling:
+            out.append(_finding(
+                "TX-P04", f"score:b{bucket}",
+                f"padding waste {waste:.1f}x at bucket {bucket} "
+                f"({calls} calls x {bucket} padded rows / {rows} real "
+                f"rows) exceeds the waste ceiling "
+                f"{waste_ceiling:g}x — the device spends most of "
+                f"this bucket scoring padding",
+                hint="lower serving.min_bucket (or coalesce requests "
+                     "— serving/server.py deadline-or-full) so small "
+                     "batches stop paying for the full bucket; the "
+                     "ceiling is the audit.waste_ceiling tuning knob"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# classification drift (TX-P05) — needs the live plan
+# ---------------------------------------------------------------------------
+
+def verify_classification(plan) -> List[LintFinding]:
+    """Verify the plan's ``lowering_reason`` classification
+    (plans/common.py) against the IR that actually lowers:
+
+    - every "device" stage's kernel must still trace abstractly,
+      standalone, at the plan's input avals;
+    - every fallback recorded as "no array kernel (transform_arrays)"
+      must still LACK an array kernel — a stage that grew
+      ``transform_arrays`` since classification is silently
+      misclassified and scores on the slow host path.
+    """
+    import jax
+    out: List[LintFinding] = []
+    plan.compile()
+    if getattr(plan, "_device_steps", None):
+        avals, _mask = plan.device_input_avals(plan.min_bucket)
+        env = {key: aval for (key, _n, _e), aval
+               in zip(plan._host_inputs, avals)}
+        for stage, out_name, keys in plan._device_steps:
+            name = f"{type(stage).__name__}({out_name})"
+            try:
+                env[out_name] = jax.eval_shape(
+                    lambda *a, s=stage: s.transform_arrays(list(a)),
+                    *[env[k] for k in keys])
+            except Exception as e:
+                out.append(_finding(
+                    "TX-P05", f"score:{name}",
+                    f"stage {name} is classified 'device' but its "
+                    f"kernel fails the abstract trace at the plan's "
+                    f"input avals ({type(e).__name__}: {e})",
+                    hint="the classification and the kernel drifted "
+                         "apart; fix the kernel or let compile() "
+                         "demote it explicitly"))
+                break               # downstream avals are unknowable
+    for step in getattr(plan, "_steps", ()):
+        if step.phase == "device":
+            continue
+        if (step.reason.startswith("no array kernel")
+                and step.stage.supports_arrays()):
+            name = f"{type(step.stage).__name__}({step.out_name})"
+            out.append(_finding(
+                "TX-P05", f"score:{name}",
+                f"stage {name} was classified as a host fallback "
+                f"('{step.reason}') but the stage DOES expose "
+                f"transform_arrays now — it scores on the slow host "
+                f"path for a stale reason",
+                hint="recompile the plan (the classification is "
+                     "computed at compile(); a class edit after "
+                     "compile leaves it stale)"))
+    return out
+
+
+def lint_audits(audits: Sequence, store=None,
+                waste_ceiling: Optional[float] = None,
+                plan=None) -> List[LintFinding]:
+    """The full TX-P pass: IR rules over ``audits``, occupancy rules
+    against ``store``, and (when the live ``plan`` is given)
+    classification-drift verification."""
+    out = audit_findings(audits)
+    out.extend(occupancy_findings(audits, store=store,
+                                  waste_ceiling=waste_ceiling))
+    if plan is not None:
+        out.extend(verify_classification(plan))
+    return out

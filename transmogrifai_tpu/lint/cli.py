@@ -44,7 +44,9 @@ def add_lint_parser(sub) -> None:
     lint.add_argument("--graph", metavar="SYMBOL", default=None,
                       help="dump the call graph around SYMBOL "
                            "(substring match on the dotted qualname: "
-                           "node, coloring, outgoing edges) and exit")
+                           "node, coloring, outgoing edges) and exit; "
+                           "honors --format json (empty tags/calls "
+                           "lists are omitted, like finding chains)")
     lint.add_argument("--changed", action="store_true",
                       help="report only findings touching files "
                            "changed vs git HEAD (+ untracked); the "
@@ -79,14 +81,13 @@ def _git_changed_files() -> list:
     return sorted(set(out))
 
 
-def _dump_graph(paths, symbol: str, cache_path) -> int:
+def _graph_nodes(paths, symbol: str, cache_path):
+    """(graph, matched nodes with tags/edges resolved) for --graph."""
     from .engine import build_project_graph
     g = build_project_graph(paths, cache_path=cache_path)
     hits = g.lookup(symbol)
-    if not hits:
-        print(f"no symbol matching {symbol!r}")
-        return 1
     loop_ctx, thread_ctx = g.contexts()
+    out = []
     for f in hits:
         tags = []
         if f.is_async:
@@ -97,16 +98,52 @@ def _dump_graph(paths, symbol: str, cache_path) -> int:
             tags.append("event-loop")
         if f.gid in thread_ctx:
             tags.append("executor-thread")
-        print(f"{f.mod}.{f.qual}  ({f.path}:{f.line})"
-              f"{'  [' + ', '.join(tags) + ']' if tags else ''}")
+        edges = []
         for e in g.edges_from(f.gid):
             dst = g.functions.get(e.dst)
             if dst is None:  # pragma: no cover - dangling edge
                 continue
             kind = {"call": "calls", "thread": "submits-to-thread",
                     "loop": "schedules-on-loop"}[e.kind]
+            edges.append((kind, dst, e.line))
+        out.append((f, tags, edges))
+    return out
+
+
+def _dump_graph(paths, symbol: str, cache_path,
+                fmt: str = "text") -> int:
+    import json
+    nodes = _graph_nodes(paths, symbol, cache_path)
+    if not nodes:
+        if fmt == "json":
+            print(json.dumps({"symbol": symbol, "nodes": []}))
+        else:
+            print(f"no symbol matching {symbol!r}")
+        return 1
+    if fmt == "json":
+        docs = []
+        for f, tags, edges in nodes:
+            # wire-format convention (matches LintFinding.to_json's
+            # chain handling): empty collections are OMITTED, never
+            # serialized as [] — leaf nodes carry no "calls" key, an
+            # untagged node no "tags" key
+            doc = {"name": f"{f.mod}.{f.qual}", "path": f.path,
+                   "line": f.line}
+            if tags:
+                doc["tags"] = tags
+            calls = [{"kind": kind, "target": f"{d.mod}.{d.qual}",
+                      "line": line} for kind, d, line in edges]
+            if calls:
+                doc["calls"] = calls
+            docs.append(doc)
+        print(json.dumps({"symbol": symbol, "nodes": docs}, indent=1))
+        return 0
+    for f, tags, edges in nodes:
+        print(f"{f.mod}.{f.qual}  ({f.path}:{f.line})"
+              f"{'  [' + ', '.join(tags) + ']' if tags else ''}")
+        for kind, dst, line in edges:
             print(f"    {kind:18s} {dst.mod}.{dst.qual} "
-                  f"(line {e.line})")
+                  f"(line {line})")
     return 0
 
 
@@ -121,7 +158,8 @@ def run_lint(args) -> int:
         if cache_path == "off":
             cache_path = ""
         if args.graph:
-            return _dump_graph(paths, args.graph, cache_path)
+            return _dump_graph(paths, args.graph, cache_path,
+                               fmt=args.format)
         changed = _git_changed_files() if args.changed else None
         baseline_path = args.baseline
         if baseline_path is None and os.path.exists(DEFAULT_BASELINE_NAME):

@@ -136,6 +136,34 @@ RULES: Dict[str, tuple] = {
                       "and resolve through TuningPolicy) so `tx tune` "
                       "overrides and the cost model actually govern "
                       "the knob"),
+    # -- plan IR rules (lowered StableHLO/HLO — analysis/rules.py) ---------
+    "TX-P01": (ERROR, "host-transfer op (callback custom_call, infeed/"
+                      "outfeed, send/recv) in a lowered scoring "
+                      "program — the IR-level ground truth behind "
+                      "TX-J01/TX-X02: every dispatch of this bucket "
+                      "synchronizes with the host"),
+    "TX-P02": (WARNING, "precision widening inside the lowered program: "
+                        "the body computes at a wider float/int width "
+                        "than any parameter carries (a kernel "
+                        "composition upcast AST rule TX-J04 cannot "
+                        "see) — memory + flops doubled for data the "
+                        "inputs never had"),
+    "TX-P03": (WARNING, "bucket-lattice coverage gap: recorded dispatch "
+                        "occupancy at a bucket outside this plan's "
+                        "ladder — that batch shape forces an unplanned "
+                        "XLA compile at serve time"),
+    "TX-P04": (ERROR, "padding-waste bound exceeded: per-bucket "
+                      "padded_rows/real_rows against the ProfileStore "
+                      "occupancy histogram is above the configured "
+                      "waste ceiling (tuning knob audit.waste_ceiling) "
+                      "— the bucket ladder burns device time scoring "
+                      "padding"),
+    "TX-P05": (WARNING, "stage classification drift: the plan's "
+                        "lowering_reason classification disagrees with "
+                        "the actual lowered IR (a 'device' stage whose "
+                        "kernel no longer traces, or a 'no array "
+                        "kernel' fallback whose stage now exposes "
+                        "transform_arrays)"),
     # -- infrastructure ----------------------------------------------------
     "TX-E00": (ERROR, "source file does not parse"),
 }

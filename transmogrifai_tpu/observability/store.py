@@ -236,6 +236,31 @@ class ProfileStore:
         except Exception:  # pragma: no cover - telemetry optional
             pass
 
+    def record_ir_features(self, features: Dict[str, dict]) -> bool:
+        """Attach the plan auditor's per-bucket lowered-IR features
+        (op count, fusion count, byte sizes, canonical fingerprint —
+        analysis/audit.py) under each profile record's ``ir`` field.
+        OVERWRITE semantics, unlike the accumulating cost fields: the
+        IR of a (plan, bucket) program is a fact about the current
+        build, not a running total — re-auditing replaces it. Keys
+        match the cost records (``score:b8``, ``prepare:seg0:b512``)
+        so cost-model-v2 reads features and targets off one row."""
+        if not features:
+            return True
+        with _merge_lock(self.path):
+            state = self.load()
+            profiles = state.setdefault("profiles", {})
+            now = time.time()
+            for key, doc in features.items():
+                if key.startswith("_"):     # reserved namespace
+                    continue
+                cur = profiles.setdefault(key, {})
+                cur["ir"] = dict(doc)
+                cur["updated"] = now
+            profiles["_schema"] = PROFILES_SCHEMA
+            self._compact(profiles, now)
+            return self._write(state)
+
     def profiles(self, prefix: str = "") -> Dict[str, dict]:
         """Real (non-reserved) profile records; ``_schema`` and
         ``_compacted`` are internal — read them via :meth:`meta`."""
@@ -355,5 +380,15 @@ def persist_process_profiles(path: Optional[str] = None
     bench modes call this after measuring, and a traced ``tx serve``
     session calls it at shutdown. Returns what was merged."""
     records = gather_process_profiles()
-    ProfileStore(path).record_profiles(records)
+    store = ProfileStore(path)
+    store.record_profiles(records)
+    try:
+        # plan-auditor IR features (analysis/audit.py): any audit run
+        # in this process leaves per-bucket op/fusion/bytes features —
+        # merge them onto the same rows so cost-model-v2 has training
+        # features next to the recorded costs from day one
+        from ..analysis.audit import process_ir_features
+        store.record_ir_features(process_ir_features())
+    except Exception:  # pragma: no cover - analysis layer optional
+        pass
     return records

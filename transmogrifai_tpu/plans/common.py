@@ -211,7 +211,14 @@ def lowering_reason(stage: Transformer, input_names: Sequence[str],
     kernel AND every input is either produced on device already or
     host-materialized and encodable; an input produced by a host
     fallback DOWNSTREAM of the device graph ("post") blocks lowering
-    for single-program plans (the device program runs once)."""
+    for single-program plans (the device program runs once).
+
+    This classification is a PREDICTION about what will lower; the
+    plan auditor verifies it against the actually-lowered IR and
+    emits a TX-P05 WARNING on disagreement (analysis/rules.py
+    ``verify_classification`` — e.g. a stage that grew
+    ``transform_arrays`` after being classified host, or a 'device'
+    kernel that no longer traces)."""
     if demoted and stage.uid in demoted:
         return demoted[stage.uid]
     if not stage.supports_arrays():

@@ -89,6 +89,21 @@ def prepare_compiles() -> int:
     return compiles("prepare")
 
 
+#: the most recent PreparePlan executed in this process — the plan
+#: auditor's handle to the fused segment programs a train() just built
+#: (workflow.train constructs the plan internally; audit_prepare_plan
+#: re-lowers its segments from the recorded audit handles)
+_LAST_PLAN: Optional["PreparePlan"] = None
+
+
+def last_prepare_plan() -> Optional["PreparePlan"]:
+    """The most recently executed PreparePlan of this process (None
+    before any plan-mode train). Audit-only introspection — the plan's
+    ``audit_handles`` carry each fused segment's jitted fn, input
+    avals, dispatched buckets and stage roster (analysis/audit.py)."""
+    return _LAST_PLAN
+
+
 # ---------------------------------------------------------------------------
 # cross-train segment cache
 # ---------------------------------------------------------------------------
@@ -185,6 +200,12 @@ class PreparePlan:
         self.segments_run = 0
         self._plan_id = plan_seq()
         self._retry = RetryPolicy.from_env()
+        #: one record per executed segment — the auditor's re-lowering
+        #: handles: {label, fn (jitted), in_avals [(trailing shape,
+        #: dtype)], buckets dispatched, stages}. Holding the jitted fn
+        #: keeps re-lowering exact (same traced program) and costs
+        #: nothing: the fn is alive in _SEGMENT_CACHE anyway.
+        self.audit_handles: List[Dict[str, Any]] = []
 
     # -- public ------------------------------------------------------------
     def execute(self, ds: Dataset,
@@ -196,6 +217,8 @@ class PreparePlan:
         are jax-backed, host fallbacks numpy — and the fitted models by
         estimator uid). ``prefitted`` supplies models already fitted on
         THIS dataset (the workflow-CV pre-pass)."""
+        global _LAST_PLAN
+        _LAST_PLAN = self
         compile_time.install()
         import jax  # noqa: F401  (device path; deferred like the plans)
         stages = [s for layer in topo_layers(list(self.result_features))
@@ -473,11 +496,14 @@ class PreparePlan:
                 fn, trace_seconds = cached
 
             chunks: List[List[Any]] = [[] for _ in steps]
+            seg_buckets: List[int] = []
             for start in range(0, max(n, 1), self.max_bucket):
                 stop = min(start + self.max_bucket, n)
                 rows = stop - start
                 bucket = bucket_for(rows, self.min_bucket,
                                     self.max_bucket)
+                if bucket not in seg_buckets:
+                    seg_buckets.append(bucket)
                 inputs = tuple(pad_rows(arr[start:stop], bucket)
                                for _, arr in sources)
                 mask = np.zeros(bucket, dtype=np.float64)
@@ -493,6 +519,20 @@ class PreparePlan:
         wall = time.perf_counter() - t0
         cdelta = compile_time.compile_seconds() - c0
         self.device_transform_seconds += wall
+        # audit handle: enough to RE-LOWER this exact segment program
+        # per dispatched bucket without re-executing anything
+        # (analysis/audit.audit_prepare_plan). Shapes/dtypes read off
+        # the source arrays' metadata — no materialization.
+        self.audit_handles.append({
+            "label": f"seg{seg_idx}",
+            "fn": fn,
+            "in_avals": [(tuple(arr.shape[1:]), arr.dtype)
+                         for _, arr in sources],
+            "buckets": sorted(seg_buckets),
+            "stages": [type(s.stage).__name__ for s in steps],
+            "stage_modules": sorted({type(s.stage).__module__
+                                     for s in steps}),
+        })
 
         import jax.numpy as jnp
         for step, outs in zip(steps, chunks):

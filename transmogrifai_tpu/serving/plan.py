@@ -742,6 +742,35 @@ class ScoringPlan:
         out.append(self.max_bucket)
         return out
 
+    def device_input_avals(self, bucket: int):
+        """The abstract inputs of one bucket's device program:
+        ``(tuple of ShapeDtypeStruct, mask aval)`` — exactly the shapes
+        ``dispatch_encoded`` feeds it (encoders probed on the zero-row
+        proto columns, mask is the f64 validity vector)."""
+        self.compile()
+        import jax
+        sds = []
+        for key, name, enc in self._host_inputs:
+            arr = np.asarray(enc(self._proto_cols[name]))
+            sds.append(jax.ShapeDtypeStruct(
+                (int(bucket),) + arr.shape[1:], arr.dtype))
+        mask = jax.ShapeDtypeStruct((int(bucket),), np.float64)
+        return tuple(sds), mask
+
+    def lower_bucket(self, bucket: int):
+        """AOT-lower ONE bucket's fused scoring program — no execution,
+        no device buffers, works under ``JAX_PLATFORMS=cpu``. This is
+        the plan auditor's entry point (analysis/audit.py): the
+        returned ``jax.stages.Lowered`` exposes the StableHLO text the
+        TX-P rules and the canonical IR fingerprint are computed from."""
+        self.compile()
+        if not self._device_steps:
+            raise PlanCompileError(
+                "plan has no device program (every stage fell back to "
+                "host numpy); nothing to lower")
+        inputs, mask = self.device_input_avals(bucket)
+        return self._device_fn.lower(inputs, mask)
+
 
 def _poison_first_valid_row(scored: Dataset, result_names, qmask
                             ) -> Dataset:

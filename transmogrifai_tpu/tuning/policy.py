@@ -421,6 +421,21 @@ class TuningPolicy:
             f"decide_fit is data-driven instead of optimistic-device")
         return seeds, decision
 
+    # -- audit -------------------------------------------------------------
+    def waste_ceiling(self) -> TuningDecision:
+        """TX-P04 padding-waste bound (override-only: the tolerable
+        padded-rows-per-real-row ratio is a policy choice, not
+        something the cost model can learn from timings)."""
+        name = "audit.waste_ceiling"
+        ov = self._override(name)
+        if ov is not None:
+            return TuningDecision(
+                name, float(ov), STATIC_DEFAULTS[name], None, None,
+                "recorded", SOURCE_OVERRIDE,
+                f"pinned by tx tune --set (store {self.path})")
+        return self._static(
+            name, "waste tolerance is a policy choice, not learnable")
+
     # -- the full decision table (tx tune, bench) --------------------------
     def decisions(self, max_wait_ms: float = 5.0,
                   max_batch: int = 256) -> List[TuningDecision]:
@@ -436,4 +451,5 @@ class TuningPolicy:
         out.extend(racing)
         out.append(self.placement_margin())
         out.append(self.placement_seed()[1])
+        out.append(self.waste_ceiling())
         return out

@@ -116,6 +116,17 @@ KNOBS: Tuple[Knob, ...] = (
                      "today's rule)",
          const_names=("DEFAULT_PLACEMENT_MARGIN",),
          param_names=("placement_margin",)),
+    Knob(name="audit.waste_ceiling", default=16.0,
+         consumer="analysis/rules.py TX-P04 padding-waste bound",
+         kind="float",
+         description="max tolerated padded_rows/real_rows ratio per "
+                     "bucket (vs the ProfileStore's recorded "
+                     "occupancy) before the plan auditor's TX-P04 "
+                     "escalates to ERROR — 16.0 tolerates the "
+                     "worst-case single-row-in-min-bucket shape while "
+                     "catching systematically mis-sized ladders",
+         const_names=("DEFAULT_WASTE_CEILING",),
+         param_names=("waste_ceiling",)),
 )
 
 #: knob name -> static default; THE values consumers import. An entry

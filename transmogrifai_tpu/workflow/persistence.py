@@ -325,6 +325,12 @@ def save_model(model, path: str) -> None:
     np.savez(os.path.join(tmp, ARRAYS_NPZ),
              **{k: v for k, v in arrays.items()})
     _fsync_file(os.path.join(tmp, ARRAYS_NPZ))
+    # canonical plan fingerprint sidecar (analysis/audit.py): the
+    # lowered scoring program's IR identity, recorded at save time and
+    # verified on load (plan_fingerprint_drift). Written AFTER the
+    # identity files so the content-keyed audit cache can key on them;
+    # best-effort inside the hook — it never breaks a save.
+    _record_plan_fingerprint(model, tmp)
     if os.path.isdir(path):
         # swap: rename can't replace a non-empty dir, so move the old
         # model aside first; it is removed only after the new one is in
@@ -342,6 +348,22 @@ def save_model(model, path: str) -> None:
     # the drift sentinel (serving/sentinel.py) resolves fingerprints
     # through the model dir
     model.model_dir = path
+
+
+def _record_plan_fingerprint(model, staging_dir: str) -> None:
+    """Satellite of the plan auditor (analysis/audit.py): compute the
+    canonical IR fingerprint of the model's scoring program and stage
+    it as ``plan-fingerprint.json``. Best-effort and env-gated
+    (``TX_PLAN_FINGERPRINT=off`` disables) — a model whose plan cannot
+    lower saves without a fingerprint, loudly, never fails."""
+    try:
+        from ..analysis.audit import record_plan_fingerprint
+        record_plan_fingerprint(model, staging_dir)
+    except Exception as e:   # never let the auditor break a save
+        import logging
+        logging.getLogger(__name__).warning(
+            "plan fingerprint not recorded (%s: %s); the saved model "
+            "carries no AOT artifact identity", type(e).__name__, e)
 
 
 def _save_drift_fingerprints(model, staging_dir: str) -> None:
@@ -456,4 +478,14 @@ def load_model(path: str):
     # remember where this model lives: the drift sentinel loads its
     # training fingerprints (drift-fingerprints.json) from here
     model.model_dir = path
+    # verify the save-time canonical plan fingerprint against THIS
+    # environment's lowering (analysis/audit.py): a mismatch means the
+    # compiled scoring program changed since save (kernel edit, jax
+    # upgrade, platform move) — counted as plan_fingerprint_drift
+    # telemetry + a loud warning, never an error
+    try:
+        from ..analysis.audit import verify_plan_fingerprint
+        verify_plan_fingerprint(model, path)
+    except Exception:  # the auditor never breaks a load
+        pass
     return model
