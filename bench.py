@@ -1359,6 +1359,162 @@ def _measure_restart() -> dict:
     }
 
 
+def _measure_restart_aot() -> dict:
+    """TX_BENCH_MODE=restart_aot: the zero-compile cold start arm
+    (docs/aot_artifacts.md) on the synthetic-Titanic model (CPU).
+    The SAME trained model is saved twice — once without an artifact
+    store (TX_AOT_EXPORT=off, the legacy layout) and once with it —
+    and three serve incarnations measure the client-visible
+    first-answer latency of: a COLD boot on the legacy dir (pays the
+    in-band bucket compile), a COLD boot on the artifact dir
+    (deserializes instead), and a WARM ``--resume-state`` boot (the
+    snapshot prewarm path, the PR-15 reference point). Alongside each:
+    the serve-process compile count (``plan_compiles``, target 0 on
+    the artifact arms) and the ``serve_aot_*`` counters. Headline
+    ``aot_cold_first_answer_ms`` with ``vs_baseline`` the
+    no-artifacts/with-artifacts cold ratio; acceptance wants
+    ``cold_within_2x_warm`` true."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import signal
+    import socket
+    import tempfile
+
+    from examples.titanic import build_features, synthetic_titanic, \
+        stratified_split
+    from transmogrifai_tpu.models import LogisticRegression
+    from transmogrifai_tpu.runtime.retry import RetryPolicy
+    from transmogrifai_tpu.serving import TcpServingClient
+    from transmogrifai_tpu.workflow import Workflow
+
+    records = synthetic_titanic(1309)
+    train, test = stratified_split(records)
+    survived, features = build_features()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        survived, features).get_output()
+    model = (Workflow().set_result_features(survived, pred)
+             .set_input_records(train).train(validate="off"))
+    work = tempfile.mkdtemp(prefix="tx_restart_aot_bench_")
+    plain_dir = os.path.join(work, "model-plain")
+    os.environ["TX_AOT_EXPORT"] = "off"
+    t0 = time.perf_counter()
+    model.save(plain_dir)
+    plain_save_s = time.perf_counter() - t0
+    art_dir = os.path.join(work, "model-aot")
+    os.environ["TX_AOT_EXPORT"] = "on"
+    t0 = time.perf_counter()
+    model.save(art_dir)
+    aot_save_s = time.perf_counter() - t0
+    state_dir = os.path.join(work, "state")
+    reqs = [dict(r) for r in test]
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    patient = RetryPolicy(max_attempts=120, base_delay=0.2,
+                          max_delay=0.5)
+
+    def wait_ready(timeout=180.0):
+        quick = RetryPolicy(max_attempts=2, base_delay=0.05,
+                            max_delay=0.1)
+        deadline = time.monotonic() + timeout
+        c = TcpServingClient("127.0.0.1", port, retry=quick,
+                             timeout=2.0)
+        while time.monotonic() < deadline:
+            try:
+                if c.request({"ready": True}).get("ready"):
+                    c.close()
+                    return
+            except Exception:
+                time.sleep(0.2)
+        raise RuntimeError("serving child never became ready")
+
+    def boot(model_dir, artifacts, extra=()):
+        """Spawn one incarnation, measure spawn-to-ready and the
+        first fresh-connection answer, snapshot its metrics."""
+        cmd = [sys.executable, "-m", "transmogrifai_tpu.cli", "serve",
+               "--model", f"titanic={model_dir}", "--host", "127.0.0.1",
+               "--port", str(port), "--max-wait-ms", "5",
+               "--snapshot-interval", "1", "--artifacts", artifacts,
+               *extra]
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=dict(os.environ,
+                                         JAX_PLATFORMS="cpu"))
+        wait_ready()
+        ready_s = time.perf_counter() - t0
+        with TcpServingClient("127.0.0.1", port, retry=patient,
+                              timeout=120.0) as c:
+            t0 = time.perf_counter()
+            out = c.score(dict(reqs[0]), model="titanic")
+            first_ms = (time.perf_counter() - t0) * 1000.0
+            snap = c.metrics()
+        if not out.get("ok"):
+            raise RuntimeError(f"first answer failed: {out}")
+        return proc, ready_s, first_ms, snap
+
+    def stop(proc):
+        proc.send_signal(signal.SIGTERM)
+        proc.communicate(timeout=180)
+
+    # arm 1: cold boot, legacy dir — the in-band compile stall
+    proc, plain_ready_s, cold_plain_ms, snap_plain = boot(
+        plain_dir, "off")
+    stop(proc)
+    # arm 2: cold boot, artifact dir — deserialize instead of compile
+    proc, aot_ready_s, cold_aot_ms, snap_aot = boot(
+        art_dir, "auto", extra=("--state-dir", state_dir))
+    with TcpServingClient("127.0.0.1", port, retry=patient,
+                          timeout=120.0) as c:
+        for r in reqs[:8]:   # record buckets into the state snapshot
+            c.score(dict(r), model="titanic")
+    time.sleep(2.5)          # let the snapshot interval fire
+    stop(proc)
+    # arm 3: warm resume — the PR-15 snapshot prewarm reference
+    proc, warm_ready_s, warm_ms, snap_warm = boot(
+        art_dir, "auto", extra=("--resume-state", state_dir))
+    stop(proc)
+
+    aot_counters = {k: v
+                    for k, v in (snap_aot.get("counters") or {}).items()
+                    if "aot" in k}
+    result = {
+        "metric": "aot_cold_first_answer_ms",
+        "value": round(cold_aot_ms, 2),
+        "unit": "ms",
+        # what the artifact store saves the FIRST caller on a cold
+        # replica: no-artifacts / with-artifacts first-answer ratio
+        "vs_baseline": round(cold_plain_ms / max(cold_aot_ms, 1e-6), 2),
+        "cold_no_artifacts_first_answer_ms": round(cold_plain_ms, 2),
+        "cold_with_artifacts_first_answer_ms": round(cold_aot_ms, 2),
+        "warm_snapshot_first_answer_ms": round(warm_ms, 2),
+        # serve-process compile counts at first answer (target 0 on
+        # the artifact arms — the whole point of the store)
+        "cold_no_artifacts_serve_compiles": int(
+            snap_plain["plan_compiles"]),
+        "cold_with_artifacts_serve_compiles": int(
+            snap_aot["plan_compiles"]),
+        "warm_snapshot_serve_compiles": int(
+            snap_warm["plan_compiles"]),
+        "cold_within_2x_warm": bool(cold_aot_ms
+                                    <= 2.0 * max(warm_ms, 1e-6)),
+        "aot_export_save_seconds": round(aot_save_s, 2),
+        "plain_save_seconds": round(plain_save_s, 2),
+        "ready_seconds": {"cold_no_artifacts": round(plain_ready_s, 2),
+                          "cold_with_artifacts": round(aot_ready_s, 2),
+                          "warm_snapshot": round(warm_ready_s, 2)},
+        "aot_counters": aot_counters,
+        "platform": "cpu",
+    }
+    try:
+        from transmogrifai_tpu.observability.store import ProfileStore
+        ProfileStore(_STATE_PATH).record_section("aot_restart", result)
+    except Exception:
+        pass                   # the headline JSON line still prints
+    return result
+
+
 def _measure_self_heal() -> dict:
     """TX_BENCH_MODE=self_heal: the drift-triggered self-healing loop
     (ISSUE 11, docs/self_healing.md) measured end to end on the
@@ -2348,6 +2504,8 @@ def _measure() -> dict:
         return _measure_self_heal()
     if os.environ.get("TX_BENCH_MODE") == "restart":
         return _measure_restart()
+    if os.environ.get("TX_BENCH_MODE") == "restart_aot":
+        return _measure_restart_aot()
     from transmogrifai_tpu.utils.jax_setup import (enable_compilation_cache,
                                                    pin_platform_from_env)
     pin_platform_from_env()
@@ -2530,8 +2688,8 @@ def _probe_ambient() -> tuple[bool, str, list]:
 def main() -> None:
     if os.environ.get("TX_BENCH_MODE") in ("sharded_search", "prepare",
                                            "serve_loop", "self_heal",
-                                           "restart", "autotune",
-                                           "overload"):
+                                           "restart", "restart_aot",
+                                           "autotune", "overload"):
         # these modes are DEFINED on the forced-CPU backend (the
         # sharded sweep on a virtual device pool, the prepare
         # comparison on the x64 CPU path, the serve-loop latency SLO
@@ -2607,6 +2765,8 @@ def _headline_metric() -> tuple:
         return "self_heal_seconds", "s"
     if os.environ.get("TX_BENCH_MODE") == "restart":
         return "restart_warm_first_answer_ms", "ms"
+    if os.environ.get("TX_BENCH_MODE") == "restart_aot":
+        return "aot_cold_first_answer_ms", "ms"
     return "titanic_holdout_aupr", "AuPR"
 
 

@@ -61,6 +61,28 @@ def _isolated_audit_cache(tmp_path, monkeypatch):
     yield
 
 
+@pytest.fixture(autouse=True)
+def _no_aot_export_by_default(monkeypatch):
+    """AOT artifact export off by default (artifacts/store.py): the
+    production default is ON, but every ``model.save`` in the suite
+    would otherwise AOT-compile the full 11-bucket ladder (~seconds
+    per save, and real mmap pressure — see _mmap_guard). Tests that
+    exercise the export/load path set TX_AOT_EXPORT=on themselves
+    (monkeypatch inside the test wins)."""
+    monkeypatch.setenv("TX_AOT_EXPORT", "off")
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _fresh_prepare_registry():
+    """The AOT prepare-segment registry (artifacts/loader.py) is
+    process-global; a seeded executable leaking across tests would
+    make an unrelated train dispatch through another test's program."""
+    yield
+    from transmogrifai_tpu.artifacts.loader import clear_prepare_registry
+    clear_prepare_registry()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

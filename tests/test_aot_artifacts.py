@@ -1,0 +1,508 @@
+"""AOT artifact store tests (artifacts/ + docs/aot_artifacts.md).
+
+The acceptance drills, in the ISSUE's words:
+
+- EXPORT AT SAVE: ``model.save`` writes a checksummed, env-keyed
+  artifact store into the model dir, riding the same atomic swap.
+- ZERO-COMPILE LOAD: ``load_or_compile`` attaches a deserialized
+  executable for every bucket; scoring through them records ZERO plan
+  compiles and produces scores BITWISE-identical to a live-compiled
+  plan.
+- LOUD FALLBACK, NEVER A CRASH: every mismatch class — missing store,
+  wrong jax version, wrong platform/machine, canonical fingerprint
+  drift, bucket-ladder drift, torn/tampered payload — falls back to
+  live compile with its own telemetry counter and identical scores.
+- REQUIRE MODE: ``TX_AOT_ARTIFACTS=require`` raises instead (the
+  fleet-replica contract).
+- PREPARE REUSE: the exported prepare-segment executables seed the
+  process registry keyed by segment signature digest.
+
+One small trained+saved model per module; mismatch drills mutate
+per-test COPIES of its store.
+"""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.artifacts import store as art_store
+from transmogrifai_tpu.artifacts.loader import (ArtifactsRequired,
+                                                clear_prepare_registry,
+                                                load_or_compile,
+                                                prepare_executable,
+                                                seed_prepare_registry)
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.runtime import telemetry
+from transmogrifai_tpu.serving import plan_compiles
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.workflow.persistence import load_model
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _records(n=120, seed=11):
+    rng = np.random.default_rng(seed)
+    cats = ["a", "b", "c"]
+    recs = []
+    for _ in range(n):
+        x = float(rng.normal())
+        z = float(rng.uniform(0, 4))
+        recs.append({"x": x, "z": z,
+                     "cat": cats[int(rng.integers(0, len(cats)))],
+                     "label": float(x + 0.5 * rng.normal() > 0)})
+    return recs
+
+
+def _train(recs):
+    x = FeatureBuilder.of("x", Real).extract(
+        lambda r: r.get("x")).as_predictor()
+    z = FeatureBuilder.of("z", RealNN).extract(
+        lambda r: r.get("z")).as_predictor()
+    cat = FeatureBuilder.of("cat", PickList).extract(
+        lambda r: r.get("cat")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        label, transmogrify([x, z, cat])).get_output()
+    return (Workflow().set_result_features(pred)
+            .set_input_records(recs).train(validate="off"))
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    """Train once, save once WITH export on (the suite-wide autouse
+    default is off) — every test works on copies of this dir."""
+    tmp = tmp_path_factory.mktemp("aot")
+    keep = {k: os.environ.get(k) for k in
+            ("TX_AOT_EXPORT", "TX_AOT_ARTIFACTS",
+             "TX_AUDIT_CACHE", "TX_PROFILE_STORE")}
+    os.environ["TX_AOT_EXPORT"] = "on"
+    os.environ.pop("TX_AOT_ARTIFACTS", None)
+    os.environ["TX_AUDIT_CACHE"] = str(tmp / "audit_cache.json")
+    os.environ["TX_PROFILE_STORE"] = str(tmp / "profile_store.json")
+    try:
+        recs = _records()
+        model = _train(recs)
+        mdir = str(tmp / "model")
+        model.save(mdir)
+        yield {"dir": mdir, "records": recs,
+               "audit_cache": str(tmp / "audit_cache.json")}
+    finally:
+        for k, v in keep.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture()
+def env(saved, monkeypatch):
+    """Per-test env: artifacts in default auto mode, the module's
+    audit cache (seeded at save) so fingerprint checks are pure
+    hashing, and a clean prepare registry + telemetry."""
+    monkeypatch.setenv("TX_AUDIT_CACHE", saved["audit_cache"])
+    monkeypatch.delenv("TX_AOT_ARTIFACTS", raising=False)
+    clear_prepare_registry()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _copy(saved, tmp_path):
+    dst = str(tmp_path / "model_copy")
+    shutil.copytree(saved["dir"], dst)
+    return dst
+
+
+def _edit_manifest(mdir, **fields):
+    path = art_store.manifest_path(mdir)
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    doc.update(fields)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+
+
+def _scores(plan, recs):
+    scored = plan.score(recs)
+    out = {}
+    for name in scored.column_names:
+        col = scored[name]
+        out[name] = [col.boxed(i).value if hasattr(col.boxed(i), "value")
+                     else col.boxed(i) for i in range(scored.n_rows)]
+    return out
+
+
+def _reference_scores(mdir, recs):
+    """Live-compiled scores with the artifact path hard-off."""
+    os.environ["TX_AOT_ARTIFACTS"] = "off"
+    try:
+        plan = load_or_compile(load_model(mdir))
+        assert not plan.aot_active()
+        return _scores(plan, recs)
+    finally:
+        os.environ.pop("TX_AOT_ARTIFACTS", None)
+
+
+def _assert_bitwise(a, b):
+    assert set(a) == set(b)
+    for name in a:
+        assert a[name] == b[name], f"column {name} diverged"
+
+
+# ---------------------------------------------------------------------------
+# export at save
+# ---------------------------------------------------------------------------
+
+class TestExportAtSave:
+    def test_store_written_with_manifest(self, saved, env):
+        adir = art_store.artifact_dir(saved["dir"])
+        assert os.path.isdir(adir)
+        manifest, state = art_store.read_manifest(saved["dir"])
+        assert state == "ok"
+        env_key = art_store.env_stamp()
+        assert manifest["jax"] == env_key["jax"]
+        assert manifest["platform"] == env_key["platform"]
+        assert manifest["machine"] == env_key["machine"]
+        assert manifest["fingerprint"].startswith("xla:")
+        assert manifest["score"], "no scoring bucket entries"
+        assert manifest["buckets"] == sorted(
+            e["bucket"] for e in manifest["score"].values())
+
+    def test_every_payload_checksums(self, saved, env):
+        manifest, _ = art_store.read_manifest(saved["dir"])
+        for kind in ("score", "prepare"):
+            for label, entry in (manifest.get(kind) or {}).items():
+                payload = art_store.read_payload(saved["dir"], entry)
+                assert payload is not None, f"torn entry {label}"
+                assert len(payload) == entry["bytes"]
+
+    def test_fingerprint_matches_pr16_sidecar(self, saved, env):
+        from transmogrifai_tpu.analysis.audit import AUDIT_SIDECAR
+        with open(os.path.join(saved["dir"], AUDIT_SIDECAR),
+                  encoding="utf-8") as fh:
+            sidecar = json.load(fh)
+        manifest, _ = art_store.read_manifest(saved["dir"])
+        assert manifest["fingerprint"] == sidecar["fingerprint"]
+
+    def test_export_off_writes_nothing(self, saved, env, tmp_path,
+                                       monkeypatch):
+        monkeypatch.setenv("TX_AOT_EXPORT", "off")
+        model = load_model(saved["dir"])
+        mdir = str(tmp_path / "plain")
+        model.save(mdir)
+        assert not os.path.isdir(art_store.artifact_dir(mdir))
+
+
+# ---------------------------------------------------------------------------
+# zero-compile load + bitwise parity
+# ---------------------------------------------------------------------------
+
+class TestZeroCompileLoad:
+    def test_loads_every_bucket_and_scores_identically(self, saved,
+                                                       env):
+        model = load_model(saved["dir"])
+        plan = load_or_compile(model)
+        assert plan.aot_active()
+        manifest, _ = art_store.read_manifest(saved["dir"])
+        assert sorted(plan._aot_executables) == manifest["buckets"]
+        c0 = plan_compiles()
+        d0 = telemetry.counters().get("serve_aot_dispatches", 0)
+        got = _scores(plan, saved["records"][:48])
+        assert plan_compiles() == c0, "AOT path recorded a compile"
+        assert telemetry.counters()["serve_aot_dispatches"] > d0
+        _assert_bitwise(got, _reference_scores(saved["dir"],
+                                               saved["records"][:48]))
+
+    def test_aot_summary_carries_the_key(self, saved, env):
+        plan = load_or_compile(load_model(saved["dir"]))
+        s = plan.aot_summary()
+        manifest, _ = art_store.read_manifest(saved["dir"])
+        assert s["fingerprint"] == manifest["fingerprint"]
+        assert s["loadedBuckets"] == manifest["buckets"]
+
+    def test_in_memory_model_live_compiles_silently(self, saved, env):
+        model = load_model(saved["dir"])
+        model.model_dir = None
+        plan = load_or_compile(model)
+        assert not plan.aot_active()
+        assert "serve_aot_fallbacks" not in telemetry.counters()
+
+    def test_mode_off_never_touches_the_store(self, saved, env,
+                                              monkeypatch):
+        monkeypatch.setenv("TX_AOT_ARTIFACTS", "off")
+        plan = load_or_compile(load_model(saved["dir"]))
+        assert not plan.aot_active()
+        assert "serve_aot_loads" not in telemetry.counters()
+
+
+# ---------------------------------------------------------------------------
+# the mismatch classes: loud fallback, identical scores, no crash
+# ---------------------------------------------------------------------------
+
+def _drill(mdir, recs, expected_class):
+    """Load a mutated store: must fall back LOUDLY (its own counter +
+    the total + the event) and score identically to live compile."""
+    plan = load_or_compile(load_model(mdir))
+    assert not plan.aot_active()
+    counters = telemetry.counters()
+    assert counters.get("serve_aot_fallbacks", 0) >= 1
+    assert counters.get(f"serve_aot_fallback_{expected_class}", 0) >= 1
+    events = [e for e in telemetry.events_since(0)
+              if e.get("event") == "serve_aot_fallback"]
+    assert any(e.get("reason") == expected_class for e in events)
+    _assert_bitwise(_scores(plan, recs), _reference_scores(mdir, recs))
+
+
+class TestMismatchClasses:
+    def test_missing_store(self, saved, env, tmp_path):
+        mdir = _copy(saved, tmp_path)
+        shutil.rmtree(art_store.artifact_dir(mdir))
+        _drill(mdir, saved["records"][:16], "missing")
+
+    def test_wrong_jax_version(self, saved, env, tmp_path):
+        mdir = _copy(saved, tmp_path)
+        _edit_manifest(mdir, jax="0.0.0")
+        _drill(mdir, saved["records"][:16], "jax_version")
+
+    def test_wrong_platform(self, saved, env, tmp_path):
+        mdir = _copy(saved, tmp_path)
+        _edit_manifest(mdir, platform="tpu")
+        _drill(mdir, saved["records"][:16], "platform")
+
+    def test_wrong_machine_fingerprint(self, saved, env, tmp_path):
+        # same backend, different host ISA — the XLA:CPU SIGILL hazard
+        mdir = _copy(saved, tmp_path)
+        _edit_manifest(mdir, machine="deadbeefdead")
+        _drill(mdir, saved["records"][:16], "platform")
+
+    def test_fingerprint_drift(self, saved, env, tmp_path):
+        mdir = _copy(saved, tmp_path)
+        manifest, _ = art_store.read_manifest(mdir)
+        _edit_manifest(mdir,
+                       fingerprint=manifest["fingerprint"][:-4] + "beef")
+        _drill(mdir, saved["records"][:16], "fingerprint")
+
+    def test_bucket_ladder_disjoint(self, saved, env, tmp_path):
+        # nothing the plan dispatches is covered: full loud fallback
+        mdir = _copy(saved, tmp_path)
+        _edit_manifest(mdir, score={})
+        _drill(mdir, saved["records"][:16], "bucket_ladder")
+
+    def test_bucket_ladder_partial_loads_overlap(self, saved, env,
+                                                 tmp_path):
+        # the store covers only bucket 8: the overlap still loads
+        # (those dispatches stay compile-free), the gap is counted
+        mdir = _copy(saved, tmp_path)
+        manifest, _ = art_store.read_manifest(mdir)
+        only8 = {k: v for k, v in manifest["score"].items()
+                 if v["bucket"] == 8}
+        _edit_manifest(mdir, score=only8)
+        plan = load_or_compile(load_model(mdir))
+        assert plan.aot_active()
+        assert sorted(plan._aot_executables) == [8]
+        counters = telemetry.counters()
+        assert counters["serve_aot_fallback_bucket_ladder"] == 1
+        _assert_bitwise(_scores(plan, saved["records"][:16]),
+                        _reference_scores(mdir, saved["records"][:16]))
+
+    def test_tuned_subrange_ladder_fully_covered(self, saved, env):
+        # the serving side tunes its ladder to a subrange of the
+        # exported default — the healthy case: all buckets load, NO
+        # fallback counter
+        plan = load_or_compile(load_model(saved["dir"]),
+                               min_bucket=16, max_bucket=512)
+        assert plan.aot_active()
+        assert sorted(plan._aot_executables) == [16, 32, 64, 128, 256,
+                                                 512]
+        assert "serve_aot_fallbacks" not in telemetry.counters()
+
+    def test_torn_payload_poisons_whole_store(self, saved, env,
+                                              tmp_path, capsys):
+        mdir = _copy(saved, tmp_path)
+        manifest, _ = art_store.read_manifest(mdir)
+        entry = next(iter(manifest["score"].values()))
+        with open(os.path.join(art_store.artifact_dir(mdir),
+                               entry["file"]), "wb") as fh:
+            fh.write(b"tampered")
+        _drill(mdir, saved["records"][:16], "torn")
+        assert "poisoned" in capsys.readouterr().err
+
+    def test_torn_manifest(self, saved, env, tmp_path):
+        mdir = _copy(saved, tmp_path)
+        with open(art_store.manifest_path(mdir), "w") as fh:
+            fh.write("{not json")
+        _drill(mdir, saved["records"][:16], "torn")
+
+    def test_require_mode_raises_instead(self, saved, env, tmp_path,
+                                         monkeypatch):
+        mdir = _copy(saved, tmp_path)
+        shutil.rmtree(art_store.artifact_dir(mdir))
+        monkeypatch.setenv("TX_AOT_ARTIFACTS", "require")
+        with pytest.raises(ArtifactsRequired):
+            load_or_compile(load_model(mdir))
+
+    def test_require_mode_happy_path_loads(self, saved, env,
+                                           monkeypatch):
+        monkeypatch.setenv("TX_AOT_ARTIFACTS", "require")
+        plan = load_or_compile(load_model(saved["dir"]))
+        assert plan.aot_active()
+
+
+# ---------------------------------------------------------------------------
+# prepare-segment registry
+# ---------------------------------------------------------------------------
+
+class TestPrepareRegistry:
+    def test_seed_joins_exported_sig_digests(self, saved, env):
+        manifest, _ = art_store.read_manifest(saved["dir"])
+        if not manifest.get("prepare"):
+            pytest.skip("model exported no prepare segments")
+        n = seed_prepare_registry(saved["dir"])
+        assert n == len(manifest["prepare"])
+        for entry in manifest["prepare"].values():
+            assert prepare_executable(entry["sig"],
+                                      entry["bucket"]) is not None
+        assert telemetry.counters()["serve_aot_prepare_seeded"] == n
+
+    def test_seed_respects_env_key(self, saved, env, tmp_path):
+        mdir = _copy(saved, tmp_path)
+        _edit_manifest(mdir, jax="0.0.0")
+        assert seed_prepare_registry(mdir) == 0
+
+    def test_load_or_compile_seeds_as_side_effect(self, saved, env):
+        manifest, _ = art_store.read_manifest(saved["dir"])
+        if not manifest.get("prepare"):
+            pytest.skip("model exported no prepare segments")
+        load_or_compile(load_model(saved["dir"]))
+        entry = next(iter(manifest["prepare"].values()))
+        assert prepare_executable(entry["sig"],
+                                  entry["bucket"]) is not None
+
+
+# ---------------------------------------------------------------------------
+# tx artifacts CLI
+# ---------------------------------------------------------------------------
+
+class TestArtifactsCli:
+    def _run(self, argv):
+        from transmogrifai_tpu.cli.gen import main
+        return main(argv)
+
+    def test_verify_valid_store(self, saved, env, capsys):
+        rc = self._run(["artifacts", saved["dir"], "--verify"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "valid" in out and "0 compiles" in out
+
+    def test_verify_tampered_store_exits_1(self, saved, env, tmp_path,
+                                           capsys):
+        mdir = _copy(saved, tmp_path)
+        _edit_manifest(mdir, jax="0.0.0")
+        rc = self._run(["artifacts", mdir, "--verify"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "FAIL jax_version" in out
+
+    def test_missing_store_exits_1(self, saved, env, tmp_path, capsys):
+        mdir = _copy(saved, tmp_path)
+        shutil.rmtree(art_store.artifact_dir(mdir))
+        rc = self._run(["artifacts", mdir])
+        assert rc == 1
+        assert "no artifact store" in capsys.readouterr().err
+
+    def test_json_format(self, saved, env, capsys):
+        rc = self._run(["artifacts", saved["dir"], "--verify",
+                        "--format", "json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["valid"] is True
+        assert all(c["ok"] for c in doc["checks"])
+        assert doc["entries"]
+
+    def test_export_repairs_missing_store(self, saved, env, tmp_path,
+                                          capsys):
+        mdir = _copy(saved, tmp_path)
+        shutil.rmtree(art_store.artifact_dir(mdir))
+        rc = self._run(["artifacts", mdir, "--export"])
+        assert rc == 0
+        assert "exported" in capsys.readouterr().out
+        manifest, state = art_store.read_manifest(mdir)
+        assert state == "ok" and manifest["score"]
+
+
+# ---------------------------------------------------------------------------
+# serving integration: PlanCache + metrics
+# ---------------------------------------------------------------------------
+
+class TestServingIntegration:
+    def test_plancache_get_goes_through_loader(self, saved, env):
+        from transmogrifai_tpu.serving.server import PlanCache
+        cache = PlanCache(budget=2)
+        cache.register("m", saved["dir"])
+        entry = cache.get("m")
+        assert entry.plan.aot_active()
+        assert telemetry.counters().get("serve_aot_loads", 0) >= 1
+
+    def test_eviction_reload_stays_compile_free(self, saved, env):
+        from transmogrifai_tpu.serving.server import PlanCache
+        cache = PlanCache(budget=1)
+        cache.register("m", saved["dir"])
+        cache.get("m")
+        cache.register("other", saved["dir"])
+        cache.get("other")                     # evicts "m"
+        assert cache.evictions == 1
+        c0 = plan_compiles()
+        entry = cache.get("m")                 # reload from artifacts
+        assert entry.plan.aot_active()
+        entry.plan.score(saved["records"][:8])
+        assert plan_compiles() == c0
+
+    def test_lifecycle_swap_stays_compile_free(self, saved, env):
+        """Satellite 2: a retrained candidate saved WITH artifacts
+        (run_refit -> save_model exports them) builds its serving
+        entry, prewarms every bucket, and swaps in — with
+        plan_compiles() FLAT across the whole episode."""
+        from transmogrifai_tpu.serving import (LifecycleConfig,
+                                               ServeConfig,
+                                               serve_in_process)
+        from transmogrifai_tpu.serving.lifecycle import ModelLifecycle
+        server, client = serve_in_process(
+            {"m": saved["dir"]},
+            ServeConfig(max_wait_ms=10.0, sentinel=False))
+        try:
+            client.score_many([dict(r) for r in saved["records"][:8]])
+            manager = ModelLifecycle(server, LifecycleConfig())
+            candidate = load_model(saved["dir"])   # "retrained" + saved
+            c0 = plan_compiles()
+            entry = manager._build_entry(("m", "default"), candidate,
+                                         [dict(r) for r in
+                                          saved["records"][:8]])
+            assert entry.plan.aot_active()
+            server.plans.swap_entry("m", entry)
+            client.score_many([dict(r) for r in saved["records"][:8]])
+            assert plan_compiles() == c0, \
+                "candidate build/prewarm/swap paid a serve compile"
+        finally:
+            server.stop()
+
+    def test_metrics_snapshot_reports_aot(self, saved, env):
+        from transmogrifai_tpu.serving import ServeConfig, \
+            serve_in_process
+        server, client = serve_in_process(
+            {"m": saved["dir"]},
+            ServeConfig(max_wait_ms=10.0, sentinel=False))
+        try:
+            client.score_many([dict(r) for r in saved["records"][:8]])
+            snap = server.metrics_snapshot()
+        finally:
+            server.stop()
+        aot = snap.get("aot") or {}
+        assert aot, f"no aot block in metrics: {sorted(snap)}"
+        summary = next(iter(aot.values()))
+        assert summary and summary["loadedBuckets"]

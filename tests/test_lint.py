@@ -1351,6 +1351,93 @@ class TestR05UnboundedQueue:
         assert findings == []
 
 
+class TestR06ArtifactBypass:
+    """TX-R06: serving/ and cli/ code must build compiled plans through
+    artifacts.loader.load_or_compile — a direct
+    ``ScoringPlan(...).compile()`` ignores a saved model's exported AOT
+    executables and pays a cold in-band XLA compile per bucket
+    (docs/aot_artifacts.md)."""
+
+    SRV = "transmogrifai_tpu/serving/myserver.py"
+
+    def _lint(self, code, path=None):
+        return lint_source(textwrap.dedent(code), path or self.SRV)
+
+    def test_chained_compile_flagged(self):
+        findings = self._lint("""
+            from .plan import ScoringPlan
+
+            def build(model):
+                return ScoringPlan(model).compile()
+        """)
+        assert "TX-R06" in _rules(findings)
+        f = [x for x in findings if x.rule_id == "TX-R06"][0]
+        assert f.severity == "error"
+        assert "load_or_compile" in (f.hint or "")
+
+    def test_qualified_ctor_flagged(self):
+        findings = self._lint("""
+            from . import plan as planmod
+
+            def build(model, buckets):
+                return planmod.ScoringPlan(
+                    model, min_bucket=buckets[0]).compile()
+        """)
+        assert "TX-R06" in _rules(findings)
+
+    def test_cli_path_flagged(self):
+        findings = self._lint("""
+            from ..serving import ScoringPlan
+
+            def run_score(args, model):
+                plan = ScoringPlan(model).compile()
+                return plan
+        """, path="transmogrifai_tpu/cli/myscore.py")
+        assert "TX-R06" in _rules(findings)
+
+    def test_load_or_compile_legal(self):
+        findings = self._lint("""
+            from ..artifacts.loader import load_or_compile
+
+            def build(model):
+                return load_or_compile(model)
+        """)
+        assert "TX-R06" not in _rules(findings)
+
+    def test_uncompiled_construction_legal(self):
+        # building a plan without .compile() (bucket introspection)
+        # is not a bypass — nothing compiles
+        findings = self._lint("""
+            from .plan import ScoringPlan
+
+            def ladder(model):
+                return ScoringPlan(model).buckets()
+        """)
+        assert "TX-R06" not in _rules(findings)
+
+    def test_outside_serving_and_cli_is_silent(self):
+        # the loader itself (artifacts/) and tests build plans directly
+        findings = self._lint("""
+            from ..serving.plan import ScoringPlan
+
+            def load_or_compile(model):
+                return ScoringPlan(model).compile()
+        """, path="transmogrifai_tpu/artifacts/myloader.py")
+        assert "TX-R06" not in _rules(findings)
+
+    def test_inline_suppression(self, tmp_path):
+        d = tmp_path / "serving"
+        d.mkdir()
+        p = d / "boot.py"
+        p.write_text(
+            "from .plan import ScoringPlan\n"
+            "def build(model):\n"
+            "    return ScoringPlan(model).compile()"
+            "  # tx-lint: disable=TX-R06\n")
+        findings, _ = lint_paths([str(p)])
+        assert findings == []
+
+
 class TestJ08ShardClosure:
     """TX-J08: a shard_map/pjit body closing over an array-like value
     gets implicit full replication — arrays must enter through

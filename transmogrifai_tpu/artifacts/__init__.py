@@ -1,0 +1,44 @@
+"""AOT-compiled, persisted plan artifacts (docs/aot_artifacts.md).
+
+PR 12 made restart fast *when a warm snapshot exists*; this package
+removes the remaining cold-start compile bill entirely. At
+``save_model`` time every ``ScoringPlan`` bucket program (and each
+``PreparePlan`` segment program the training run dispatched) is
+AOT-compiled (``jax.jit(...).lower().compile()``), serialized
+(``jax.experimental.serialize_executable``) and written into the model
+directory as a checksummed, manifest-keyed artifact store. At serve
+boot the loader deserializes those executables instead of compiling —
+zero XLA compiles in the serve process on the happy path.
+
+Validity is keyed exactly like the PR-16 audit layer: (jax version,
+platform/backend, machine fingerprint on CPU, the canonical plan
+fingerprint, the bucket ladder). ANY mismatch falls back to live
+compile loudly — a per-class telemetry counter + event, never a crash,
+and bitwise-identical scores either way (the artifact is the same
+program the live path would compile).
+
+- :mod:`.store`  — on-disk layout, manifest schema, checksums, staging
+- :mod:`.export` — the ``save_model`` hook + ``tx artifacts --export``
+- :mod:`.loader` — ``load_or_compile`` (the ONLY sanctioned way for
+  serving/CLI code to build a plan: lint rule TX-R06 flags direct
+  ``ScoringPlan(...).compile()`` call sites in those trees)
+"""
+from .store import (ARTIFACT_DIR, MANIFEST_FILE, ARTIFACT_SCHEMA,
+                    artifact_dir, read_manifest, env_stamp,
+                    export_enabled, load_mode)
+from .export import export_model_artifacts, export_scoring_artifacts, \
+    export_prepare_artifacts
+from .loader import ArtifactsRequired, load_or_compile, \
+    load_scoring_artifacts, seed_prepare_registry, prepare_executable, \
+    clear_prepare_registry
+
+__all__ = [
+    "ARTIFACT_DIR", "MANIFEST_FILE", "ARTIFACT_SCHEMA",
+    "artifact_dir", "read_manifest", "env_stamp", "export_enabled",
+    "load_mode",
+    "export_model_artifacts", "export_scoring_artifacts",
+    "export_prepare_artifacts",
+    "ArtifactsRequired", "load_or_compile", "load_scoring_artifacts",
+    "seed_prepare_registry", "prepare_executable",
+    "clear_prepare_registry",
+]

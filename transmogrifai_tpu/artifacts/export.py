@@ -1,0 +1,190 @@
+"""Artifact export: AOT-compile + serialize every plan program
+(docs/aot_artifacts.md).
+
+``save_model`` calls :func:`export_model_artifacts` on its staging dir
+(workflow/persistence.py) — the artifact store rides inside the same
+atomic directory swap as the model itself. ``tx artifacts --export``
+re-exports an existing model dir for the current environment (the
+"platform move" repair path), going through the store's own staged
+swap.
+
+Export is best-effort by contract: a program that fails to AOT-compile
+or serialize skips its entry loudly (counter + event) and the save
+proceeds — a model without artifacts live-compiles exactly as before.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ..runtime import telemetry as _telemetry
+from . import store as _store
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["export_model_artifacts", "export_scoring_artifacts",
+           "export_prepare_artifacts"]
+
+
+def _serialize(compiled) -> bytes:
+    """One ``jax.stages.Compiled`` -> payload bytes. The in/out pytree
+    defs are NOT persisted: both are recomputed deterministically at
+    load time from the plan's own avals (loader._tree_defs), so the
+    payload is pure XLA executable + jax glue."""
+    from jax.experimental import serialize_executable as _se
+    payload, _in_tree, _out_tree = _se.serialize(compiled)
+    return payload
+
+
+def _plan_fingerprint_for_export(plan, staging_dir: str) -> str:
+    """The canonical plan fingerprint for the manifest. The
+    ``plan-fingerprint.json`` sidecar (PR 16, written moments earlier
+    in the same save) is authoritative when present — one lowering
+    serves both keys; otherwise compute from the already-compiled
+    plan's min bucket."""
+    from ..analysis.audit import AUDIT_SIDECAR, audit_scoring_plan
+    sidecar = os.path.join(staging_dir, AUDIT_SIDECAR)
+    try:
+        with open(sidecar, encoding="utf-8") as fh:
+            fp = json.load(fh).get("fingerprint")
+        if fp:
+            return str(fp)
+    except (OSError, ValueError):
+        pass
+    return audit_scoring_plan(plan, buckets=[plan.min_bucket],
+                              compiled=False)[0].fingerprint
+
+
+def export_scoring_artifacts(plan, manifest: dict,
+                             payloads: Dict[str, bytes]) -> int:
+    """AOT-compile + serialize every bucket program of a compiled
+    ScoringPlan into ``payloads``/``manifest``. Returns the number of
+    bucket entries written."""
+    entries: Dict[str, dict] = {}
+    for bucket in plan.buckets():
+        try:
+            compiled = plan.lower_bucket(int(bucket)).compile()
+            payload = _serialize(compiled)
+        except Exception as e:
+            _telemetry.count("serve_aot_export_errors")
+            _telemetry.event("serve_aot_export_error", kind="score",
+                             bucket=int(bucket),
+                             error=f"{type(e).__name__}: {e}")
+            _log.warning("AOT export: scoring bucket %d not exported "
+                         "(%s: %s)", bucket, type(e).__name__, e)
+            continue
+        fname = f"score-b{int(bucket)}.bin"
+        payloads[fname] = payload
+        entries[f"b{int(bucket)}"] = {
+            "file": fname, "bucket": int(bucket),
+            "sha256": _store.payload_sha256(payload),
+            "bytes": len(payload),
+        }
+    manifest["score"] = entries
+    manifest["buckets"] = [int(b) for b in plan.buckets()]
+    manifest["nOutputs"] = len(plan._device_outputs)
+    manifest["donate"] = bool(plan.donate)
+    return len(entries)
+
+
+def export_prepare_artifacts(prepare_plan, manifest: dict,
+                             payloads: Dict[str, bytes]) -> int:
+    """Serialize every fused prepare segment program the training run
+    dispatched, from the plan's PR-16 audit handles (plans/prepare.py
+    records the jitted fn + input avals + buckets + the cross-train
+    segment signature digest per segment). Keyed by signature digest:
+    a later train whose fitted state fingerprints identically resolves
+    the artifact instead of compiling."""
+    import jax
+    import numpy as np
+    entries: Dict[str, dict] = {}
+    for handle in getattr(prepare_plan, "audit_handles", ()):
+        sig = handle.get("sig_digest")
+        if not sig:
+            continue            # unfingerprintable segment: no reuse key
+        for bucket in handle["buckets"]:
+            label = f"{handle['label']}:b{int(bucket)}"
+            try:
+                avals = tuple(
+                    jax.ShapeDtypeStruct((int(bucket),) + tuple(shape),
+                                         dtype)
+                    for shape, dtype in handle["in_avals"])
+                mask = jax.ShapeDtypeStruct((int(bucket),), np.float64)
+                compiled = handle["fn"].lower(avals, mask).compile()
+                payload = _serialize(compiled)
+            except Exception as e:
+                _telemetry.count("serve_aot_export_errors")
+                _telemetry.event("serve_aot_export_error",
+                                 kind="prepare", label=label,
+                                 error=f"{type(e).__name__}: {e}")
+                _log.warning("AOT export: prepare segment %s not "
+                             "exported (%s: %s)", label,
+                             type(e).__name__, e)
+                continue
+            fname = (f"prepare-{handle['label']}-b{int(bucket)}.bin"
+                     .replace(":", "-"))
+            payloads[fname] = payload
+            entries[label] = {
+                "file": fname, "bucket": int(bucket), "sig": sig,
+                "sha256": _store.payload_sha256(payload),
+                "bytes": len(payload),
+                "nOutputs": len(handle.get("stages") or ()),
+                "inAvals": [[list(shape), np.dtype(dtype).name]
+                            for shape, dtype in handle["in_avals"]],
+            }
+    manifest["prepare"] = entries
+    return len(entries)
+
+
+def export_model_artifacts(model, staging_dir: str,
+                           prepare_plan: Any = None) -> Optional[dict]:
+    """The ``save_model`` hook: export the model's scoring bucket
+    programs (and, when the saving process just trained it, the
+    prepare segment programs) into ``<staging_dir>/aot-artifacts``.
+    Returns the manifest, or None when export is disabled / the plan
+    has no device program. Never raises past the persistence wrapper.
+    """
+    if not _store.export_enabled():
+        return None
+    from ..serving.plan import ScoringPlan
+    t0 = time.perf_counter()
+    plan = ScoringPlan(model).compile()
+    if not getattr(plan, "_device_steps", None):
+        _telemetry.event("serve_aot_export_skipped",
+                         reason="no device program")
+        return None
+    manifest: Dict[str, Any] = {"schema": _store.ARTIFACT_SCHEMA,
+                                "createdAt": time.time()}
+    manifest.update(_store.env_stamp())
+    manifest["fingerprint"] = _plan_fingerprint_for_export(
+        plan, staging_dir)
+    payloads: Dict[str, bytes] = {}
+    n_score = export_scoring_artifacts(plan, manifest, payloads)
+    if prepare_plan is None:
+        # the common save-after-train flow: the process-global handle
+        # to the prepare plan train() just executed
+        from ..plans.prepare import last_prepare_plan
+        prepare_plan = last_prepare_plan()
+    n_prep = 0
+    if prepare_plan is not None and getattr(model, "train_dataset",
+                                            None) is not None:
+        n_prep = export_prepare_artifacts(prepare_plan, manifest,
+                                          payloads)
+    if not n_score:
+        _telemetry.event("serve_aot_export_skipped",
+                         reason="no bucket exported")
+        return None
+    _store.write_store(staging_dir, manifest, payloads)
+    seconds = time.perf_counter() - t0
+    _telemetry.count("serve_aot_exports")
+    _telemetry.event("serve_aot_exported", buckets=n_score,
+                     prepare_segments=n_prep,
+                     bytes=sum(len(p) for p in payloads.values()),
+                     seconds=round(seconds, 3))
+    _log.info("AOT artifacts exported: %d scoring bucket(s), %d "
+              "prepare segment(s), %.0f KiB in %.2fs", n_score, n_prep,
+              sum(len(p) for p in payloads.values()) / 1024, seconds)
+    return manifest

@@ -1,0 +1,177 @@
+"""Artifact store layout + manifest + integrity (docs/aot_artifacts.md).
+
+One directory per saved model::
+
+    <model_dir>/aot-artifacts/
+        manifest.json            # schema, env key, fingerprint, entries
+        score-b8.bin             # serialized executable per bucket
+        ...
+        prepare-seg0-b512.bin    # serialized executable per (segment,
+                                 # bucket) the training run dispatched
+
+The manifest is the validity key: (jax version, platform/backend,
+machine fingerprint, canonical plan fingerprint, bucket ladder). Every
+payload file carries its sha256 in the manifest; the loader verifies
+before deserializing and — like the audit cache's poisoning contract
+(analysis/cache.py) — ONE bad entry discards the whole store loudly
+rather than serving a mix of loaded and tampered programs.
+
+Writes are staged: payloads + manifest land in a sibling
+``aot-artifacts.tmp-<pid>`` directory which is swapped in whole (the
+``save_model`` rename idiom, workflow/persistence.py) — a crash
+mid-export leaves either the previous store or none, never a torn one.
+The manifest is written LAST inside the staging dir, so even a torn
+staging dir can never present entries without their checksums.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ARTIFACT_DIR", "MANIFEST_FILE", "ARTIFACT_SCHEMA",
+           "artifact_dir", "manifest_path", "env_stamp",
+           "export_enabled", "load_mode", "read_manifest",
+           "write_store", "payload_sha256", "read_payload"]
+
+#: artifact directory name inside a saved model dir — a SIDECAR:
+#: analysis/cache.model_content_hash keys on op-model.json+arrays.npz
+#: only, so writing artifacts never moves the model's content key
+ARTIFACT_DIR = "aot-artifacts"
+MANIFEST_FILE = "manifest.json"
+
+#: manifest schema — bump on any layout/keying change; a mismatched
+#: schema is routine invalidation (live compile), never a guess
+ARTIFACT_SCHEMA = 1
+
+
+def artifact_dir(model_dir: str) -> str:
+    return os.path.join(model_dir, ARTIFACT_DIR)
+
+
+def manifest_path(model_dir: str) -> str:
+    return os.path.join(artifact_dir(model_dir), MANIFEST_FILE)
+
+
+def export_enabled() -> bool:
+    """``TX_AOT_EXPORT`` gates the save-side export (default ON —
+    saving a model writes its compiled executables alongside it)."""
+    return os.environ.get("TX_AOT_EXPORT", "on") not in ("off", "0")
+
+
+def load_mode() -> str:
+    """``TX_AOT_ARTIFACTS`` gates the load side: ``auto`` (default —
+    load when present, loud fallback otherwise), ``require`` (a serve
+    boot without valid artifacts is an error: fleet replicas must
+    never compile in-band), ``off`` (always live-compile)."""
+    mode = os.environ.get("TX_AOT_ARTIFACTS", "auto").lower()
+    if mode in ("off", "0"):
+        return "off"
+    if mode == "require":
+        return "require"
+    return "auto"
+
+
+def env_stamp() -> Dict[str, str]:
+    """The environment half of the artifact key. ``machine`` matters
+    on CPU: XLA:CPU emits host-ISA-specific code (utils/jax_setup
+    documents the SIGILL hazard), so an artifact compiled on an AVX-512
+    host must not load on a host without it."""
+    import jax
+    from ..utils.jax_setup import _machine_fingerprint
+    return {"jax": jax.__version__,
+            "platform": jax.default_backend(),
+            "machine": _machine_fingerprint()}
+
+
+def payload_sha256(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def read_manifest(model_dir: str) -> Tuple[Optional[dict], str]:
+    """``(manifest, "ok")`` or ``(None, reason)`` with reason one of
+    ``missing`` (no store / no manifest — the legacy-model-dir case)
+    or ``torn`` (unreadable/corrupt/mis-schemad manifest)."""
+    path = manifest_path(model_dir)
+    if not os.path.exists(path):
+        return None, "missing"
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None, "torn"
+    if not isinstance(doc, dict) or doc.get("schema") != ARTIFACT_SCHEMA:
+        return None, "torn"
+    return doc, "ok"
+
+
+def read_payload(model_dir: str, entry: dict) -> Optional[bytes]:
+    """One entry's payload bytes, checksum-verified; None on any
+    integrity failure (missing file, short read, sha mismatch)."""
+    fname = entry.get("file")
+    want = entry.get("sha256")
+    if not fname or not want:
+        return None
+    path = os.path.join(artifact_dir(model_dir), os.path.basename(fname))
+    try:
+        with open(path, "rb") as fh:
+            payload = fh.read()
+    except OSError:
+        return None
+    if payload_sha256(payload) != want:
+        return None
+    return payload
+
+
+def write_store(model_dir: str, manifest: dict,
+                payloads: Dict[str, bytes]) -> str:
+    """Stage ``payloads`` + ``manifest`` and swap the store into
+    ``<model_dir>/aot-artifacts`` atomically. Returns the final dir."""
+    final = artifact_dir(model_dir)
+    tmp = f"{final}.tmp-export{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    for fname, payload in payloads.items():
+        fpath = os.path.join(tmp, os.path.basename(fname))
+        with open(fpath, "wb") as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+    # manifest LAST: a staging dir killed before this line carries no
+    # manifest and reads as "missing", never as a torn store
+    with open(os.path.join(tmp, MANIFEST_FILE), "w",
+              encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=1)
+        fh.flush()
+        os.fsync(fh.fileno())
+    if os.path.isdir(final):
+        old = f"{final}.old-export{os.getpid()}"
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.rename(final, old)
+        os.rename(tmp, final)
+        shutil.rmtree(old)
+    else:
+        os.rename(tmp, final)
+    return final
+
+
+def manifest_summary(manifest: Optional[dict]) -> Optional[dict]:
+    """The small, JSON-able slice of a manifest the serving snapshot
+    and metrics carry (serving/state.py, metrics_snapshot)."""
+    if not manifest:
+        return None
+    return {
+        "fingerprint": manifest.get("fingerprint"),
+        "jax": manifest.get("jax"),
+        "platform": manifest.get("platform"),
+        "buckets": sorted(int(b) for b in (manifest.get("buckets")
+                                           or ())),
+        "prepareSegments": len(manifest.get("prepare") or {}),
+    }

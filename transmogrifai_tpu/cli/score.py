@@ -166,7 +166,7 @@ def run_score(args) -> int:
         # CLI scoring runs guarded by default: malformed rows are
         # quarantined with reasons instead of crashing the run, and
         # the drift sentinel compares the batch against training
-        from ..serving import DriftThresholds, ScoringPlan
+        from ..serving import DriftThresholds
         thresholds = None
         if args.drift_warn is not None or args.drift_degrade is not None:
             d = DriftThresholds()
@@ -175,7 +175,11 @@ def run_score(args) -> int:
                 else d.warn,
                 degrade=args.drift_degrade
                 if args.drift_degrade is not None else d.degrade)
-        plan = ScoringPlan(model).compile()
+        # artifact-first (artifacts/loader.py, TX-R06): `tx score` on
+        # a saved model deserializes the exported bucket programs —
+        # compile-free invocation; loud counted fallback otherwise
+        from ..artifacts.loader import load_or_compile
+        plan = load_or_compile(model, model_dir=args.model)
         if args.no_guardrails:
             # sentinel only: no admission/breaker, just drift watching
             from ..serving.sentinel import DriftSentinel

@@ -62,6 +62,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import sys
 from typing import List, Optional
 
 __all__ = ["add_serve_parser", "run_serve", "run_supervised",
@@ -150,6 +151,16 @@ def add_serve_parser(sub) -> None:
                     help="seconds a SIGTERM/SIGINT drain waits for "
                          "queued + in-flight requests before shutdown "
                          "(docs/serving_restart.md)")
+    sv.add_argument("--artifacts", choices=["auto", "require", "off"],
+                    default=None,
+                    help="AOT artifact loading (docs/aot_artifacts.md):"
+                         " auto loads each saved model's exported "
+                         "executables (zero serve-process compiles) "
+                         "with loud fallback to live compile; require "
+                         "refuses to boot a model without valid "
+                         "artifacts (fleet replicas); off always "
+                         "live-compiles (default: TX_AOT_ARTIFACTS "
+                         "env, else auto)")
     sv.add_argument("--state-dir", default=None, metavar="DIR",
                     help="write the warm-state snapshot here "
                          "(periodically, at lifecycle commits, and on "
@@ -441,6 +452,10 @@ def run_serve(args) -> int:
         sentinel=not args.no_sentinel,
         lifecycle=lifecycle,
         admission_control=admission_control)
+    if getattr(args, "artifacts", None):
+        # the flag wins over the env; set BEFORE any plan resolves so
+        # PlanCache.get / prewarm / state restore all see one mode
+        os.environ["TX_AOT_ARTIFACTS"] = args.artifacts
     server = ServingServer(config)
     for name, path in _parse_models(args.model):
         server.add_model(name, path)
@@ -466,9 +481,28 @@ def run_serve(args) -> int:
     # cost model says this zoo will hit BEFORE the port binds, so the
     # first live batches skip their compile stall. Cold store or
     # TX_TUNE=off -> empty set -> no-op, boot time unchanged.
+    from ..artifacts.store import load_mode
+    if load_mode() == "require":
+        # fleet-replica contract: resolve every registered model NOW —
+        # a model without valid artifacts refuses to boot instead of
+        # silently compiling in-band
+        from ..artifacts.loader import ArtifactsRequired
+        try:
+            for name in server.plans.names():
+                server.plans.get(name, server.plan_buckets)
+        except ArtifactsRequired as e:
+            print(f"tx-serve: {e}", file=sys.stderr)
+            return 2
     warmed = server.prewarm()
     if warmed:
         banner_extra["prewarmed"] = warmed
+    # which resident models serve from deserialized AOT executables
+    # (the boot-visible zero-compile signal, docs/aot_artifacts.md)
+    aot_models = sorted(
+        name for (name, _b), entry in server.plans.resident_entries()
+        if getattr(entry.plan, "aot_active", lambda: False)())
+    if aot_models:
+        banner_extra["artifacts"] = aot_models
     if server._target_decision.tuned() or any(
             d.tuned() for d in server._bucket_decisions):
         banner_extra["tuned"] = {

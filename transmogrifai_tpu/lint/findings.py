@@ -102,6 +102,13 @@ RULES: Dict[str, tuple] = {
                       "overflow at the admission edge "
                       "(serving/admission.py) with a retry_after_ms "
                       "answer instead of queue-and-pray"),
+    "TX-R06": (ERROR, "direct ScoringPlan(...).compile() in serving/ "
+                      "or cli/ — bypasses the AOT artifact loader, so "
+                      "a saved model's exported executables are "
+                      "ignored and the serve process pays a cold XLA "
+                      "compile per bucket; route through "
+                      "artifacts.loader.load_or_compile "
+                      "(docs/aot_artifacts.md)"),
     # -- cross-procedure rules (whole-program call graph) ------------------
     "TX-X01": (ERROR, "blocking primitive (time.sleep, sync open() "
                       "file I/O, .block_until_ready(), un-awaited "

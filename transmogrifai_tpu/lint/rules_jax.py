@@ -337,6 +337,17 @@ def _is_serving_path(path: str) -> bool:
     return "serving" in re.split(r"[/\\]", path)
 
 
+def _is_artifact_path(path: str) -> bool:
+    """serving/ and cli/ files get the TX-R06 artifact-bypass rule:
+    these trees score saved models, where a direct
+    ``ScoringPlan(...).compile()`` ignores the model dir's exported
+    AOT executables and pays a cold XLA compile per bucket
+    (artifacts/loader.py is the sanctioned entry point)."""
+    import re
+    parts = re.split(r"[/\\]", path)
+    return "serving" in parts or "cli" in parts
+
+
 def _is_train_path(path: str) -> bool:
     """workflow/ package files get the TX-J09 train-hot-path rule: the
     code ``Workflow.train()`` executes between raw data and the fitted
@@ -503,6 +514,7 @@ class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, al: _Aliases):
         self.path = path
         self.serving = _is_serving_path(path)
+        self.artifact_path = _is_artifact_path(path)
         self.train_path = _is_train_path(path)
         self.resilience = _is_resilience_path(path)
         self.record_drop = _is_record_drop_path(path)
@@ -1052,6 +1064,42 @@ class _Visitor(ast.NodeVisitor):
                  "(stages to *.tmp, then os.replace — the live path "
                  "is never half-written)")
 
+    # -- TX-R06: AOT-artifact-loader bypass in serving//cli/ ---------------
+    def _check_plan_compile_bypass(self, node: ast.Call) -> None:
+        """``ScoringPlan(...).compile()`` chained directly in serving/
+        or cli/ code ignores the saved model's exported AOT executables
+        (docs/aot_artifacts.md): the serve process pays a cold XLA
+        compile per bucket that ``save_model`` already paid for it.
+        ``artifacts.loader.load_or_compile`` is the one sanctioned
+        constructor — it attaches the artifacts when the validity key
+        matches and falls back LOUDLY (counted) when it doesn't."""
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "compile"):
+            return
+        inner = fn.value
+        if not isinstance(inner, ast.Call):
+            return
+        ctor = inner.func
+        name = None
+        if isinstance(ctor, ast.Name):
+            name = ctor.id
+        elif isinstance(ctor, ast.Attribute):
+            name = ctor.attr
+        if name != "ScoringPlan":
+            return
+        where = (f" in {self.fn_stack[-1].name!r}"
+                 if self.fn_stack else "")
+        self.add(
+            "TX-R06", node,
+            f"ScoringPlan(...).compile(){where} bypasses the AOT "
+            f"artifact loader — a saved model's exported executables "
+            f"are ignored and every bucket pays a cold in-band XLA "
+            f"compile",
+            ERROR,
+            hint="route through artifacts.loader.load_or_compile "
+                 "(loads the model dir's serialized executables, "
+                 "counted loud fallback to live compile otherwise)")
+
     # -- TX-R05: unbounded request queues in serving/ ----------------------
     _QUEUE_NAME_HINTS = ("queue", "backlog", "pending")
 
@@ -1170,6 +1218,9 @@ class _Visitor(ast.NodeVisitor):
         # TX-R04: torn state-file writes anywhere under serving/ ------------
         if self.serving:
             self._check_state_file_write(node)
+        # TX-R06: AOT-artifact-loader bypass in serving//cli/ ----------------
+        if self.artifact_path:
+            self._check_plan_compile_bypass(node)
         # TX-O01: telemetry/trace/clock inside a jitted body ----------------
         if self.jit_ctx is not None:
             self._check_traced_telemetry(node)

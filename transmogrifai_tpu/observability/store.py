@@ -305,6 +305,19 @@ class ProfileStore:
             state["tuning"] = block
             return self._write(state)
 
+    # -- named bench blocks (TX_BENCH_MODE=restart_aot, ...) ---------------
+    def record_section(self, name: str, doc: dict) -> bool:
+        """Persist one named, timestamped bench/diagnostic block (e.g.
+        ``aot_restart``) wholesale. Callers own the namespace — pick a
+        name that is not one of the structural blocks (``profiles``,
+        ``tuning``, ``autotune``, ``probes``)."""
+        with _merge_lock(self.path):
+            state = self.load()
+            out = dict(doc)
+            out["time"] = time.time()
+            state[str(name)] = out
+            return self._write(state)
+
     # -- autotune bench trail (TX_BENCH_MODE=autotune) ---------------------
     def record_autotune(self, doc: dict) -> bool:
         """Persist the bench's full TuningDecision list + tuned-vs-

@@ -133,6 +133,29 @@ def _state_fingerprint(stage: PipelineStage) -> Optional[str]:
     return hashlib.sha1(blob).hexdigest()
 
 
+def _sig_digest(sig) -> Optional[str]:
+    """Stable digest of a segment signature — the cross-PROCESS reuse
+    key the AOT artifact store files prepare executables under
+    (artifacts/export.py). The signature is already deterministic
+    (state fingerprints + positions + bucket range), so its repr is."""
+    if sig is None:
+        return None
+    return hashlib.sha1(repr(sig).encode()).hexdigest()
+
+
+def _prepare_aot_executable(sig_digest: Optional[str], bucket: int):
+    """The deserialized AOT executable for one (segment, bucket), or
+    None — a thin guard over artifacts/loader.prepare_executable so a
+    broken artifacts layer can never take training down."""
+    if sig_digest is None:
+        return None
+    try:
+        from ..artifacts.loader import prepare_executable
+        return prepare_executable(sig_digest, bucket)
+    except Exception:           # registry is an optimization, not truth
+        return None
+
+
 def _segment_cache_get(sig):
     hit = _SEGMENT_CACHE.get(sig)
     if hit is not None:
@@ -483,6 +506,7 @@ class PreparePlan:
             pos_of[s.out_name] = k_in + j
         step_pos = tuple(step_pos)
         sig = self._segment_signature(step_pos, k_in)
+        sig_digest = _sig_digest(sig)
         seg_label = f"prepare:seg{seg_idx}"
         t0 = time.perf_counter()
         c0 = compile_time.compile_seconds()
@@ -508,10 +532,19 @@ class PreparePlan:
                                for _, arr in sources)
                 mask = np.zeros(bucket, dtype=np.float64)
                 mask[:rows] = 1.0
-                record_compile(
-                    "prepare",
-                    (sig if sig is not None else self._plan_id, bucket))
-                outs = self._dispatch(fn, inputs, mask)
+                # a seeded AOT executable (artifacts/loader.py — the
+                # lifecycle retrain path seeds from the live model's
+                # artifact store) dispatches without compiling; the
+                # prepare-compile diagnostic stays flat
+                aot_fn = _prepare_aot_executable(sig_digest, bucket)
+                if aot_fn is not None:
+                    _telemetry.count("prepare_aot_dispatches")
+                else:
+                    record_compile(
+                        "prepare",
+                        (sig if sig is not None else self._plan_id,
+                         bucket))
+                outs = self._dispatch(aot_fn or fn, inputs, mask)
                 for i, o in enumerate(outs):
                     chunks[i].append(o[:rows])
                 if n == 0:
@@ -526,6 +559,7 @@ class PreparePlan:
         self.audit_handles.append({
             "label": f"seg{seg_idx}",
             "fn": fn,
+            "sig_digest": sig_digest,
             "in_avals": [(tuple(arr.shape[1:]), arr.dtype)
                          for _, arr in sources],
             "buckets": sorted(seg_buckets),

@@ -319,10 +319,15 @@ class ModelLifecycle:
     # -- candidate entry: prewarm + fresh guards ---------------------------
     def _build_entry(self, key: Tuple[str, str], candidate,
                      ring: List[dict]):
-        from .plan import ScoringPlan
+        from ..artifacts.loader import load_or_compile
         from .server import _CacheEntry, _TenantGuards
         name, tenant = key
-        plan = ScoringPlan(candidate).compile()
+        # the retrain just saved the candidate (run_refit -> save_model
+        # exports its AOT artifacts): reuse them, so canary prewarm and
+        # everything post-swap stays at ZERO serve-process compiles —
+        # plan_compiles() is flat across a swap
+        # (tests/test_aot_artifacts.py asserts it)
+        plan = load_or_compile(candidate)
         self._prewarm(plan, ring)
         entry = _CacheEntry(
             model=candidate, plan=plan,

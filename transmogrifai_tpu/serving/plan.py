@@ -127,6 +127,13 @@ class ScoringPlan:
         self._deadline_pool = None
         #: live rows dispatched per bucket (bucket_profile denominator)
         self._bucket_rows: Dict[int, int] = {}
+        #: bucket -> deserialized AOT executable (artifacts/loader.py)
+        #: — when present for a bucket, dispatch calls it INSTEAD of
+        #: the jitted fn: same program, zero serve-process compiles
+        self._aot_executables: Dict[int, Any] = {}
+        #: the artifact manifest the executables came from (None =
+        #: live-compiled plan)
+        self.aot_manifest: Optional[dict] = None
 
     # -- compilation -------------------------------------------------------
     def compile(self) -> "ScoringPlan":
@@ -181,6 +188,32 @@ class ScoringPlan:
                 stage_name, reason)
         self._compiled = True
         return self
+
+    # -- AOT artifacts (artifacts/, docs/aot_artifacts.md) -----------------
+    def attach_artifacts(self, execs: Dict[int, Any],
+                         manifest: Optional[dict] = None
+                         ) -> "ScoringPlan":
+        """Route per-bucket dispatch through deserialized AOT
+        executables (artifacts/loader.load_or_compile is the sanctioned
+        caller). The executables ARE the programs the live path would
+        compile — bitwise-identical outputs, asserted in
+        tests/test_aot_artifacts.py."""
+        self._aot_executables = dict(execs)
+        self.aot_manifest = manifest
+        return self
+
+    def aot_active(self) -> bool:
+        return bool(self._aot_executables)
+
+    def aot_summary(self) -> Optional[dict]:
+        """The snapshot/metrics slice: which artifact store this plan
+        serves from (serving/state.py records it per model)."""
+        if not self._aot_executables:
+            return None
+        from ..artifacts.store import manifest_summary
+        out = manifest_summary(self.aot_manifest) or {}
+        out["loadedBuckets"] = sorted(self._aot_executables)
+        return out
 
     def fallbacks(self) -> int:
         """How many stages of this plan run through the host
@@ -624,14 +657,20 @@ class ScoringPlan:
         with _trace.span("score.dispatch", rows=enc.n_rows,
                          chunks=len(enc.chunks)):
             for bucket, inputs, mask, rows in enc.chunks:
-                record_compile("score", (self._plan_id, bucket))
+                if bucket in self._aot_executables:
+                    # AOT path: the program was deserialized, not
+                    # compiled — the compile diagnostic stays flat
+                    _telemetry.count("serve_aot_dispatches")
+                else:
+                    record_compile("score", (self._plan_id, bucket))
                 self._bucket_rows[bucket] = \
                     self._bucket_rows.get(bucket, 0) + rows
                 # the bucket section reports into the span as a child
                 # carrying the per-bucket compile/execute split
                 # (utils/compile_time section observer)
                 with _bucket_section("score", self._plan_id, bucket):
-                    outs = self._dispatch_device(inputs, mask)
+                    outs = self._dispatch_device(inputs, mask,
+                                                 bucket=bucket)
                 for i, o in enumerate(outs):
                     out_chunks[i].append(np.asarray(o)[:rows])
         return self._finish_score(enc.ds, out_chunks)
@@ -646,7 +685,22 @@ class ScoringPlan:
         return _shared_bucket_profile("score", self._plan_id,
                                       self._bucket_rows)
 
-    def _dispatch_device(self, inputs, mask):
+    def _aot_dispatch_fallback(self, bucket, e: Exception):
+        """A loaded executable that fails at CALL time (arg layout
+        drift, backend refusal) is dropped for its bucket — the live
+        jit path takes over seamlessly — and the degradation is
+        recorded loudly (the artifacts loud-fallback contract)."""
+        self._aot_executables.pop(bucket, None)
+        _telemetry.count("serve_aot_dispatch_errors")
+        _telemetry.event("serve_aot_dispatch_error", bucket=bucket,
+                         error=f"{type(e).__name__}: {e}")
+        _log.warning(
+            "AOT executable for bucket %s failed at dispatch "
+            "(%s: %s); live-compiling this bucket from now on",
+            bucket, type(e).__name__, e)
+        record_compile("score", (self._plan_id, bucket))
+
+    def _dispatch_device(self, inputs, mask, bucket=None):
         """One fused-program dispatch behind the runtime retry policy:
         a preemption/RESOURCE_EXHAUSTED-shaped backend error retries
         with backoff (runtime/retry.py) instead of failing the serving
@@ -655,9 +709,20 @@ class ScoringPlan:
         included) runs under a per-batch wall-clock budget — a hung
         backend is abandoned (the thread is orphaned, exactly like the
         selector's family deadline) and surfaces as DEADLINE_EXCEEDED
-        for the breaker/fallback layer."""
+        for the breaker/fallback layer.
+
+        With an AOT executable attached for ``bucket`` the dispatch
+        calls it instead of the jitted fn — the identical program,
+        deserialized rather than compiled."""
         def attempt():
             maybe_inject("plan", "device", "dispatch")
+            aot = (self._aot_executables.get(bucket)
+                   if bucket is not None else None)
+            if aot is not None:
+                try:
+                    return aot(inputs, mask)
+                except Exception as e:
+                    self._aot_dispatch_fallback(bucket, e)
             return self._device_fn(inputs, mask)
 
         deadline = (self.guard.deadline_seconds

@@ -264,7 +264,15 @@ class PlanCache:
             kwargs["min_bucket"] = buckets[0]
         if buckets[1] is not None:
             kwargs["max_bucket"] = buckets[1]
-        plan = ScoringPlan(model, **kwargs).compile()
+        # artifact-first compile (artifacts/loader.py, TX-R06): a
+        # saved model's AOT executables deserialize instead of
+        # compiling — a cache MISS (boot or eviction reload) costs a
+        # file read, not an XLA compile; loud counted fallback
+        # otherwise
+        from ..artifacts.loader import load_or_compile
+        plan = load_or_compile(
+            model, model_dir=loader if isinstance(loader, str) else None,
+            **kwargs)
         entry = _CacheEntry(
             model=model, plan=plan,
             result_names=[f.name for f in model.result_features])
@@ -1275,6 +1283,14 @@ class ServingServer:
                            "misses": self.plans.misses,
                            "evictions": self.plans.evictions},
             "plan_compiles": plan_compiles(),
+            # AOT artifact state per resident model (docs/
+            # aot_artifacts.md): which plans serve from deserialized
+            # executables vs live compiles — the zero-compile-cold-
+            # start acceptance signal next to plan_compiles above
+            "aot": {
+                name: (entry.plan.aot_summary()
+                       if hasattr(entry.plan, "aot_summary") else None)
+                for name, entry in live},
             "breakers": breakers,
             "sentinels": sentinels,
             "lifecycle": (self.lifecycle.snapshot()

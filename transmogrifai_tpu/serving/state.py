@@ -115,6 +115,13 @@ class ServingStateSnapshot:
                 "bucket_range": [plan.min_bucket, plan.max_bucket],
                 "samples": samples,
                 "tenants": sorted(entry.guards),
+                # which AOT artifact store this incarnation served
+                # from (None = live-compiled) — the restore logs a
+                # drift event when the next boot resolves a DIFFERENT
+                # store (docs/aot_artifacts.md)
+                "artifacts": (plan.aot_summary()
+                              if hasattr(plan, "aot_summary")
+                              else None),
             }
             if name not in snap.lru:
                 snap.lru.append(name)
@@ -194,6 +201,21 @@ class ServingStateSnapshot:
                     continue
             entry = server.plans.get(
                 name, getattr(server, "plan_buckets", (None, None)))
+            # artifact-manifest continuity: a warm restart that lands
+            # on a different (or no) artifact store than the previous
+            # incarnation is loud — the model dir changed under us
+            prev_art = mdoc.get("artifacts")
+            cur_art = (entry.plan.aot_summary()
+                       if hasattr(entry.plan, "aot_summary") else None)
+            if prev_art is not None and (
+                    cur_art is None
+                    or cur_art.get("fingerprint")
+                    != prev_art.get("fingerprint")):
+                _telemetry.count("serving_state_artifact_drift")
+                _telemetry.event(
+                    "serving_state_artifact_drift", model=name,
+                    previous=str((prev_art or {}).get("fingerprint")),
+                    current=str((cur_art or {}).get("fingerprint")))
             samples = list(mdoc.get("samples") or []) or [{}]
             buckets = [int(b) for b in mdoc.get("warm_buckets") or []]
             for bucket in sorted(buckets):

@@ -331,6 +331,11 @@ def save_model(model, path: str) -> None:
     # identity files so the content-keyed audit cache can key on them;
     # best-effort inside the hook — it never breaks a save.
     _record_plan_fingerprint(model, tmp)
+    # AOT artifact export (artifacts/, docs/aot_artifacts.md): every
+    # bucket program compiled + serialized into the staging dir, so
+    # the artifact store rides the same atomic swap as the model. The
+    # fingerprint sidecar just written above is its identity key.
+    _export_plan_artifacts(model, tmp)
     if os.path.isdir(path):
         # swap: rename can't replace a non-empty dir, so move the old
         # model aside first; it is removed only after the new one is in
@@ -364,6 +369,22 @@ def _record_plan_fingerprint(model, staging_dir: str) -> None:
         logging.getLogger(__name__).warning(
             "plan fingerprint not recorded (%s: %s); the saved model "
             "carries no AOT artifact identity", type(e).__name__, e)
+
+
+def _export_plan_artifacts(model, staging_dir: str) -> None:
+    """Satellite of the artifact store (artifacts/export.py): AOT-
+    compile + serialize the model's bucket programs into the staging
+    dir. Best-effort and env-gated (``TX_AOT_EXPORT=off`` disables) —
+    a model whose programs cannot export saves without artifacts,
+    loudly, and live-compiles at serve time exactly as before."""
+    try:
+        from ..artifacts.export import export_model_artifacts
+        export_model_artifacts(model, staging_dir)
+    except Exception as e:   # never let the exporter break a save
+        import logging
+        logging.getLogger(__name__).warning(
+            "AOT artifacts not exported (%s: %s); the saved model "
+            "will live-compile at serve boot", type(e).__name__, e)
 
 
 def _save_drift_fingerprints(model, staging_dir: str) -> None:
