@@ -1610,6 +1610,61 @@ class TestT01TunableKnobFork:
         assert "TX-T01" not in _rules(findings)
 
 
+class TestT02HardcodedPow2BucketMath:
+    """TX-T02: hand-rolled power-of-two bucket math in the dispatch
+    layers disagrees with a tuned non-power-of-two lattice
+    (docs/ragged_batching.md); only plans/common.py and
+    tuning/lattice.py may hold that arithmetic."""
+
+    def test_doubling_loop_flagged_in_serving(self):
+        findings = lint_source(textwrap.dedent("""
+            def grow(n):
+                b = 8
+                while b < n:
+                    b *= 2
+                return b
+        """), "transmogrifai_tpu/serving/server.py")
+        flagged = [f for f in findings if f.rule_id == "TX-T02"]
+        assert len(flagged) == 1
+        assert flagged[0].severity == "error"
+        assert "bucket_for" in (flagged[0].hint or "")
+
+    def test_shift_and_pow_with_computed_exponent_flagged(self):
+        findings = lint_source(textwrap.dedent("""
+            def rungs(k):
+                return [1 << i for i in range(k)], 2 ** k
+        """), "transmogrifai_tpu/plans/prepare.py")
+        assert len([f for f in findings
+                    if f.rule_id == "TX-T02"]) == 2
+
+    def test_literal_exponent_is_clean(self):
+        # `2 ** 30` is a plain size constant, not a derived ladder
+        findings = lint_source(
+            "GIB = 2 ** 30\nPAGE = 1 << 12\n",
+            "transmogrifai_tpu/serving/server.py")
+        assert "TX-T02" not in _rules(findings)
+
+    def test_exempt_files_are_clean(self):
+        src = textwrap.dedent("""
+            def grow(n):
+                b = 8
+                while b < n:
+                    b *= 2
+                return 1 << n
+        """)
+        for path in ("transmogrifai_tpu/plans/common.py",
+                     "transmogrifai_tpu/tuning/lattice.py"):
+            assert "TX-T02" not in _rules(lint_source(src, path))
+
+    def test_outside_bucket_layers_is_clean(self):
+        # models/ heap math doubles freely — out of TX-T02 scope
+        findings = lint_source(textwrap.dedent("""
+            def heap(depth):
+                return 2 ** depth - 1
+        """), "transmogrifai_tpu/models/trees.py")
+        assert "TX-T02" not in _rules(findings)
+
+
 # ---------------------------------------------------------------------------
 # cross-procedure rules (TX-X01..TX-X04) — whole-program call graph
 # ---------------------------------------------------------------------------

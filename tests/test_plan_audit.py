@@ -241,34 +241,38 @@ class TestOccupancyRules:
         store.record_profiles(records)
         return store
 
-    def test_p03_fires_on_uncovered_recorded_bucket(self, tmp_path):
+    def test_p03_fires_beyond_the_ladder_top(self, tmp_path):
         store = self._store(tmp_path,
-                            {"score:b7": {"calls": 3, "rows": 10}})
+                            {"score:b128": {"calls": 3, "rows": 300}})
         findings = occupancy_findings(_ladder(), store=store)
         assert _rules(findings) == ["TX-P03"]
-        assert findings[0].subject == "score:b7"
+        assert findings[0].subject == "score:b128"
         assert findings[0].severity == "warning"
 
     def test_p03_silent_when_ladder_covers_traffic(self, tmp_path):
+        # lattice-aware coverage (docs/ragged_batching.md): any shape
+        # at or below the ladder top pads onto SOME rung — off-rung
+        # records from an older ladder are not gaps
         store = self._store(tmp_path,
-                            {"score:b8": {"calls": 3, "rows": 20}})
+                            {"score:b8": {"calls": 3, "rows": 20},
+                             "score:b7": {"calls": 3, "rows": 10}})
         assert occupancy_findings(_ladder(), store=store) == []
 
     def test_p04_fires_above_waste_ceiling(self, tmp_path):
-        # 100 dispatches of bucket 64 carrying 100 real rows total:
-        # waste = 100*64/100 = 64x > 16x default ceiling
+        # 400 dispatches carrying 100 real rows: mean 0.25 rows pads
+        # to this ladder's min rung 8 — waste 32x > 16x default
         store = self._store(tmp_path,
-                            {"score:b64": {"calls": 100, "rows": 100}})
+                            {"score:b64": {"calls": 400, "rows": 100}})
         findings = occupancy_findings(_ladder(), store=store)
         assert _rules(findings) == ["TX-P04"]
         assert findings[0].severity == "error"
-        assert "64.0x" in findings[0].message
+        assert "32.0x" in findings[0].message
 
     def test_p04_ceiling_is_the_registered_knob(self, tmp_path):
         from transmogrifai_tpu.tuning.registry import STATIC_DEFAULTS
         assert STATIC_DEFAULTS["audit.waste_ceiling"] == 16.0
         store = self._store(tmp_path,
-                            {"score:b64": {"calls": 100, "rows": 100}})
+                            {"score:b64": {"calls": 400, "rows": 100}})
         # an explicit ceiling above the measured waste silences it
         assert occupancy_findings(_ladder(), store=store,
                                   waste_ceiling=100.0) == []
@@ -594,7 +598,7 @@ class TestAuditCli:
         from transmogrifai_tpu.cli.audit import run_audit
         store_path = str(tmp_path / "cli_store.json")
         ProfileStore(store_path).record_profiles(
-            {"score:b3": {"calls": 5, "rows": 9}})
+            {"score:b16384": {"calls": 5, "rows": 40000}})
         rc = run_audit(_audit_args(
             demo[2], "--no-compile", "--no-persist",
             "--store", store_path,
@@ -611,9 +615,10 @@ class TestAuditCli:
         from transmogrifai_tpu.cli.audit import run_audit
         store_path = str(tmp_path / "cli_store.json")
         store = ProfileStore(store_path)
-        # 64 padded rows per real row: far above the 16x default
+        # mean 0.05 real rows padding to the demo ladder's min rung
+        # 8: waste 160x, far above the 16x default
         store.record_profiles(
-            {"score:b64": {"calls": 100, "rows": 100}})
+            {"score:b64": {"calls": 100, "rows": 5}})
         base = _audit_args(
             demo[2], "--no-compile", "--no-persist",
             "--store", store_path,

@@ -1,0 +1,493 @@
+"""Ragged batching on non-power-of-two bucket lattices (ISSUE 18,
+docs/ragged_batching.md).
+
+The load-bearing contracts:
+
+- ``bucket_for``/``pad_rows`` with an EXPLICIT lattice: edge buckets
+  (n == rung, n == 1, n beyond the top rung chunks) and bitwise parity
+  with the historical doubling rule when the lattice IS the default
+  power-of-two ladder;
+- ``choose_lattice``: deterministic, bounded, monotone; empty
+  occupancy and TX_TUNE=off keep the default ladder bitwise (the
+  cold-start contract);
+- ``CostModelV2``: learned tier above the confidence floor, v1
+  interpolation below it, and the per-tier LOO error report;
+- ``ScoringPlan(lattice=...)``: non-pow2 bucket programs score
+  BITWISE-identically to the default plan, including chunked batches;
+- AOT artifacts: a tuned non-pow2 ladder runs through the SAME subset
+  coverage check — covered rungs load, uncovered rungs degrade loudly;
+- the lattice-aware occupancy rules (TX-P03/TX-P04) and the
+  predicted-cost coalescer split.
+"""
+import types
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.observability.store import ProfileStore
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.plans.common import bucket_for, pad_rows
+from transmogrifai_tpu.serving.plan import ScoringPlan
+from transmogrifai_tpu.tuning.lattice import (bucket_for_lattice,
+                                              choose_lattice,
+                                              default_lattice,
+                                              normalize_lattice)
+from transmogrifai_tpu.tuning.model_v2 import (LEARNED, CostModelV2)
+from transmogrifai_tpu.tuning.policy import TuningPolicy
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import Workflow
+
+LATTICE = (21, 48, 96)
+
+
+def _bucket_rec(calls, execute, compile_s=0.01, rows=None, bucket=None):
+    rows = rows if rows is not None else calls * int(bucket or 1)
+    return {"calls": calls, "wall_seconds": execute + compile_s,
+            "compile_seconds": compile_s, "execute_seconds": execute,
+            "rows": rows}
+
+
+def _seed_scaling_store(path, ir=False):
+    """Recorded per-bucket costs with the measured CPU shape (~fixed
+    overhead + per-row term): splitting a big padded dispatch into a
+    snug rung is predicted cheaper per row."""
+    buckets = (8, 16, 32, 64, 128, 256)
+    store = ProfileStore(path)
+    store.record_profiles({
+        f"score:b{b}": _bucket_rec(10, (0.0015 + 3e-5 * b) * 10,
+                                   bucket=b)
+        for b in buckets})
+    if ir:
+        store.record_ir_features({
+            f"score:b{b}": {"ops": 40, "fusions": 6,
+                            "parameter_bytes": 64 * b,
+                            "constant_bytes": 2048,
+                            "output_bytes": 16 * b}
+            for b in buckets})
+    return store
+
+
+# ---------------------------------------------------------------------------
+# bucket_for / pad_rows with an explicit lattice
+# ---------------------------------------------------------------------------
+
+class TestBucketForLattice:
+    def test_edges_on_a_non_pow2_lattice(self):
+        assert bucket_for(1, lattice=LATTICE) == 21
+        assert bucket_for(21, lattice=LATTICE) == 21      # n == rung
+        assert bucket_for(22, lattice=LATTICE) == 48
+        assert bucket_for(96, lattice=LATTICE) == 96      # n == max
+        # beyond the top rung: the top comes back — the chunking cue
+        assert bucket_for(97, lattice=LATTICE) == 96
+        assert bucket_for(10 ** 9, lattice=LATTICE) == 96
+
+    def test_default_lattice_parity_with_doubling_rule(self):
+        dflt = default_lattice(8, 8192)
+        for n in (1, 7, 8, 9, 100, 1000, 4096, 8192, 10 ** 9):
+            assert bucket_for(n, lattice=dflt) == bucket_for(n)
+
+    def test_normalize_sorts_dedups_and_rejects_empty(self):
+        assert normalize_lattice([96, 21, 48, 21]) == (21, 48, 96)
+        with pytest.raises(ValueError):
+            normalize_lattice([])
+        with pytest.raises(ValueError):
+            normalize_lattice([0, -3])
+
+    def test_bucket_for_lattice_single_rung(self):
+        assert bucket_for_lattice(1, (21,)) == 21
+        assert bucket_for_lattice(21, (21,)) == 21
+        assert bucket_for_lattice(500, (21,)) == 21       # chunk cue
+
+    def test_pad_rows_to_non_pow2_bucket(self):
+        arr = np.arange(30, dtype=np.float32).reshape(15, 2)
+        padded = pad_rows(arr, 21)
+        assert padded.shape == (21, 2)
+        assert np.array_equal(padded[:15], arr)
+        assert not padded[15:].any()
+
+    def test_pad_rows_noop_at_exact_rung(self):
+        arr = np.arange(21, dtype=np.int64)
+        out = pad_rows(arr, 21)
+        assert out.shape == (21,)
+        assert np.array_equal(out, arr)
+
+
+# ---------------------------------------------------------------------------
+# choose_lattice
+# ---------------------------------------------------------------------------
+
+class TestChooseLattice:
+    def test_empty_occupancy_is_the_default_ladder(self):
+        choice = choose_lattice({}, min_bucket=8, max_bucket=256)
+        assert not choice.tuned()
+        assert choice.lattice == default_lattice(8, 256)
+
+    def test_padding_proxy_snaps_rungs_onto_observed_sizes(self):
+        # 65-row dispatches pad to 128 on the pow2 ladder; the proxy
+        # (padded rows) puts a rung exactly at 65
+        choice = choose_lattice({65: 100}, min_bucket=8, max_bucket=256)
+        assert choice.tuned()
+        assert 65 in choice.lattice
+        assert choice.lattice[-1] == 256                  # forced top
+        assert bucket_for_lattice(65, choice.lattice) == 65
+        assert choice.predicted_cost < choice.predicted_default_cost
+
+    def test_pow2_aligned_occupancy_keeps_the_default(self):
+        # traffic exactly on pow2 rungs: nothing strictly cheaper
+        choice = choose_lattice({8: 10, 64: 5}, min_bucket=8,
+                                max_bucket=256)
+        assert not choice.tuned()
+        assert choice.lattice == default_lattice(8, 256)
+
+    def test_deterministic_bounded_monotone(self):
+        occ = {3: 7, 21: 40, 65: 100, 130: 12, 700: 2}
+        a = choose_lattice(occ, min_bucket=8, max_bucket=256,
+                           max_rungs=4)
+        b = choose_lattice(occ, min_bucket=8, max_bucket=256,
+                           max_rungs=4)
+        assert a.lattice == b.lattice                     # bitwise
+        assert len(a.lattice) <= 4
+        assert a.lattice == tuple(sorted(set(a.lattice)))
+        assert a.lattice[0] >= 8 and a.lattice[-1] == 256
+
+    def test_flat_exec_cost_keeps_the_default_ladder(self):
+        # padding is free when the predicted exec cost is bucket-
+        # independent: a snug rung brings NO strict improvement, so
+        # the pow2 ladder is retained even though the padded-rows
+        # proxy would have tuned
+        occ = {65: 1}
+        proxy = choose_lattice(occ, min_bucket=8, max_bucket=256)
+        assert proxy.tuned()
+        modeled = choose_lattice(
+            occ, min_bucket=8, max_bucket=256,
+            exec_cost=lambda b: 0.001,
+            compile_cost=lambda b: 1.0)
+        assert not modeled.tuned()
+        assert modeled.modeled
+
+
+# ---------------------------------------------------------------------------
+# cost model v2: learned tier + fallback + error report
+# ---------------------------------------------------------------------------
+
+class TestCostModelV2:
+    def test_learned_tier_predicts_unrecorded_buckets(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        _seed_scaling_store(path, ir=True)
+        model = CostModelV2.from_store(path)
+        fit = model.fit_for("score")
+        assert fit is not None and fit.confident()
+        est = model.predict("score", bucket=48)            # unrecorded
+        assert est.confidence == LEARNED
+        # sane magnitude: between the neighboring recorded rungs
+        lo = model.predict("score", bucket=32).execute
+        hi = model.predict("score", bucket=128).execute
+        assert 0.25 * lo < est.execute < 4 * hi
+
+    def test_recorded_buckets_stay_exact(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        _seed_scaling_store(path, ir=True)
+        est = CostModelV2.from_store(path).predict("score", bucket=64)
+        assert est.confidence == "recorded"
+        assert est.execute == pytest.approx(0.0015 + 3e-5 * 64)
+
+    def test_below_record_floor_falls_back_to_interpolation(
+            self, tmp_path):
+        path = str(tmp_path / "s.json")
+        store = ProfileStore(path)
+        store.record_profiles({                            # 3 < floor 4
+            f"score:b{b}": _bucket_rec(10, (0.0015 + 3e-5 * b) * 10,
+                                       bucket=b)
+            for b in (8, 64, 256)})
+        store.record_ir_features({
+            f"score:b{b}": {"ops": 40, "fusions": 6,
+                            "parameter_bytes": 64 * b,
+                            "constant_bytes": 2048,
+                            "output_bytes": 16 * b}
+            for b in (8, 64, 256)})
+        model = CostModelV2.from_store(path)
+        assert model.fit_for("score") is None
+        assert model.predict("score",
+                             bucket=48).confidence == "interpolated"
+
+    def test_prediction_error_report_tiers(self, tmp_path):
+        path = str(tmp_path / "s.json")
+        _seed_scaling_store(path, ir=True)
+        report = CostModelV2.from_store(path).prediction_error_report()
+        tiers = report["tiers"]
+        assert set(tiers) == {"recorded", "interpolated", "learned",
+                              "default"}
+        assert tiers["recorded"]["count"] == 6
+        assert tiers["recorded"]["mean_abs_rel_err"] == 0.0
+        # every LOO row answers once through the v2 ladder (learned
+        # here) and once through v1 interpolation
+        assert tiers["learned"]["count"] == 6
+        assert tiers["interpolated"]["count"] == 6
+        assert report["learned"]["score"]["confident"]
+
+
+# ---------------------------------------------------------------------------
+# cold-start contract: TX_TUNE=off / empty store stay bitwise pow2
+# ---------------------------------------------------------------------------
+
+class TestColdStartLattice:
+    def test_tx_tune_off_keeps_the_pow2_ladder(self, tmp_path,
+                                               monkeypatch):
+        path = str(tmp_path / "s.json")
+        store = _seed_scaling_store(path)
+        store.record_occupancy({"score": {65: 200, 3: 10}})
+        monkeypatch.setenv("TX_TUNE", "off")
+        d = TuningPolicy(path=path).bucket_lattice(min_bucket=8,
+                                                   max_bucket=256)
+        assert not d.tuned()
+        assert d.chosen == default_lattice(8, 256)
+        assert d.source == "disabled"
+
+    def test_empty_store_keeps_the_pow2_ladder(self, tmp_path):
+        d = TuningPolicy(path=str(tmp_path / "s.json")).bucket_lattice(
+            min_bucket=8, max_bucket=256)
+        assert not d.tuned()
+        assert d.chosen == default_lattice(8, 256)
+
+    def test_cold_server_has_no_lattice_and_the_classic_coalescer(self):
+        from transmogrifai_tpu.serving.server import (PlanCache,
+                                                      ServeConfig,
+                                                      ServingServer)
+        server = ServingServer(ServeConfig(sentinel=False))
+        assert server.plan_lattice is None
+        assert server.coalesce_policy == "deadline_or_full"
+        # cache keys keep the historical 2-tuple shape when untuned
+        assert PlanCache._key("m", (None, None), None) == \
+            ("m", (None, None))
+        assert PlanCache._key("m", (8, 256), (21, 96)) == \
+            ("m", (8, 256), (21, 96))
+
+
+# ---------------------------------------------------------------------------
+# warm store: server lattice + predicted-cost coalescer split
+# ---------------------------------------------------------------------------
+
+class TestWarmServerLattice:
+    @pytest.fixture()
+    def warm_env(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "s.json")
+        store = _seed_scaling_store(path, ir=True)
+        store.record_occupancy({"score": {65: 200, 3: 10}})
+        monkeypatch.setenv("TX_PROFILE_STORE", path)
+        monkeypatch.delenv("TX_TUNE", raising=False)
+        return path
+
+    def test_server_resolves_a_tuned_lattice(self, warm_env):
+        from transmogrifai_tpu.serving.server import (ServeConfig,
+                                                      ServingServer)
+        server = ServingServer(ServeConfig(sentinel=False))
+        assert server.plan_lattice is not None
+        assert 65 in server.plan_lattice
+        assert server.plan_lattice[-1] == 256
+        assert server.coalesce_policy == "predicted_cost"
+
+    def test_coalesce_pop_count_splits_at_the_snug_rung(self, warm_env):
+        from transmogrifai_tpu.serving.server import (ServeConfig,
+                                                      ServingServer)
+        server = ServingServer(ServeConfig(sentinel=False))
+        # 70 queued rows: dispatching all 70 pads to 256; the model
+        # says the 65-rung's per-row cost is cheaper — split
+        assert server._coalesce_pop_count(70) == 65
+        # already exactly on a rung, or too small: the classic pop
+        assert server._coalesce_pop_count(65) == 65
+        assert server._coalesce_pop_count(1) == 1
+
+    def test_caller_config_pins_the_coalesce_policy(self, warm_env):
+        from transmogrifai_tpu.serving.server import (ServeConfig,
+                                                      ServingServer)
+        server = ServingServer(ServeConfig(
+            sentinel=False, coalesce_policy="deadline_or_full"))
+        assert server.coalesce_policy == "deadline_or_full"
+
+
+# ---------------------------------------------------------------------------
+# lattice-aware occupancy audit rules (TX-P03 / TX-P04)
+# ---------------------------------------------------------------------------
+
+def _audits(*buckets):
+    return [types.SimpleNamespace(plan="score", bucket=b, label=f"b{b}",
+                                  host_transfer_ops=[], param_widths={},
+                                  body_widths={})
+            for b in buckets]
+
+
+class _FakeStore:
+    def __init__(self, profiles):
+        self._profiles = profiles
+
+    def profiles(self):
+        return self._profiles
+
+
+class TestLatticeAwareOccupancyRules:
+    def test_recorded_pow2_bucket_inside_a_lattice_is_not_a_gap(self):
+        from transmogrifai_tpu.analysis.rules import occupancy_findings
+        # old pow2 records (bucket 32) under a [21, 64] lattice plan:
+        # 32 pads up to 64 — NOT a coverage gap, modest waste
+        store = _FakeStore({"score:b32": _bucket_rec(5, 0.1, rows=150)})
+        findings = occupancy_findings(_audits(21, 64), store=store)
+        assert findings == []
+
+    def test_beyond_ladder_top_is_a_gap(self):
+        from transmogrifai_tpu.analysis.rules import occupancy_findings
+        store = _FakeStore({"score:b128": _bucket_rec(5, 0.1, rows=400)})
+        findings = occupancy_findings(_audits(21, 64), store=store)
+        assert [f.rule_id for f in findings] == ["TX-P03"]
+        assert "ladder top" in findings[0].message
+
+    def test_waste_bound_remaps_onto_the_effective_rung(self):
+        from transmogrifai_tpu.analysis.rules import occupancy_findings
+        # mean 1 real row pads to rung 21: waste 21x > ceiling 16x
+        store = _FakeStore({"score:b8": _bucket_rec(20, 0.1, rows=20)})
+        findings = occupancy_findings(_audits(21, 64), store=store)
+        assert [f.rule_id for f in findings] == ["TX-P04"]
+        assert findings[0].severity == "error"
+
+
+# ---------------------------------------------------------------------------
+# ScoringPlan on an explicit lattice: bitwise parity + AOT coverage
+# ---------------------------------------------------------------------------
+
+def _records(n=120, seed=11):
+    rng = np.random.default_rng(seed)
+    cats = ["a", "b", "c"]
+    recs = []
+    for _ in range(n):
+        x = float(rng.normal())
+        z = float(rng.uniform(0, 4))
+        recs.append({"x": x, "z": z,
+                     "cat": cats[int(rng.integers(0, len(cats)))],
+                     "label": float(x + 0.5 * rng.normal() > 0)})
+    return recs
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    recs = _records()
+    x = FeatureBuilder.of("x", Real).extract(
+        lambda r: r.get("x")).as_predictor()
+    z = FeatureBuilder.of("z", RealNN).extract(
+        lambda r: r.get("z")).as_predictor()
+    cat = FeatureBuilder.of("cat", PickList).extract(
+        lambda r: r.get("cat")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        label, transmogrify([x, z, cat])).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(recs).train(validate="off"))
+    return model, recs
+
+
+def _scores(plan, recs):
+    scored = plan.score(recs)
+    out = {}
+    for name in scored.column_names:
+        col = scored[name]
+        out[name] = [col.boxed(i).value if hasattr(col.boxed(i), "value")
+                     else col.boxed(i) for i in range(scored.n_rows)]
+    return out
+
+
+class TestScoringPlanLattice:
+    def test_plan_adopts_the_lattice(self, small_model):
+        model, _ = small_model
+        plan = ScoringPlan(model, lattice=LATTICE)
+        assert plan.buckets() == list(LATTICE)
+        assert (plan.min_bucket, plan.max_bucket) == (21, 96)
+
+    def test_scores_bitwise_identical_to_the_default_plan(
+            self, small_model):
+        model, recs = small_model
+        dflt = ScoringPlan(model, min_bucket=8, max_bucket=256).compile()
+        lat = ScoringPlan(model, lattice=LATTICE).compile()
+        for n in (1, 20, 21, 22, 48, 96):                  # edge rungs
+            a = _scores(dflt, recs[:n])
+            b = _scores(lat, recs[:n])
+            assert set(a) == set(b)
+            for name in a:
+                assert a[name] == b[name], (n, name)
+
+    def test_chunked_batch_beyond_the_top_rung(self, small_model):
+        model, recs = small_model
+        dflt = ScoringPlan(model, min_bucket=8, max_bucket=256).compile()
+        lat = ScoringPlan(model, lattice=LATTICE).compile()
+        a = _scores(dflt, recs[:100])                      # 100 > 96
+        b = _scores(lat, recs[:100])
+        for name in a:
+            assert a[name] == b[name], name
+
+
+class TestAotLatticeCoverage:
+    @pytest.fixture(scope="class")
+    def saved(self, small_model, tmp_path_factory, request):
+        import os
+        model, recs = small_model
+        tmp = tmp_path_factory.mktemp("aot_lattice")
+        keep = {k: os.environ.get(k) for k in
+                ("TX_AOT_EXPORT", "TX_AOT_ARTIFACTS", "TX_AUDIT_CACHE")}
+        os.environ["TX_AOT_EXPORT"] = "on"
+        os.environ.pop("TX_AOT_ARTIFACTS", None)
+        os.environ["TX_AUDIT_CACHE"] = str(tmp / "audit_cache.json")
+        try:
+            mdir = str(tmp / "model")
+            model.save(mdir)
+            yield {"dir": mdir, "records": recs,
+                   "audit_cache": str(tmp / "audit_cache.json")}
+        finally:
+            for k, v in keep.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    @pytest.fixture()
+    def env(self, saved, monkeypatch):
+        from transmogrifai_tpu.runtime import telemetry
+        monkeypatch.setenv("TX_AUDIT_CACHE", saved["audit_cache"])
+        monkeypatch.delenv("TX_AOT_ARTIFACTS", raising=False)
+        telemetry.reset()
+        yield
+        telemetry.reset()
+
+    def test_pow2_subset_lattice_loads_every_rung(self, saved, env):
+        from transmogrifai_tpu.artifacts.loader import load_or_compile
+        from transmogrifai_tpu.runtime import telemetry
+        from transmogrifai_tpu.workflow.persistence import load_model
+        plan = load_or_compile(load_model(saved["dir"]),
+                               lattice=(16, 64, 512))
+        assert plan.aot_active()
+        assert sorted(plan._aot_executables) == [16, 64, 512]
+        assert "serve_aot_fallbacks" not in telemetry.counters()
+
+    def test_non_pow2_rung_degrades_loudly_and_scores_match(
+            self, saved, env):
+        from transmogrifai_tpu.artifacts.loader import load_or_compile
+        from transmogrifai_tpu.runtime import telemetry
+        from transmogrifai_tpu.workflow.persistence import load_model
+        # 48 was never exported (the save-time ladder is pow2): the
+        # overlap loads, the gap is counted, scores stay bitwise
+        plan = load_or_compile(load_model(saved["dir"]),
+                               lattice=(8, 48, 256))
+        assert plan.aot_active()
+        assert sorted(plan._aot_executables) == [8, 256]
+        counters = telemetry.counters()
+        assert counters["serve_aot_fallback_bucket_ladder"] == 1
+        a = _scores(plan, saved["records"][:40])           # hits 48
+        import os
+        os.environ["TX_AOT_ARTIFACTS"] = "off"
+        try:
+            ref = load_or_compile(load_model(saved["dir"]),
+                                  lattice=(8, 48, 256))
+            b = _scores(ref, saved["records"][:40])
+        finally:
+            os.environ.pop("TX_AOT_ARTIFACTS", None)
+        for name in a:
+            assert a[name] == b[name], name

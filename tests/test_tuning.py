@@ -372,7 +372,7 @@ class TestTuneCli:
         rc, out = self._run(["--store", str(tmp_path / "s.json"),
                              "--explain"], capsys)
         assert rc == 0
-        assert out.count("why:") == 11   # one per decision
+        assert out.count("why:") == 14   # one per decision
 
     def test_set_then_json_then_reset(self, tmp_path, capsys):
         store = str(tmp_path / "s.json")

@@ -385,18 +385,27 @@ def _fingerprint_via_cache(model, model_dir: str) -> str:
     return fp
 
 
-def record_plan_fingerprint(model, staging_dir: str) -> None:
+def record_plan_fingerprint(model, staging_dir: str,
+                            lattice: Optional[Sequence[int]] = None
+                            ) -> None:
     """save_model hook: compute the canonical fingerprint and write it
     as the ``plan-fingerprint.json`` sidecar (+ seed the audit cache so
-    the load-side verify is a pure cache hit). Best-effort — a model
-    whose plan cannot compile saves without a fingerprint, loudly."""
+    the load-side verify is a pure cache hit). ``lattice`` records the
+    bucket lattice the saving plan dispatched on (None = the default
+    power-of-two ladder) — informational identity only: the canonical
+    fingerprint is bucket-invariant, so a lattice change never trips
+    ``plan_fingerprint_drift`` (docs/ragged_batching.md). Best-effort —
+    a model whose plan cannot compile saves without a fingerprint,
+    loudly."""
     if not _fingerprint_enabled():
         return
     try:
         fp = _fingerprint_via_cache(model, staging_dir)
         jax_version, platform = _env()
         doc = {"schema": 1, "fingerprint": fp,
-               "jax": jax_version, "platform": platform}
+               "jax": jax_version, "platform": platform,
+               "lattice": ([int(b) for b in lattice]
+                           if lattice else None)}
         with open(os.path.join(staging_dir, AUDIT_SIDECAR), "w",
                   encoding="utf-8") as fh:
             json.dump(doc, fh, indent=1)

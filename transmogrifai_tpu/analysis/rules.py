@@ -12,11 +12,13 @@ exactly like ``tx lint`` does.
   computes at a wider float/int width than any parameter carries
   (invisible to AST rule TX-J04).
 - **TX-P03** bucket-lattice coverage gap vs the ProfileStore's
-  recorded occupancy (a shape that forces an unplanned serve-time
-  compile).
-- **TX-P04** padding-waste bound: per-bucket ``padded_rows/real_rows``
-  against recorded occupancy, ERROR above the ``audit.waste_ceiling``
-  tuning knob.
+  recorded occupancy: a recorded shape BEYOND the plan's ladder top
+  (every smaller shape pads up to some rung of this ladder — custom
+  non-pow2 lattices don't trip false gaps for old pow2 records).
+- **TX-P04** padding-waste bound: each record's mean real rows per
+  dispatch remapped onto THIS plan's effective rung, ERROR above the
+  ``audit.waste_ceiling`` tuning knob (reduces to the classic
+  ``padded_rows/real_rows`` on a matching pow2 ladder).
 - **TX-P05** classification drift: ``lowering_reason``
   (plans/common.py) disagrees with what actually lowers.
 
@@ -26,6 +28,7 @@ fresh — recorded traffic must never be masked by an audit cache hit.
 """
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional, Sequence
 
 from ..lint.findings import LintFinding, rule_severity
@@ -114,32 +117,45 @@ def occupancy_findings(audits: Sequence, store=None,
     except Exception:               # store unreadable: occupancy unknown
         return []
     out: List[LintFinding] = []
+    top = ladder[-1]
     for bucket in sorted(recorded):
         rec = recorded[bucket]
         calls = int(rec.get("calls", 0) or 0)
         rows = int(rec.get("rows", 0) or 0)
-        if bucket not in ladder:
+        # lattice-aware coverage (docs/ragged_batching.md): a recorded
+        # bucket BELOW the ladder top always pads up to some rung of
+        # THIS plan — only a shape beyond the top rung signals a range
+        # this ladder cannot serve without chunking. Custom non-pow2
+        # lattices must not trip false gaps for old pow2 records.
+        if bucket > top:
             out.append(_finding(
                 "TX-P03", f"score:b{bucket}",
                 f"recorded dispatch occupancy at bucket {bucket} "
-                f"({calls} calls) but this plan's ladder is "
-                f"{ladder} — that batch shape forces an unplanned "
-                f"XLA compile at serve time",
+                f"({calls} calls) beyond this plan's ladder top "
+                f"{top} (ladder {ladder}) — that batch shape chunks "
+                f"or forces an unplanned XLA compile at serve time",
                 hint="widen the plan's [min_bucket, max_bucket] range "
                      "(tuning knobs serving.min_bucket/max_bucket) to "
                      "cover the recorded shape, or chunk the batch"))
             continue
         if calls <= 0 or rows <= 0:
             continue                # occupancy unknown — no bound
-        waste = (calls * bucket) / rows
+        # lattice-aware waste: remap the record's mean real rows per
+        # dispatch onto THIS ladder's effective rung (for a matching
+        # pow2 ladder this reduces exactly to the old
+        # calls*bucket/rows bound)
+        mean_rows = rows / calls
+        eff = next((r for r in ladder if r >= math.ceil(mean_rows)),
+                   top)
+        waste = eff / mean_rows
         if waste > waste_ceiling:
             out.append(_finding(
                 "TX-P04", f"score:b{bucket}",
-                f"padding waste {waste:.1f}x at bucket {bucket} "
-                f"({calls} calls x {bucket} padded rows / {rows} real "
-                f"rows) exceeds the waste ceiling "
-                f"{waste_ceiling:g}x — the device spends most of "
-                f"this bucket scoring padding",
+                f"padding waste {waste:.1f}x at bucket {eff} "
+                f"({calls} calls, mean {mean_rows:.1f} real rows "
+                f"padding to rung {eff} of ladder {ladder}) exceeds "
+                f"the waste ceiling {waste_ceiling:g}x — the device "
+                f"spends most of this bucket scoring padding",
                 hint="lower serving.min_bucket (or coalesce requests "
                      "— serving/server.py deadline-or-full) so small "
                      "batches stop paying for the full bucket; the "

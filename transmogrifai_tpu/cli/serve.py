@@ -489,7 +489,8 @@ def run_serve(args) -> int:
         from ..artifacts.loader import ArtifactsRequired
         try:
             for name in server.plans.names():
-                server.plans.get(name, server.plan_buckets)
+                server.plans.get(name, server.plan_buckets,
+                                 server.plan_lattice)
         except ArtifactsRequired as e:
             print(f"tx-serve: {e}", file=sys.stderr)
             return 2
@@ -499,7 +500,7 @@ def run_serve(args) -> int:
     # which resident models serve from deserialized AOT executables
     # (the boot-visible zero-compile signal, docs/aot_artifacts.md)
     aot_models = sorted(
-        name for (name, _b), entry in server.plans.resident_entries()
+        key[0] for key, entry in server.plans.resident_entries()
         if getattr(entry.plan, "aot_active", lambda: False)())
     if aot_models:
         banner_extra["artifacts"] = aot_models

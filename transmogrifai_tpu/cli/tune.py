@@ -100,6 +100,50 @@ def _render_text(decisions: List, explain: bool,
     return lines
 
 
+def _lattice_report(policy, decisions) -> Optional[dict]:
+    """Per-rung predicted-vs-recorded cost of the chosen bucket
+    lattice (docs/ragged_batching.md): which tier answered each rung
+    and both execute costs, so ``--explain`` shows WHY the lattice
+    beat (or kept) the power-of-two ladder."""
+    d = next((x for x in decisions
+              if x.knob == "serving.bucket_lattice"), None)
+    if d is None:
+        return None
+    recorded = (policy.model.recorded_buckets("score")
+                if policy.enabled else {})
+    rungs = []
+    for b in (d.chosen or ()):
+        est = policy.model.predict("score", bucket=int(b))
+        rec = recorded.get(int(b))
+        rungs.append({
+            "bucket": int(b),
+            "predicted_execute_s": (round(est.execute, 6)
+                                    if est.execute is not None
+                                    else None),
+            "recorded_execute_s": (round(rec.execute, 6)
+                                   if rec is not None
+                                   and rec.execute is not None
+                                   else None),
+            "confidence": est.confidence,
+        })
+    return {"chosen": [int(b) for b in (d.chosen or ())],
+            "default": [int(b) for b in (d.default or ())],
+            "tuned": d.tuned(), "rungs": rungs}
+
+
+def _render_lattice(report: dict) -> List[str]:
+    lines = ["", "bucket lattice (per rung):",
+             "  bucket  predicted    recorded     tier"]
+    for r in report["rungs"]:
+        pred = ("?" if r["predicted_execute_s"] is None
+                else f"{r['predicted_execute_s']:.6f}s")
+        rec = ("-" if r["recorded_execute_s"] is None
+               else f"{r['recorded_execute_s']:.6f}s")
+        lines.append(f"  {r['bucket']:>6}  {pred:<11}  {rec:<11}  "
+                     f"{r['confidence']}")
+    return lines
+
+
 def run_tune(args: argparse.Namespace) -> int:
     from ..observability.store import ProfileStore
     from ..serving.server import ServeConfig
@@ -144,12 +188,16 @@ def run_tune(args: argparse.Namespace) -> int:
     decisions = policy.decisions(max_wait_ms=max_wait,
                                  max_batch=max_batch)
     if args.format == "json":
-        print(json.dumps({
+        doc = {
             "store": store.path,
             "enabled": policy.enabled,
             "overrides": policy.overrides,
             "decisions": [d.to_json() for d in decisions],
-        }, indent=1, sort_keys=True))
+        }
+        lattice = _lattice_report(policy, decisions)
+        if lattice is not None:
+            doc["lattice"] = lattice
+        print(json.dumps(doc, indent=1, sort_keys=True))
         return rc
     if mutated:
         print("")
@@ -158,6 +206,11 @@ def run_tune(args: argparse.Namespace) -> int:
               "the static default")
     for line in _render_text(decisions, args.explain, policy.overrides):
         print(line)
+    if args.explain:
+        lattice = _lattice_report(policy, decisions)
+        if lattice is not None:
+            for line in _render_lattice(lattice):
+                print(line)
     return rc
 
 

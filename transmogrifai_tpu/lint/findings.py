@@ -143,6 +143,16 @@ RULES: Dict[str, tuple] = {
                       "and resolve through TuningPolicy) so `tx tune` "
                       "overrides and the cost model actually govern "
                       "the knob"),
+    "TX-T02": (ERROR, "hardcoded power-of-two bucket math (`1 << n`, "
+                      "`2 ** n` with a computed exponent, `b *= 2` "
+                      "grow loops) on row counts outside "
+                      "plans/common.py / tuning/lattice.py — bucket "
+                      "plans resolve through an explicit lattice now "
+                      "(docs/ragged_batching.md), so local pow2 "
+                      "arithmetic silently disagrees with a tuned "
+                      "non-power-of-two ladder; call "
+                      "plans.common.bucket_for/pad_rows (or the "
+                      "tuning.lattice helpers) instead"),
     # -- plan IR rules (lowered StableHLO/HLO — analysis/rules.py) ---------
     "TX-P01": (ERROR, "host-transfer op (callback custom_call, infeed/"
                       "outfeed, send/recv) in a lowered scoring "

@@ -122,6 +122,16 @@ call) are caught here in milliseconds:
   registry (or default the parameter to ``None`` and resolve through
   ``TuningPolicy``). Files under ``tuning/`` are exempt — that IS the
   registry.
+- TX-T02 hardcoded power-of-two bucket math in the bucketing layers
+  (``serving/``, ``plans/``, ``tuning/``, ``artifacts/``,
+  ``analysis/``): ``1 << n``, ``2 ** n`` with a computed exponent, or
+  a ``b *= 2`` / ``b <<= 1`` grow loop re-derives the bucket ladder
+  locally. Plans resolve batch sizes through an EXPLICIT lattice now
+  (docs/ragged_batching.md) — a tuned non-power-of-two ladder makes
+  every local pow2 computation silently wrong. ``plans/common.py``
+  (the ``bucket_for``/``pad_rows`` entry points) and
+  ``tuning/lattice.py`` (the lattice math itself) are the two files
+  where that arithmetic legally lives and are exempt.
 
 Scope discipline keeps the rules precise: J01/J04/J05 only fire INSIDE
 functions statically known to be jitted (decorated with ``jax.jit`` or
@@ -406,6 +416,27 @@ def _is_tuning_path(path: str) -> bool:
     return "tuning" in re.split(r"[/\\]", path)
 
 
+#: the packages whose row/bucket arithmetic TX-T02 polices — the
+#: layers a tuned non-pow2 lattice flows through
+_T02_PACKAGES = frozenset(
+    {"serving", "plans", "tuning", "artifacts", "analysis"})
+
+
+def _is_bucket_math_path(path: str) -> bool:
+    """TX-T02 scope: the bucketing layers, MINUS the two files where
+    power-of-two arithmetic legally lives — ``plans/common.py``
+    (bucket_for/pad_rows, the entry points everyone should call) and
+    ``tuning/lattice.py`` (the lattice/pow2 math itself)."""
+    import re
+    parts = re.split(r"[/\\]", path)
+    if not _T02_PACKAGES & set(parts):
+        return False
+    if len(parts) >= 2 and (parts[-2], parts[-1]) in (
+            ("plans", "common.py"), ("tuning", "lattice.py")):
+        return False
+    return True
+
+
 def _tunable_names() -> tuple:
     """(const names, param name -> consumer-package scopes) registered
     in tuning/registry.py — lazy so the lint package imports standalone
@@ -520,6 +551,9 @@ class _Visitor(ast.NodeVisitor):
         self.record_drop = _is_record_drop_path(path)
         #: TX-T01: files under tuning/ may hold the literal defaults
         self.tuning_path = _is_tuning_path(path)
+        #: TX-T02: bucketing layers where local pow2 ladder math is
+        #: banned (plans/common.py + tuning/lattice.py exempt)
+        self.bucket_math_path = _is_bucket_math_path(path)
         self._tunable_consts, self._tunable_params = _tunable_names()
         self.al = al
         self.findings: List[LintFinding] = []
@@ -1439,9 +1473,49 @@ class _Visitor(ast.NodeVisitor):
             self._check_unbounded_queue([node.target], node.value)
         self.generic_visit(node)
 
+    # -- TX-T02 ------------------------------------------------------------
+    def _t02(self, node: ast.AST, spelled: str) -> None:
+        self.add(
+            "TX-T02", node,
+            f"hardcoded power-of-two bucket math ({spelled}) outside "
+            f"plans/common.py / tuning/lattice.py — a tuned "
+            f"non-power-of-two lattice (docs/ragged_batching.md) makes "
+            f"this locally re-derived ladder disagree with the plan's "
+            f"actual buckets",
+            ERROR,
+            hint="resolve batch shapes through plans.common.bucket_for/"
+                 "pad_rows (lattice-aware) or the tuning.lattice "
+                 "helpers instead of local pow2 arithmetic")
+
+    @staticmethod
+    def _const_int(node: ast.AST, value: int) -> bool:
+        return isinstance(node, ast.Constant) \
+            and type(node.value) is int and node.value == value
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        # TX-T02: `1 << n` / `2 ** n` with a COMPUTED exponent is a
+        # locally re-derived pow2 bucket ladder. A literal exponent
+        # (`2 ** 30`, a plain size constant) is just a number — exempt.
+        if self.bucket_math_path and not _is_numeric_literal(node.right):
+            if isinstance(node.op, ast.LShift) \
+                    and self._const_int(node.left, 1):
+                self._t02(node, "1 << <computed>")
+            elif isinstance(node.op, ast.Pow) \
+                    and self._const_int(node.left, 2):
+                self._t02(node, "2 ** <computed>")
+        self.generic_visit(node)
+
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         if self.serving:
             self._check_live_mutation(node.target)
+        # TX-T02: `b *= 2` / `b <<= 1` doubling loops grow a pow2
+        # ladder in place — same forked-ladder hazard as `1 << n`
+        if self.bucket_math_path and (
+                (isinstance(node.op, ast.Mult)
+                 and self._const_int(node.value, 2))
+                or (isinstance(node.op, ast.LShift)
+                    and self._const_int(node.value, 1))):
+            self._t02(node, "<row count> *= 2")
         self.generic_visit(node)
 
     def visit_Delete(self, node: ast.Delete) -> None:

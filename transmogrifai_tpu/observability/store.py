@@ -261,6 +261,36 @@ class ProfileStore:
             self._compact(profiles, now)
             return self._write(state)
 
+    # -- occupancy histograms (rows per dispatch, pre-padding) -------------
+    def record_occupancy(self, hists: Dict[str, Dict[int, int]]) -> bool:
+        """Accumulate ``{namespace: {real_rows: dispatches}}`` under
+        the ``occupancy`` block — the padded cost records can never
+        recover the real batch-size distribution, and the lattice
+        chooser (tuning/lattice.py) needs exactly that."""
+        if not any(h for h in (hists or {}).values()):
+            return True
+        with _merge_lock(self.path):
+            state = self.load()
+            occ = state.setdefault("occupancy", {})
+            for ns, hist in hists.items():
+                dst = occ.setdefault(str(ns), {})
+                for size, count in hist.items():
+                    key = str(int(size))
+                    dst[key] = int(dst.get(key, 0)) + int(count)
+            return self._write(state)
+
+    def occupancy(self, namespace: str = "score") -> Dict[int, int]:
+        """Cross-run rows-per-dispatch histogram for one namespace."""
+        block = self.load().get("occupancy", {}).get(namespace, {})
+        out: Dict[int, int] = {}
+        if isinstance(block, dict):
+            for size, count in block.items():
+                try:
+                    out[int(size)] = int(count)
+                except (TypeError, ValueError):
+                    continue
+        return out
+
     def profiles(self, prefix: str = "") -> Dict[str, dict]:
         """Real (non-reserved) profile records; ``_schema`` and
         ``_compacted`` are internal — read them via :meth:`meta`."""
@@ -403,5 +433,12 @@ def persist_process_profiles(path: Optional[str] = None
         from ..analysis.audit import process_ir_features
         store.record_ir_features(process_ir_features())
     except Exception:  # pragma: no cover - analysis layer optional
+        pass
+    try:
+        # real rows-per-dispatch histograms (plans/common.py
+        # record_rows): the occupancy side of the lattice decision
+        from ..plans.common import row_histograms
+        store.record_occupancy(row_histograms())
+    except Exception:  # pragma: no cover - plans not imported yet
         pass
     return records

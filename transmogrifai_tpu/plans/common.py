@@ -32,11 +32,15 @@ from ..stages.base import Transformer
 from ..types import Prediction
 
 __all__ = ["DEFAULT_MIN_BUCKET", "DEFAULT_MAX_BUCKET", "bucket_for",
-           "pad_rows", "PlanCompileError", "PlanStep", "PlanCoverage",
+           "pad_rows", "default_lattice", "normalize_lattice",
+           "record_rows", "row_histogram", "row_histograms",
+           "PlanCompileError", "PlanStep", "PlanCoverage",
            "empty_raw_dataset", "probe_stage", "lowering_reason",
            "fallback_reason", "record_compile", "compiles", "plan_seq",
            "bucket_section", "bucket_profile"]
 
+from ..tuning.lattice import (bucket_for_lattice, default_lattice,
+                              normalize_lattice)
 from ..tuning.registry import STATIC_DEFAULTS as _TUNABLES
 
 #: smallest padded batch — single-record requests share one program
@@ -69,13 +73,42 @@ def compiles(namespace: str) -> int:
 
 
 def bucket_for(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
-               max_bucket: int = DEFAULT_MAX_BUCKET) -> int:
-    """Smallest power-of-two bucket >= n (clamped to the bucket range);
-    n beyond the largest bucket is the caller's cue to chunk."""
+               max_bucket: int = DEFAULT_MAX_BUCKET,
+               lattice: Optional[Sequence[int]] = None) -> int:
+    """Smallest bucket >= n on the plan's lattice (clamped to the
+    bucket range); n beyond the largest bucket is the caller's cue to
+    chunk. With no explicit ``lattice`` the default power-of-two
+    ladder applies — bitwise the historical doubling behavior."""
+    if lattice:
+        return bucket_for_lattice(n, lattice)
     b = min_bucket
     while b < n and b < max_bucket:
         b *= 2
     return min(b, max_bucket)
+
+
+#: process-local occupancy histograms: {namespace: {real_rows: calls}}
+#: — the raw material the lattice chooser (tuning/lattice.py) needs;
+#: the power-of-two padding in cost records destroys exactly this
+#: information, so it is recorded separately at dispatch.
+_ROW_HIST: Dict[str, Dict[int, int]] = {}
+
+
+def record_rows(namespace: str, rows: int) -> None:
+    """Record one dispatch's REAL (pre-padding) row count."""
+    h = _ROW_HIST.setdefault(namespace, {})
+    r = int(rows)
+    h[r] = h.get(r, 0) + 1
+
+
+def row_histogram(namespace: str) -> Dict[int, int]:
+    """This process's recorded rows-per-dispatch histogram."""
+    return dict(_ROW_HIST.get(namespace, {}))
+
+
+def row_histograms() -> Dict[str, Dict[int, int]]:
+    """All namespaces' histograms (what the ProfileStore persists)."""
+    return {ns: dict(h) for ns, h in _ROW_HIST.items() if h}
 
 
 def pad_rows(arr, bucket: int):

@@ -67,8 +67,9 @@ from ..types import Prediction
 from ..utils import compile_time
 from .common import (DEFAULT_MIN_BUCKET, PlanCompileError, PlanCoverage,
                      PlanStep, bucket_for, compiles, empty_raw_dataset,
-                     fallback_reason, lowering_reason, pad_rows, plan_seq,
-                     probe_stage, record_compile)
+                     fallback_reason, lowering_reason, normalize_lattice,
+                     pad_rows, plan_seq, probe_stage, record_compile,
+                     record_rows)
 from .placement import PlacementPolicy
 
 _log = logging.getLogger(__name__)
@@ -204,10 +205,22 @@ class PreparePlan:
     def __init__(self, result_features: Sequence[Feature],
                  min_bucket: int = DEFAULT_MIN_BUCKET,
                  max_bucket: int = DEFAULT_PREPARE_MAX_BUCKET,
-                 listener=None, placement: Optional[PlacementPolicy] = None):
+                 listener=None, placement: Optional[PlacementPolicy] = None,
+                 lattice: Optional[Sequence[int]] = None):
         self.result_features = tuple(result_features)
-        self.min_bucket = int(min_bucket)
-        self.max_bucket = int(max_bucket)
+        #: explicit bucket lattice — None keeps the default
+        #: power-of-two ladder bitwise; a lattice overrides the range
+        #: args (its first/last rungs become min/max), and joins the
+        #: cross-train segment signature so cached programs never mix
+        #: lattices
+        self.lattice: Optional[Tuple[int, ...]] = \
+            normalize_lattice(lattice) if lattice else None
+        if self.lattice:
+            self.min_bucket = self.lattice[0]
+            self.max_bucket = self.lattice[-1]
+        else:
+            self.min_bucket = int(min_bucket)
+            self.max_bucket = int(max_bucket)
         if self.min_bucket < 1 or self.max_bucket < self.min_bucket:
             raise ValueError(
                 f"bad bucket range [{min_bucket}, {max_bucket}]")
@@ -283,6 +296,7 @@ class PreparePlan:
                 round(self.device_transform_seconds, 4),
             "host_transform_seconds":
                 round(self.host_transform_seconds, 4),
+            "lattice": list(self.lattice) if self.lattice else None,
         }
 
     # -- transform classification ------------------------------------------
@@ -525,7 +539,9 @@ class PreparePlan:
                 stop = min(start + self.max_bucket, n)
                 rows = stop - start
                 bucket = bucket_for(rows, self.min_bucket,
-                                    self.max_bucket)
+                                    self.max_bucket,
+                                    lattice=self.lattice)
+                record_rows("prepare", rows)
                 if bucket not in seg_buckets:
                     seg_buckets.append(bucket)
                 inputs = tuple(pad_rows(arr[start:stop], bucket)
@@ -594,7 +610,8 @@ class PreparePlan:
             if fp is None:
                 return None     # unfingerprintable: no cross-train reuse
             parts.append((type(stage).__name__, fp, in_pos))
-        return (tuple(parts), k_in, self.min_bucket, self.max_bucket)
+        return (tuple(parts), k_in, self.min_bucket, self.max_bucket,
+                self.lattice)
 
     def _wrap_output(self, name: str, arr) -> FeatureColumn:
         """Wrap a device output as the column the numpy path would have

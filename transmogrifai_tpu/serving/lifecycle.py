@@ -188,7 +188,10 @@ class ModelLifecycle:
         gen = self.last_generation = next(self._generations)
         self._note("detect", counter="lifecycle_detect", model=name,
                    tenant=tenant, generation=gen)
-        entry = self.server.plans.entry_for(name, tenant)
+        entry = self.server.plans.entry_for(
+            name, tenant,
+            buckets=getattr(self.server, "plan_buckets", (None, None)),
+            lattice=getattr(self.server, "plan_lattice", None))
         self._pool.submit(self._heal, key, entry, gen)
 
     # -- the heal cycle (lifecycle worker thread) --------------------------
@@ -247,8 +250,11 @@ class ModelLifecycle:
                              policy=cfg.swap_policy):
                 new_entry = self._build_entry(key, result.model, ring)
                 scope = tenant if cfg.swap_policy == "tenant" else None
-                self.server.plans.swap_entry(name, new_entry,
-                                             tenant=scope)
+                self.server.plans.swap_entry(
+                    name, new_entry, tenant=scope,
+                    buckets=getattr(self.server, "plan_buckets",
+                                    (None, None)),
+                    lattice=getattr(self.server, "plan_lattice", None))
         except Exception as e:
             # a candidate that cannot compile/prewarm is REJECTED like
             # a canary failure — the classified reason is recorded and
@@ -327,7 +333,16 @@ class ModelLifecycle:
         # everything post-swap stays at ZERO serve-process compiles —
         # plan_compiles() is flat across a swap
         # (tests/test_aot_artifacts.py asserts it)
-        plan = load_or_compile(candidate)
+        kwargs = {}
+        pb = getattr(self.server, "plan_buckets", (None, None))
+        if pb[0] is not None:
+            kwargs["min_bucket"] = pb[0]
+        if pb[1] is not None:
+            kwargs["max_bucket"] = pb[1]
+        lat = getattr(self.server, "plan_lattice", None)
+        if lat is not None:
+            kwargs["lattice"] = lat
+        plan = load_or_compile(candidate, **kwargs)
         self._prewarm(plan, ring)
         entry = _CacheEntry(
             model=candidate, plan=plan,
@@ -408,8 +423,10 @@ class ModelLifecycle:
                   reason: str) -> None:
         name, tenant = key
         t0 = time.monotonic()
-        restored = self.server.plans.rollback(name,
-                                              tenant=watch["scope"])
+        restored = self.server.plans.rollback(
+            name, tenant=watch["scope"],
+            buckets=getattr(self.server, "plan_buckets", (None, None)),
+            lattice=getattr(self.server, "plan_lattice", None))
         with self._lock:
             self._watch.pop(key, None)
         self._note("rollback", counter="lifecycle_rollbacks",

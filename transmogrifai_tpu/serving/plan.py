@@ -37,7 +37,8 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import (Any, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -51,8 +52,9 @@ from ..plans.common import (DEFAULT_MAX_BUCKET, DEFAULT_MIN_BUCKET,
                             bucket_section as _bucket_section, compiles,
                             empty_raw_dataset as _empty_raw_dataset,
                             fallback_reason as _shared_fallback_reason,
+                            default_lattice, normalize_lattice,
                             pad_rows as _pad_rows, plan_seq,
-                            record_compile)
+                            record_compile, record_rows)
 from ..observability import trace as _trace
 from ..runtime import telemetry as _telemetry
 from ..runtime.faults import maybe_inject
@@ -102,10 +104,21 @@ class ScoringPlan:
 
     def __init__(self, model, min_bucket: int = DEFAULT_MIN_BUCKET,
                  max_bucket: int = DEFAULT_MAX_BUCKET,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 lattice: Optional[Sequence[int]] = None):
         self.model = model
-        self.min_bucket = int(min_bucket)
-        self.max_bucket = int(max_bucket)
+        #: explicit bucket lattice (tuning/lattice.py choose_lattice)
+        #: — None keeps the default power-of-two ladder over
+        #: [min_bucket, max_bucket] bitwise; a lattice overrides the
+        #: range args (its first/last rungs become min/max)
+        self.lattice: Optional[Tuple[int, ...]] = \
+            normalize_lattice(lattice) if lattice else None
+        if self.lattice:
+            self.min_bucket = self.lattice[0]
+            self.max_bucket = self.lattice[-1]
+        else:
+            self.min_bucket = int(min_bucket)
+            self.max_bucket = int(max_bucket)
         if self.min_bucket < 1 or self.max_bucket < self.min_bucket:
             raise ValueError(
                 f"bad bucket range [{min_bucket}, {max_bucket}]")
@@ -634,7 +647,8 @@ class ScoringPlan:
         for start in range(0, max(n, 1), self.max_bucket):
             stop = min(start + self.max_bucket, n)
             rows = stop - start
-            bucket = bucket_for(rows, self.min_bucket, self.max_bucket)
+            bucket = bucket_for(rows, self.min_bucket, self.max_bucket,
+                                lattice=self.lattice)
             inputs = tuple(_pad_rows(arr[start:stop], bucket)
                            for _, arr in encoded)
             mask = np.zeros(bucket, dtype=np.float64)
@@ -665,6 +679,9 @@ class ScoringPlan:
                     record_compile("score", (self._plan_id, bucket))
                 self._bucket_rows[bucket] = \
                     self._bucket_rows.get(bucket, 0) + rows
+                # real (pre-padding) rows: the occupancy histogram the
+                # lattice chooser trains on (plans/common.record_rows)
+                record_rows("score", rows)
                 # the bucket section reports into the span as a child
                 # carrying the per-bucket compile/execute split
                 # (utils/compile_time section observer)
@@ -679,9 +696,12 @@ class ScoringPlan:
         """Observed per-bucket dispatch cost of THIS plan:
         ``{bucket: {calls, wall_seconds, compile_seconds,
         execute_seconds, rows}}`` (plans/common.bucket_profile over
-        utils/compile_time sections). The serving coalescer
-        (serving/server.py) reads this to pick its deadline-or-full
-        target bucket from recorded data; bench emits it."""
+        utils/compile_time sections). Lattice-aware by construction:
+        keys are the buckets ACTUALLY dispatched (whatever rungs this
+        plan's lattice has) and ``rows`` is the real pre-padding row
+        count per bucket — nothing assumes a power-of-two ladder. The
+        serving coalescer (serving/server.py) reads this to pick its
+        dispatch target from recorded data; bench emits it."""
         return _shared_bucket_profile("score", self._plan_id,
                                       self._bucket_rows)
 
@@ -797,15 +817,16 @@ class ScoringPlan:
             "host_inputs": [k for k, _, _ in self._host_inputs],
             "device_outputs": list(self._device_outputs),
             "buckets": self.buckets(),
+            "lattice": list(self.lattice) if self.lattice else None,
         }
 
     def buckets(self) -> List[int]:
-        out, b = [], self.min_bucket
-        while b < self.max_bucket:
-            out.append(b)
-            b *= 2
-        out.append(self.max_bucket)
-        return out
+        """The plan's bucket ladder: the explicit lattice when one was
+        chosen, else the default power-of-two ladder (identical values
+        to the historical doubling loop)."""
+        if self.lattice:
+            return list(self.lattice)
+        return list(default_lattice(self.min_bucket, self.max_bucket))
 
     def device_input_avals(self, bucket: int):
         """The abstract inputs of one bucket's device program:

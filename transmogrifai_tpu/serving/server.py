@@ -146,6 +146,13 @@ class ServeConfig:
     #: no controller — the enqueue edge, dispatch semaphore and every
     #: answer are byte-identical to a build without docs/admission.md
     admission_control: Optional[AdmissionConfig] = None
+    #: coalescer split policy (docs/ragged_batching.md):
+    #: "deadline_or_full" (the classic rule) or "predicted_cost"
+    #: (split a popped batch at a lattice rung when the cost model
+    #: predicts the smaller dispatch is cheaper per row); None defers
+    #: to the tuning policy, which only upgrades off the default when
+    #: a tuned lattice AND recorded score costs exist
+    coalesce_policy: Optional[str] = None
 
 
 @dataclass
@@ -236,15 +243,27 @@ class PlanCache:
     def names(self) -> List[str]:
         return sorted(self._loaders)
 
+    @staticmethod
+    def _key(name: str, buckets: Tuple[int, int],
+             lattice: Optional[Tuple[int, ...]]) -> Tuple:
+        """Cache key. With ``lattice=None`` the key is EXACTLY the
+        pre-lattice ``(name, buckets)`` shape, so cold starts, warm
+        restarts (serving/state.py) and every existing snapshot keep
+        resolving the same entries bitwise."""
+        if lattice is None:
+            return (name, buckets)
+        return (name, buckets, tuple(int(b) for b in lattice))
+
     def get(self, name: str,
-            buckets: Tuple[int, int] = (None, None)) -> _CacheEntry:
+            buckets: Tuple[int, int] = (None, None),
+            lattice: Optional[Tuple[int, ...]] = None) -> _CacheEntry:
         """Resident entry for ``name`` (LRU-bumped), loading the model
         and compiling its plan on a miss. Blocking — call from an
         executor, never from the event loop."""
         if name not in self._loaders:
             raise ServeRejected(f"unknown model {name!r}; registered: "
                                 f"{self.names()}")
-        key = (name, buckets)
+        key = self._key(name, buckets, lattice)
         entry = self._entries.get(key)
         if entry is not None:
             self._entries.move_to_end(key)
@@ -264,6 +283,8 @@ class PlanCache:
             kwargs["min_bucket"] = buckets[0]
         if buckets[1] is not None:
             kwargs["max_bucket"] = buckets[1]
+        if lattice is not None:
+            kwargs["lattice"] = lattice
         # artifact-first compile (artifacts/loader.py, TX-R06): a
         # saved model's AOT executables deserialize instead of
         # compiling — a cache MISS (boot or eviction reload) costs a
@@ -286,7 +307,8 @@ class PlanCache:
 
     # -- hot-swap (the ONLY sanctioned live replacement, TX-R03) -----------
     def entry_for(self, name: str, tenant: str,
-                  buckets: Tuple[int, int] = (None, None)
+                  buckets: Tuple[int, int] = (None, None),
+                  lattice: Optional[Tuple[int, ...]] = None
                   ) -> _CacheEntry:
         """Tenant-aware resolution: a tenant-scoped swapped-in entry
         wins; every other tenant resolves the shared LRU entry —
@@ -297,11 +319,12 @@ class PlanCache:
             self.hits += 1
             _telemetry.count("serve_plan_cache_hits")
             return override
-        return self.get(name, buckets)
+        return self.get(name, buckets, lattice)
 
     def swap_entry(self, name: str, new_entry: _CacheEntry,
                    tenant: Optional[str] = None,
-                   buckets: Tuple[int, int] = (None, None)) -> None:
+                   buckets: Tuple[int, int] = (None, None),
+                   lattice: Optional[Tuple[int, ...]] = None) -> None:
         """Atomically replace the live entry for ``name`` (one dict
         assignment — batches already holding the old entry finish on
         it; the next ``entry_for`` resolves ``new_entry``). The
@@ -317,7 +340,7 @@ class PlanCache:
                 (name, tenant), _NO_OVERRIDE)
             self._overrides[(name, tenant)] = new_entry
         else:
-            key = (name, buckets)
+            key = self._key(name, buckets, lattice)
             self._pinned[(name, None)] = self._entries.get(key)
             self._entries[key] = new_entry
         _telemetry.count("serve_plan_swaps")
@@ -325,7 +348,8 @@ class PlanCache:
                          tenant=tenant or "*")
 
     def rollback(self, name: str, tenant: Optional[str] = None,
-                 buckets: Tuple[int, int] = (None, None)) -> bool:
+                 buckets: Tuple[int, int] = (None, None),
+                 lattice: Optional[Tuple[int, ...]] = None) -> bool:
         """Instantly restore the entry pinned by the last
         :meth:`swap_entry` for this scope. Returns False when nothing
         is pinned (already committed or never swapped)."""
@@ -333,15 +357,16 @@ class PlanCache:
         if pin not in self._pinned:
             return False
         prev = self._pinned.pop(pin)
+        key = self._key(name, buckets, lattice)
         if tenant is not None:
             if prev is _NO_OVERRIDE:
                 self._overrides.pop((name, tenant), None)
             else:
                 self._overrides[(name, tenant)] = prev
         elif prev is not None:
-            self._entries[(name, buckets)] = prev
+            self._entries[key] = prev
         else:
-            self._entries.pop((name, buckets), None)
+            self._entries.pop(key, None)
         return True
 
     def commit(self, name: str, tenant: Optional[str] = None) -> None:
@@ -360,11 +385,12 @@ class PlanCache:
         return list(self._entries.items())
 
     def touch(self, name: str,
-              buckets: Tuple[int, int] = (None, None)) -> bool:
+              buckets: Tuple[int, int] = (None, None),
+              lattice: Optional[Tuple[int, ...]] = None) -> bool:
         """LRU-bump a resident entry without resolving it (no
         hit/miss accounting) — how a warm restart replays the
         snapshot's recorded LRU order (serving/state.py)."""
-        key = (name, buckets)
+        key = self._key(name, buckets, lattice)
         if key not in self._entries:
             return False
         self._entries.move_to_end(key)
@@ -507,6 +533,27 @@ class ServingServer:
             (lo_d.chosen, hi_d.chosen)
             if (lo_d.tuned() or hi_d.tuned()) else (None, None))
         self._bucket_decisions = (lo_d, hi_d)
+        #: padding-aware ragged batching (docs/ragged_batching.md):
+        #: the tuning policy's per-plan bucket LATTICE, chosen from the
+        #: recorded occupancy histogram × predicted per-bucket cost.
+        #: Untuned (cold store / TX_TUNE=off / no improvement found)
+        #: => None, and every plan + cache key stays bitwise the
+        #: power-of-two build.
+        self._lattice_decision = self.tuning.bucket_lattice(
+            min_bucket=self.plan_buckets[0],
+            max_bucket=self.plan_buckets[1])
+        self.plan_lattice: Optional[Tuple[int, ...]] = (
+            tuple(int(b) for b in self._lattice_decision.chosen)
+            if self._lattice_decision.tuned() else None)
+        #: coalescer split policy: caller (ServeConfig) wins, then an
+        #: override pin, then the model (which only proposes
+        #: "predicted_cost" when the lattice itself tuned)
+        self._coalesce_decision = self.tuning.coalesce_policy(
+            caller=self.config.coalesce_policy,
+            lattice_tuned=self._lattice_decision.tuned())
+        self.coalesce_policy = str(self._coalesce_decision.chosen)
+        #: split dispatches taken by the predicted-cost coalescer
+        self.stats.setdefault("split_dispatches", 0)
         #: overload admission (docs/admission.md) — None when
         #: ``config.admission_control`` is None: every path below
         #: byte-identical to a build without the controller
@@ -572,7 +619,8 @@ class ServingServer:
         for name in (names if names is not None
                      else self.plans.names()):
             try:
-                entry = self.plans.get(name, self.plan_buckets)
+                entry = self.plans.get(name, self.plan_buckets,
+                                       self.plan_lattice)
             except Exception as e:  # pragma: no cover - bad loader
                 from ..runtime.errors import classify_error
                 _telemetry.event("serve_prewarm_failed", model=name,
@@ -734,12 +782,53 @@ class ServingServer:
             except asyncio.TimeoutError:
                 break
         n = min(len(lane.queue), self.config.max_batch)
+        if self.coalesce_policy == "predicted_cost":
+            k = self._coalesce_pop_count(n)
+            if k < n:
+                # split: the leftover stays queued (its deadline is
+                # its own arrival time, so no request waits longer
+                # than max_wait_ms) and this dispatch pads less
+                self.stats["split_dispatches"] += 1
+                _telemetry.count("serve_split_dispatches")
+                n = k
         batch = [lane.queue.popleft() for _ in range(n)]
         key = ("full_dispatches" if n >= lane.target
                else "deadline_dispatches")
         self.stats[key] += 1
         _telemetry.count(f"serve_{key}")
         return batch
+
+    def _coalesce_pop_count(self, n: int) -> int:
+        """Predicted-cost split rule (docs/ragged_batching.md): pop
+        ``k <= n`` where ``k`` is the largest lattice rung <= n IF the
+        cost model predicts the rung's per-row execute cost beats
+        dispatching all ``n`` rows at their (larger, padded) rung.
+        Unknown costs or no lattice => ``n`` (the classic rule)."""
+        if n < 2 or not self.plan_lattice:
+            return n
+        rungs = [b for b in self.plan_lattice
+                 if b <= min(n, self.config.max_batch)]
+        if not rungs:
+            return n
+        k = rungs[-1]
+        if k >= n:
+            return n
+        model = getattr(self.tuning, "model", None)
+        if model is None:
+            return n
+        up = next((b for b in self.plan_lattice if b >= n), None)
+        if up is None:
+            return n
+        full = model.predict("score", bucket=int(up))
+        part = model.predict("score", bucket=int(k))
+        if full.execute is None or part.execute is None:
+            return n
+        # per-real-row cost of dispatching n rows padded to `up` vs
+        # k rows exactly at rung `k` (leftover pays its own dispatch
+        # later — charge it the same rate as the k-row dispatch)
+        if part.execute / k < full.execute / n:
+            return k
+        return n
 
     async def _lane_loop(self, lane: _Lane) -> None:
         """One lane's collector: coalesce -> host-encode (encode pool)
@@ -793,7 +882,8 @@ class ServingServer:
         reasons, raw-Dataset boxing, and bucket encode/padding."""
         marks = {"encode_t0": time.monotonic()}
         entry = self.plans.entry_for(lane.model_name, lane.tenant,
-                                     buckets=self.plan_buckets)
+                                     buckets=self.plan_buckets,
+                                     lattice=self.plan_lattice)
         guards = entry.guards.get(lane.tenant)
         if guards is None:
             guards = entry.guards[lane.tenant] = _TenantGuards(

@@ -102,7 +102,8 @@ class ServingStateSnapshot:
     def capture(cls, server) -> "ServingStateSnapshot":
         snap = cls(written_at=time.time(),
                    restart_generation=server.restart_generation)
-        for (name, _buckets), entry in server.plans.resident_entries():
+        for key, entry in server.plans.resident_entries():
+            name = key[0]
             plan = entry.plan
             warm = sorted(b for b, rec in plan.bucket_profile().items()
                           if rec.get("calls", 0) > 0)
@@ -200,7 +201,8 @@ class ServingStateSnapshot:
                                             "model")
                     continue
             entry = server.plans.get(
-                name, getattr(server, "plan_buckets", (None, None)))
+                name, getattr(server, "plan_buckets", (None, None)),
+                getattr(server, "plan_lattice", None))
             # artifact-manifest continuity: a warm restart that lands
             # on a different (or no) artifact store than the previous
             # incarnation is loud — the model dir changed under us
@@ -250,7 +252,9 @@ class ServingStateSnapshot:
                                 - max(br.cooldown_seconds - remaining,
                                       0.0))
         for name in self.lru:
-            server.plans.touch(name)
+            server.plans.touch(
+                name, getattr(server, "plan_buckets", (None, None)),
+                getattr(server, "plan_lattice", None))
         if self.lifecycle is not None and server.lifecycle is not None:
             server.lifecycle.load_state(self.lifecycle)
         for k, v in self.counters.items():

@@ -9,10 +9,15 @@ and prepare (fit placement seed/margin). ``tx tune`` inspects and
 pins every decision; ``TX_TUNE=off`` or an empty store yields the
 static defaults bitwise (tuning/registry.py owns those numbers).
 """
+from .lattice import (LatticeChoice, bucket_for_lattice, choose_lattice,
+                      default_lattice, normalize_lattice)
 from .model import CostModel, CostEstimate
+from .model_v2 import LEARNED, CostModelV2
 from .policy import TuningDecision, TuningPolicy, tuning_enabled
 from .registry import KNOBS, STATIC_DEFAULTS, static_default
 
-__all__ = ["CostModel", "CostEstimate", "TuningDecision",
+__all__ = ["CostModel", "CostModelV2", "CostEstimate", "LEARNED",
+           "LatticeChoice", "bucket_for_lattice", "choose_lattice",
+           "default_lattice", "normalize_lattice", "TuningDecision",
            "TuningPolicy", "tuning_enabled", "KNOBS",
            "STATIC_DEFAULTS", "static_default"]

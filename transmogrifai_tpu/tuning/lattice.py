@@ -1,0 +1,285 @@
+"""Bucket lattices: the padded-batch shape sets plans dispatch on.
+
+The default lattice is the power-of-two ladder (8, 16, ... 8192): a 65-
+row batch pays 128 padded rows. This module makes the lattice a
+DECISION instead of a constant — :func:`choose_lattice` takes the
+recorded occupancy histogram (real rows per dispatch,
+``plans/common.py row_histogram`` persisted by the ProfileStore) times
+the cost model's predicted per-bucket dispatch/compile cost and emits a
+non-power-of-two lattice (monotone, deduplicated, bounded at
+``tuning.lattice_max_rungs`` rungs, deterministic) where traffic
+warrants, via an exact interval-partition dynamic program.
+
+Contract invariants:
+
+- the TOP rung is always ``max_bucket`` — batches beyond it chunk by
+  the top rung exactly as before, and the AOT artifact subset-coverage
+  check keeps working unchanged (ladder = the chosen lattice),
+- a tuned lattice is only returned when its predicted cost is STRICTLY
+  below the default power-of-two ladder's on the same histogram —
+  empty occupancy (cold start) always yields the default ladder,
+- everything is pure arithmetic over the inputs: same store, same
+  lattice, bitwise.
+
+This module is a LEAF like tuning/registry.py: stdlib only, importable
+from ``plans/common.py`` at module scope. It is (with plans/common.py)
+one of the two files where hand-rolled power-of-two bucket math is
+allowed — lint rule TX-T02 flags ``1 <<`` / ``2 **`` / ``*= 2`` row
+math anywhere else in the dispatch layers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .registry import STATIC_DEFAULTS as _TUNABLES
+
+__all__ = ["DEFAULT_LATTICE_MAX_RUNGS", "LatticeChoice",
+           "default_lattice", "normalize_lattice", "bucket_for_lattice",
+           "grow_pow2", "floor_pow2", "lattice_cost", "choose_lattice"]
+
+#: rung bound for tuned lattices (the default 8..8192 ladder has 11)
+DEFAULT_LATTICE_MAX_RUNGS = int(_TUNABLES["tuning.lattice_max_rungs"])
+
+
+def default_lattice(min_bucket: Optional[int] = None,
+                    max_bucket: Optional[int] = None) -> Tuple[int, ...]:
+    """The power-of-two ladder: doubles from ``min_bucket``, capped by
+    a final ``max_bucket`` rung (non-power-of-two caps clamp, exactly
+    the historical ``bucket_for`` behavior)."""
+    lo = int(_TUNABLES["serving.min_bucket"]
+             if min_bucket is None else min_bucket)
+    hi = int(_TUNABLES["serving.max_bucket"]
+             if max_bucket is None else max_bucket)
+    lo = max(lo, 1)
+    hi = max(hi, lo)
+    out: List[int] = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(out)
+
+
+def normalize_lattice(lattice: Sequence[int]) -> Tuple[int, ...]:
+    """Sorted, deduplicated, positive rungs — the canonical lattice
+    form every consumer (plans, artifacts, audit) stores."""
+    rungs = sorted({int(b) for b in lattice if int(b) >= 1})
+    if not rungs:
+        raise ValueError("a bucket lattice needs at least one rung >= 1")
+    return tuple(rungs)
+
+
+def bucket_for_lattice(n: int, lattice: Sequence[int]) -> int:
+    """Smallest rung >= n; n beyond the top rung returns the top rung —
+    the caller's cue to chunk (same contract as ``bucket_for``)."""
+    top = lattice[0]
+    for b in lattice:
+        top = b
+        if b >= n:
+            return b
+    return top
+
+
+def grow_pow2(start: int, bound: int) -> int:
+    """Smallest ``start * 2**k >= bound`` (k >= 0) — the ladder-growth
+    primitive ``TuningPolicy.bucket_range`` used to hand-roll."""
+    b = max(int(start), 1)
+    while b < bound:
+        b *= 2
+    return b
+
+
+def floor_pow2(x: float) -> int:
+    """Largest power of two <= x (minimum 1) — the admission-bound
+    sizing primitive."""
+    if x < 2:
+        return 1
+    return 1 << (int(x).bit_length() - 1)
+
+
+@dataclass(frozen=True)
+class LatticeChoice:
+    """The chooser's verdict: the lattice to use plus the predicted
+    cost (seconds when a cost model backed the choice, padded rows
+    under the linear proxy) both ways."""
+    lattice: Tuple[int, ...]
+    default: Tuple[int, ...]
+    predicted_cost: float
+    predicted_default_cost: float
+    modeled: bool              # True: costs are model seconds
+    reason: str
+
+    def tuned(self) -> bool:
+        return self.lattice != self.default
+
+    def to_json(self) -> dict:
+        return {"lattice": list(self.lattice),
+                "default": list(self.default),
+                "predictedCost": round(float(self.predicted_cost), 6),
+                "predictedDefaultCost":
+                    round(float(self.predicted_default_cost), 6),
+                "modeled": self.modeled, "tuned": self.tuned(),
+                "reason": self.reason}
+
+
+def _fold_occupancy(occupancy: Dict[int, int],
+                    max_bucket: int) -> Dict[int, int]:
+    """Clamp the recorded rows-per-dispatch histogram onto the bucket
+    range: sizes beyond ``max_bucket`` chunk (full top-rung pieces plus
+    the remainder), sizes below 1 drop."""
+    out: Dict[int, int] = {}
+    for size, count in occupancy.items():
+        s, c = int(size), int(count)
+        if s < 1 or c < 1:
+            continue
+        if s > max_bucket:
+            full, rem = divmod(s, max_bucket)
+            out[max_bucket] = out.get(max_bucket, 0) + full * c
+            if rem:
+                out[rem] = out.get(rem, 0) + c
+        else:
+            out[s] = out.get(s, 0) + c
+    return out
+
+
+def lattice_cost(lattice: Sequence[int], occupancy: Dict[int, int],
+                 exec_cost: Callable[[int], float],
+                 compile_cost: Callable[[int], float]) -> float:
+    """Predicted steady-state cost of serving ``occupancy`` on
+    ``lattice``: per-dispatch execute at each size's rung, plus one
+    compile per rung that actually serves traffic."""
+    used: Dict[int, int] = {}
+    total = 0.0
+    for size, count in sorted(occupancy.items()):
+        rung = bucket_for_lattice(size, lattice)
+        used[rung] = used.get(rung, 0) + count
+        total += count * float(exec_cost(rung))
+    for rung in used:
+        total += float(compile_cost(rung))
+    return total
+
+
+def choose_lattice(occupancy: Dict[int, int],
+                   min_bucket: Optional[int] = None,
+                   max_bucket: Optional[int] = None,
+                   max_rungs: Optional[int] = None,
+                   exec_cost: Optional[Callable[[int],
+                                                Optional[float]]] = None,
+                   compile_cost: Optional[Callable[[int],
+                                                   Optional[float]]] = None
+                   ) -> LatticeChoice:
+    """Pick the bucket lattice for a plan from its recorded occupancy
+    histogram and the cost model's per-bucket predictions.
+
+    Candidate rungs are the observed (clamped) dispatch sizes — with a
+    cost monotone in the padded row count, an optimal rung always sits
+    exactly on an observed size — plus the forced ``max_bucket`` top
+    rung. An interval-partition DP picks <= ``max_rungs`` rungs
+    minimizing
+
+        sum_sizes count(s) * exec_cost(rung(s))
+        + sum_{rungs serving traffic} compile_cost(rung)
+
+    When the model has no basis (``exec_cost=None``) the proxy is
+    padded rows (``exec_cost = rung``, ``compile_cost = 0``) — i.e.
+    minimize padding waste outright. The tuned lattice is returned only
+    when strictly cheaper than the default power-of-two ladder under
+    the SAME objective; otherwise (and on an empty histogram) the
+    default ladder comes back unchanged."""
+    lo = int(_TUNABLES["serving.min_bucket"]
+             if min_bucket is None else min_bucket)
+    hi = int(_TUNABLES["serving.max_bucket"]
+             if max_bucket is None else max_bucket)
+    lo = max(lo, 1)
+    hi = max(hi, lo)
+    cap = DEFAULT_LATTICE_MAX_RUNGS if max_rungs is None \
+        else max(int(max_rungs), 1)
+    dflt = default_lattice(lo, hi)
+
+    occ = _fold_occupancy(occupancy or {}, hi)
+    if not occ:
+        return LatticeChoice(dflt, dflt, 0.0, 0.0, False,
+                             "no recorded occupancy — default "
+                             "power-of-two ladder")
+
+    modeled = exec_cost is not None
+
+    def _exec(b: int) -> float:
+        if exec_cost is not None:
+            v = exec_cost(b)
+            if v is not None:
+                return max(float(v), 0.0)
+        return float(b)          # linear padded-rows proxy
+
+    def _comp(b: int) -> float:
+        if compile_cost is not None:
+            v = compile_cost(b)
+            if v is not None:
+                return max(float(v), 0.0)
+        return 0.0
+
+    # candidate rungs: observed sizes clamped to >= min_bucket, plus
+    # the forced top rung
+    cands = sorted({max(min(s, hi), lo) for s in occ} | {hi})
+    # per-candidate demand: every observed size maps to the smallest
+    # candidate >= it (clamped sizes land exactly on a candidate)
+    weight = [0] * len(cands)
+    for size, count in occ.items():
+        idx = next(i for i, c in enumerate(cands)
+                   if c >= min(max(size, lo), hi))
+        weight[idx] += count
+
+    k = len(cands)
+    inf = float("inf")
+    # f[m][i]: min cost covering candidates 0..i with m rungs, rung m-1
+    # at cands[i]; sizes between chosen rungs pay the NEXT rung up.
+    exec_at = [_exec(c) for c in cands]
+    comp_at = [_comp(c) for c in cands]
+    prefix = [0] * (k + 1)
+    for i in range(k):
+        prefix[i + 1] = prefix[i] + weight[i]
+    f = [[inf] * k for _ in range(min(cap, k) + 1)]
+    parent: Dict[Tuple[int, int], int] = {}
+    for i in range(k):
+        f[1][i] = (prefix[i + 1] - prefix[0]) * exec_at[i] \
+            + (comp_at[i] if prefix[i + 1] - prefix[0] else 0.0)
+    for m in range(2, min(cap, k) + 1):
+        for i in range(m - 1, k):
+            for j in range(m - 2, i):
+                if f[m - 1][j] == inf:
+                    continue
+                served = prefix[i + 1] - prefix[j + 1]
+                cost = f[m - 1][j] + served * exec_at[i] \
+                    + (comp_at[i] if served else 0.0)
+                if cost < f[m][i]:
+                    f[m][i] = cost
+                    parent[(m, i)] = j
+    best_m, best_cost = 0, inf
+    for m in range(1, min(cap, k) + 1):
+        if f[m][k - 1] < best_cost:
+            best_m, best_cost = m, f[m][k - 1]
+    rungs: List[int] = []
+    m, i = best_m, k - 1
+    while m >= 1:
+        rungs.append(cands[i])
+        i = parent.get((m, i), -1)
+        m -= 1
+    chosen = normalize_lattice(rungs)
+    if chosen[-1] != hi:                 # top rung is structural
+        chosen = normalize_lattice(chosen + (hi,))
+
+    dflt_cost = lattice_cost(dflt, occ, _exec, _comp)
+    tuned_cost = lattice_cost(chosen, occ, _exec, _comp)
+    if chosen == dflt or not tuned_cost < dflt_cost:
+        return LatticeChoice(
+            dflt, dflt, dflt_cost, dflt_cost, modeled,
+            "default power-of-two ladder already cost-optimal for the "
+            "recorded occupancy")
+    unit = "s predicted" if modeled else " padded rows"
+    return LatticeChoice(
+        chosen, dflt, tuned_cost, dflt_cost, modeled,
+        f"{len(chosen)}-rung lattice from {len(occ)} recorded dispatch "
+        f"shapes: {tuned_cost:.6g}{unit} vs {dflt_cost:.6g}{unit} on "
+        f"the power-of-two ladder")

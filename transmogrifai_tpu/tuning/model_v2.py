@@ -1,0 +1,267 @@
+"""Learned cost model v2: a closed-form ridge fit in log space over
+the ProfileStore's cost records × the plan auditor's IR features.
+
+The v1 model (tuning/model.py) interpolates recorded per-bucket costs
+in (log2 bucket, log seconds) space — it knows nothing about WHY a
+bucket costs what it does. PR 16's ``tx audit`` left the explanatory
+features on the same store rows (``ir``: op counts, fusion counts,
+constant/parameter/output bytes, per lowered bucket program), and "A
+Learned Performance Model for TPUs" (PAPERS.md) is the blueprint for
+using them: regress log per-call cost on the program features plus the
+bucket shape and recorded padding waste.
+
+No SGD, no new deps: the fit is the closed-form ridge solution
+``w = (XᵀX + λI)⁻¹ XᵀY`` over a handful of rows — deterministic for a
+given store snapshot. The prediction ladder per (namespace, bucket):
+
+- ``recorded``     — exact record exists: measured mean (unchanged),
+- ``learned``      — the ridge fit is trained (>= 4 feature-complete
+                     records) and confident (mean absolute log-space
+                     training residual <= 0.35, i.e. ~40% relative):
+                     features for the unseen bucket are synthesized
+                     from the nearest recorded bucket with the
+                     row-proportional byte features rescaled,
+- ``interpolated`` — below the confidence floor: the v1 table,
+- ``default``      — empty namespace: caller falls back to statics.
+
+:func:`CostModelV2.prediction_error_report` computes the
+leave-one-out error of each tier against the recorded truths — the
+per-tier drift block every bench run persists into BENCH_STATE.json.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .model import (DEFAULT, INTERPOLATED, RECORDED, CostEstimate,
+                    CostModel, _per_call)
+
+__all__ = ["CostModelV2", "LEARNED", "prediction_error_report"]
+
+LEARNED = "learned"
+
+#: ridge regularizer — small enough not to bias the tiny fits, big
+#: enough to keep near-collinear feature columns solvable
+_RIDGE_LAMBDA = 1e-3
+#: minimum feature-complete records before the fit activates
+_MIN_TRAIN_RECORDS = 4
+#: confidence floor: mean |log-residual| above this (≈40% relative
+#: error on the training rows) falls back to the v1 interpolation
+_RESIDUAL_FLOOR = 0.35
+
+_EPS = 1e-9
+_BUCKET_KEY = re.compile(r"^(?P<ns>.+):b(?P<bucket>\d+)$")
+
+#: IR feature fields that scale with the padded row count (parameter
+#: and output buffers are row-major over the batch axis); op/fusion
+#: counts and constants are shape-independent facts about the program
+_ROW_SCALED = ("parameter_bytes", "output_bytes")
+_COPIED = ("ops", "fusions", "constant_bytes")
+
+
+def _feature_row(bucket: int, ir: Dict[str, float],
+                 waste: float) -> List[float]:
+    """[1, log2 bucket, log1p ops, log1p fusions, log1p param bytes,
+    log1p const bytes, log1p output bytes, log waste] — log space
+    end-to-end so the power-law cost surface is near-linear."""
+    return [1.0,
+            math.log2(max(int(bucket), 1)),
+            math.log1p(max(float(ir.get("ops", 0) or 0), 0.0)),
+            math.log1p(max(float(ir.get("fusions", 0) or 0), 0.0)),
+            math.log1p(max(float(ir.get("parameter_bytes", 0) or 0),
+                           0.0)),
+            math.log1p(max(float(ir.get("constant_bytes", 0) or 0),
+                           0.0)),
+            math.log1p(max(float(ir.get("output_bytes", 0) or 0), 0.0)),
+            math.log(max(float(waste), 1.0))]
+
+
+def _record_waste(bucket: int, rec: dict) -> float:
+    """Recorded padding waste of one row: padded/real rows (1.0 when
+    the store has no row accounting for the key)."""
+    calls = int(rec.get("calls", 0) or 0)
+    rows = int(rec.get("rows", 0) or 0)
+    if calls < 1 or rows < 1:
+        return 1.0
+    return max(float(bucket) * calls / rows, 1.0)
+
+
+class _Fit:
+    """One namespace's trained ridge: weights + the recorded feature
+    rows the unseen-bucket synthesis borrows from."""
+
+    def __init__(self, weights: np.ndarray, residual: float,
+                 by_bucket: Dict[int, Tuple[Dict[str, float], float]],
+                 n: int):
+        self.weights = weights          # (d, 3): wall, compile, execute
+        self.residual = residual        # mean |log-residual| (execute)
+        self.by_bucket = by_bucket      # bucket -> (ir, waste)
+        self.n = n
+
+    def confident(self) -> bool:
+        return self.residual <= _RESIDUAL_FLOOR
+
+    def predict(self, bucket: int) -> Tuple[float, float, float]:
+        """Synthesize the unseen bucket's features from the nearest
+        recorded bucket (row-proportional bytes rescaled), then apply
+        the fit."""
+        near = min(self.by_bucket,
+                   key=lambda b: (abs(math.log2(max(bucket, 1))
+                                      - math.log2(b)), b))
+        ir, waste = self.by_bucket[near]
+        scale = float(bucket) / float(near)
+        synth = {f: ir.get(f, 0) for f in _COPIED}
+        for f in _ROW_SCALED:
+            synth[f] = float(ir.get(f, 0) or 0) * scale
+        x = np.asarray(_feature_row(bucket, synth, waste))
+        wall, comp, execute = (float(math.exp(v))
+                               for v in x @ self.weights)
+        return wall, comp, execute
+
+
+class CostModelV2(CostModel):
+    """The v1 snapshot reader plus the learned tier. Drop-in: every v1
+    query keeps its answer for recorded keys; only the *unrecorded*
+    bucket predictions upgrade from interpolation to the ridge fit
+    (and only above the confidence floor)."""
+
+    def __init__(self, profiles: Dict[str, dict]):
+        super().__init__(profiles)
+        self._fits: Dict[str, Optional[_Fit]] = {}
+
+    # -- training ----------------------------------------------------------
+    def _training_rows(self, namespace: str
+                       ) -> List[Tuple[int, dict, dict]]:
+        """(bucket, record, ir) for every feature-complete record of
+        the namespace: measured calls AND audited IR features."""
+        prefix = f"{namespace}:b"
+        rows = []
+        for key, rec in self.records.items():
+            if not key.startswith(prefix):
+                continue
+            tail = key[len(prefix):]
+            if not tail.isdigit():
+                continue
+            ir = rec.get("ir")
+            if not isinstance(ir, dict) or _per_call(rec) is None:
+                continue
+            rows.append((int(tail), rec, ir))
+        rows.sort(key=lambda r: r[0])
+        return rows
+
+    def fit_for(self, namespace: str) -> Optional[_Fit]:
+        """Train (once per snapshot) the namespace's ridge; None below
+        the record floor — the caller falls back to v1."""
+        if namespace in self._fits:
+            return self._fits[namespace]
+        rows = self._training_rows(namespace)
+        fit: Optional[_Fit] = None
+        if len(rows) >= _MIN_TRAIN_RECORDS:
+            X, Y, by_bucket = [], [], {}
+            for bucket, rec, ir in rows:
+                wall, comp, execute, _calls = _per_call(rec)
+                waste = _record_waste(bucket, rec)
+                X.append(_feature_row(bucket, ir, waste))
+                Y.append([math.log(max(wall, _EPS)),
+                          math.log(max(comp, _EPS)),
+                          math.log(max(execute, _EPS))])
+                by_bucket[bucket] = (dict(ir), waste)
+            Xm = np.asarray(X, dtype=np.float64)
+            Ym = np.asarray(Y, dtype=np.float64)
+            d = Xm.shape[1]
+            w = np.linalg.solve(Xm.T @ Xm + _RIDGE_LAMBDA * np.eye(d),
+                                Xm.T @ Ym)
+            resid = float(np.mean(np.abs(Xm @ w - Ym)[:, 2]))
+            fit = _Fit(w, resid, by_bucket, len(rows))
+        self._fits[namespace] = fit
+        return fit
+
+    # -- prediction (overrides the v1 bucket path) -------------------------
+    def _predict_bucket(self, namespace: str, bucket: int
+                        ) -> CostEstimate:
+        known = self.recorded_buckets(namespace)
+        if bucket in known:
+            return known[bucket]
+        fit = self.fit_for(namespace)
+        if fit is not None and fit.confident():
+            wall, comp, execute = fit.predict(int(bucket))
+            return CostEstimate(f"{namespace}:b{bucket}", wall, comp,
+                                execute, LEARNED)
+        return super()._predict_bucket(namespace, bucket)
+
+    def learned_namespaces(self) -> Dict[str, dict]:
+        """Fit diagnostics per namespace that trained (tx tune
+        --explain / bench surfaces)."""
+        out: Dict[str, dict] = {}
+        for ns in sorted({m.group("ns")
+                          for m in (_BUCKET_KEY.match(k)
+                                    for k in self.records)
+                          if m}):
+            fit = self.fit_for(ns)
+            if fit is not None:
+                out[ns] = {"records": fit.n,
+                           "residual": round(fit.residual, 6),
+                           "confident": fit.confident()}
+        return out
+
+    # -- drift accounting (the per-tier error block) -----------------------
+    def prediction_error_report(self) -> dict:
+        """Leave-one-out prediction error per confidence tier against
+        the recorded per-call execute truths.
+
+        Each recorded ``<ns>:b<bucket>`` row is held out in turn; a
+        model built from the REMAINING rows predicts it through the v2
+        ladder (error lands on whichever tier answered — learned,
+        interpolated, or default when nothing else is known) and
+        through the v1 interpolation alone (error lands on
+        ``interpolated``), so every tier's drift is populated from the
+        same truths. ``recorded`` is exact by construction (count =
+        recorded rows, error 0)."""
+        by_ns: Dict[str, Dict[int, dict]] = {}
+        for key, rec in self.records.items():
+            m = _BUCKET_KEY.match(key)
+            if not m or _per_call(rec) is None:
+                continue
+            by_ns.setdefault(m.group("ns"), {})[
+                int(m.group("bucket"))] = rec
+
+        tiers: Dict[str, List[float]] = {RECORDED: [], INTERPOLATED: [],
+                                         LEARNED: [], DEFAULT: []}
+        for ns, buckets in sorted(by_ns.items()):
+            for bucket, rec in sorted(buckets.items()):
+                truth = _per_call(rec)[2]       # per-call execute
+                tiers[RECORDED].append(0.0)
+                rest = {k: v for k, v in self.records.items()
+                        if k != f"{ns}:b{bucket}"}
+                loo2 = CostModelV2(rest)
+                loo1 = CostModel(rest)
+                for model, pin in ((loo2, None), (loo1, INTERPOLATED)):
+                    est = model.predict(ns, bucket=bucket)
+                    tier = pin or est.confidence
+                    if est.execute is None:
+                        if pin is None:
+                            tiers[DEFAULT].append(float("nan"))
+                        continue
+                    err = abs(est.execute - truth) / max(truth, _EPS)
+                    tiers[tier].append(err)
+
+        def _agg(errs: List[float]) -> dict:
+            real = [e for e in errs if not math.isnan(e)]
+            return {"count": len(errs),
+                    "mean_abs_rel_err":
+                        round(sum(real) / len(real), 6) if real
+                        else None,
+                    "max_abs_rel_err":
+                        round(max(real), 6) if real else None}
+
+        return {"schema": 1,
+                "tiers": {t: _agg(v) for t, v in tiers.items()},
+                "learned": self.learned_namespaces()}
+
+
+def prediction_error_report(path: Optional[str] = None) -> dict:
+    """Convenience: the per-tier LOO error block for one store."""
+    return CostModelV2.from_store(path).prediction_error_report()

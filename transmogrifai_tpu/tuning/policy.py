@@ -29,7 +29,10 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..observability.store import ProfileStore, default_store_path
+from .lattice import (choose_lattice, default_lattice, floor_pow2,
+                      grow_pow2)
 from .model import DEFAULT, CostModel
+from .model_v2 import CostModelV2
 from .registry import STATIC_DEFAULTS, knob as _knob_meta
 
 __all__ = ["TuningDecision", "TuningPolicy", "tuning_enabled"]
@@ -91,6 +94,8 @@ def _coerce(knob_name: str, value: Any) -> Any:
         return int(value)
     if kind == "float":
         return None if value is None else float(value)
+    if kind == "str":
+        return None if value is None else str(value)
     if kind == "int_tuple":
         if isinstance(value, str):
             value = [v for v in value.split(",") if v.strip()]
@@ -112,7 +117,7 @@ class TuningPolicy:
             bool(enabled)
         self.store = ProfileStore(self.path)
         if self.enabled:
-            self.model = model or CostModel.from_store(self.path)
+            self.model = model or CostModelV2.from_store(self.path)
             self.overrides = self.store.tuning_overrides()
         else:
             self.model = CostModel({})
@@ -159,13 +164,15 @@ class TuningPolicy:
             return self._static(
                 name, "no score:b* records in the store yet")
         best, best_est = 0, None
-        b = int(STATIC_DEFAULTS["serving.min_bucket"])
-        while b <= max(int(max_batch), 1):
+        cap = max(int(max_batch), 1)
+        for b in default_lattice(
+                int(STATIC_DEFAULTS["serving.min_bucket"]), cap):
+            if b > cap:
+                continue
             est = self.model.predict("score", bucket=b)
             if est.known() and est.execute is not None \
                     and est.execute <= budget_s and b > best:
                 best, best_est = b, est
-            b *= 2
         dflt_est = self.model.predict("score", bucket=default)
         if not best:
             return self._static(
@@ -193,8 +200,7 @@ class TuningPolicy:
         if known:
             lo_m, hi_m = min(known), max(known)
             if max_batch is not None:
-                while hi_m < min(int(max_batch), hi_d):
-                    hi_m *= 2
+                hi_m = grow_pow2(hi_m, min(int(max_batch), hi_d))
             source, conf = SOURCE_MODEL, "recorded"
             reason = (f"recorded dispatch shapes span b{lo_m}..b{hi_m} "
                       f"({len(known)} buckets)")
@@ -276,9 +282,7 @@ class TuningPolicy:
                 name, "no score:b* records in the store yet")
         rate, _bucket = max(rates)
         budget_s = 0.25
-        rows = 1
-        while rows * 2 <= rate * budget_s:
-            rows *= 2
+        rows = floor_pow2(rate * budget_s)
         chosen = max(min(rows, 4 * default), int(max_batch))
         return TuningDecision(
             name, chosen, default, chosen / rate, default / rate,
@@ -300,6 +304,109 @@ class TuningPolicy:
                 f"pinned by tx tune --set (store {self.path})")
         return self._static(
             name, "model keeps the static fairness granularity")
+
+    def lattice_max_rungs(self) -> TuningDecision:
+        """Rung bound for tuned bucket lattices (override-only: the
+        bound is a compile-budget policy, like the waste ceiling)."""
+        name = "tuning.lattice_max_rungs"
+        ov = self._override(name)
+        if ov is not None:
+            return TuningDecision(
+                name, int(ov), STATIC_DEFAULTS[name], None, None,
+                "recorded", SOURCE_OVERRIDE,
+                f"pinned by tx tune --set (store {self.path})")
+        return self._static(
+            name, "rung bound is a compile-budget policy choice")
+
+    def bucket_lattice(self, min_bucket: Optional[int] = None,
+                       max_bucket: Optional[int] = None
+                       ) -> TuningDecision:
+        """THE padding decision: the bucket lattice ScoringPlans
+        dispatch on, chosen by the recorded occupancy histogram ×
+        predicted per-bucket cost (tuning/lattice.py). Cold start
+        (no occupancy) or TX_TUNE=off keeps the default power-of-two
+        ladder bitwise."""
+        name = "serving.bucket_lattice"
+        lo = int(STATIC_DEFAULTS["serving.min_bucket"]
+                 if min_bucket is None else min_bucket)
+        hi = int(STATIC_DEFAULTS["serving.max_bucket"]
+                 if max_bucket is None else max_bucket)
+        dflt = default_lattice(lo, hi)
+        if not self.enabled:
+            return TuningDecision(
+                name, dflt, dflt, None, None, DEFAULT, SOURCE_DISABLED,
+                "TX_TUNE=off — autotuning disabled")
+        occ = self.store.occupancy("score")
+        if not occ:
+            return TuningDecision(
+                name, dflt, dflt, None, None, DEFAULT, SOURCE_DEFAULT,
+                "no recorded occupancy histogram yet")
+        known = self.model.recorded_buckets("score")
+        exec_cost = compile_cost = None
+        if known:
+            exec_cost = (lambda b:
+                         self.model.predict("score", bucket=b).execute)
+            compile_cost = (lambda b:
+                            self.model.predict("score",
+                                               bucket=b).compile)
+        choice = choose_lattice(
+            occ, min_bucket=lo, max_bucket=hi,
+            max_rungs=int(self.lattice_max_rungs().chosen),
+            exec_cost=exec_cost, compile_cost=compile_cost)
+        if not choice.tuned():
+            return TuningDecision(
+                name, dflt, dflt, choice.predicted_cost,
+                choice.predicted_default_cost,
+                "recorded" if known else DEFAULT, SOURCE_DEFAULT,
+                choice.reason)
+        conf = (self.model.predict(
+            "score", bucket=choice.lattice[0]).confidence
+            if known else DEFAULT)
+        return TuningDecision(
+            name, choice.lattice, dflt, choice.predicted_cost,
+            choice.predicted_default_cost, conf, SOURCE_MODEL,
+            choice.reason)
+
+    def coalesce_policy(self, caller: Optional[str] = None,
+                        lattice_tuned: bool = False) -> TuningDecision:
+        """How the serving coalescer closes a batch. The model only
+        moves off the fixed deadline-or-full rule when a tuned lattice
+        is active AND it has recorded dispatch costs to predict
+        marginal cost from — cold start stays bitwise on the old
+        rule."""
+        name = "serving.coalesce_policy"
+        default = STATIC_DEFAULTS[name]
+        valid = ("deadline_or_full", "predicted_cost")
+        ov = self._override(name)
+        if ov is not None:
+            if ov not in valid:
+                return TuningDecision(
+                    name, default, default, None, None, DEFAULT,
+                    SOURCE_DEFAULT,
+                    f"override {ov!r} is not one of {valid} — "
+                    f"keeping the default rule")
+            return TuningDecision(
+                name, str(ov), default, None, None, "recorded",
+                SOURCE_OVERRIDE,
+                f"pinned by tx tune --set (store {self.path})")
+        if caller is not None:
+            chosen = caller if caller in valid else default
+            return TuningDecision(
+                name, chosen, default, None, None, DEFAULT,
+                SOURCE_CALLER,
+                f"requested by the serve config"
+                if caller in valid else
+                f"config value {caller!r} is not one of {valid} — "
+                f"keeping the default rule")
+        if self.enabled and lattice_tuned \
+                and self.model.recorded_buckets("score"):
+            return TuningDecision(
+                name, "predicted_cost", default, None, None,
+                "recorded", SOURCE_MODEL,
+                "tuned lattice active — split batches against the "
+                "model's predicted per-row marginal cost")
+        return self._static(
+            name, "fixed deadline-or-full rule (no tuned lattice)")
 
     # -- search ------------------------------------------------------------
     def _schedule_cost(self, eta: int, mf: float,
@@ -447,6 +554,10 @@ class TuningPolicy:
         out.append(self.prewarm_buckets(max_batch))
         out.append(self.admission_queue_rows(max_batch))
         out.append(self.admission_quantum())
+        lattice = self.bucket_lattice()
+        out.append(lattice)
+        out.append(self.coalesce_policy(lattice_tuned=lattice.tuned()))
+        out.append(self.lattice_max_rungs())
         _eta, _mf, racing = self.racing_schedule()
         out.extend(racing)
         out.append(self.placement_margin())

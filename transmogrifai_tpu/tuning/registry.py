@@ -32,7 +32,7 @@ class Knob:
     name: str
     default: Any
     consumer: str
-    kind: str          # int | float | int_pair | int_tuple
+    kind: str          # int | float | str | int_pair | int_tuple
     description: str
     #: the constant / parameter spellings TX-T01 polices for this knob
     const_names: Tuple[str, ...] = ()
@@ -90,6 +90,27 @@ KNOBS: Tuple[Knob, ...] = (
                      "larger favors batch throughput, smaller favors "
                      "fairness granularity",
          const_names=("DEFAULT_ADMISSION_QUANTUM",),
+         param_names=()),
+    Knob(name="serving.coalesce_policy", default="deadline_or_full",
+         consumer="serving/server.py ServingServer._collect",
+         kind="str",
+         description="how the coalescer closes a batch: "
+                     "'deadline_or_full' (the fixed rule — dispatch at "
+                     "the wait deadline or the target fill) or "
+                     "'predicted_cost' (additionally split the popped "
+                     "batch at a lattice rung when the cost model's "
+                     "predicted per-row marginal cost says the smaller "
+                     "dispatch is cheaper)",
+         const_names=("DEFAULT_COALESCE_POLICY",),
+         param_names=()),
+    Knob(name="tuning.lattice_max_rungs", default=12,
+         consumer="tuning/lattice.py choose_lattice",
+         kind="int",
+         description="rung bound for tuned non-power-of-two bucket "
+                     "lattices — caps per-plan compiles exactly like "
+                     "the log2(max/min)+1 bound the default ladder "
+                     "carries (11 rungs at 8..8192)",
+         const_names=("DEFAULT_LATTICE_MAX_RUNGS",),
          param_names=()),
     Knob(name="search.eta", default=3,
          consumer="selector/racing.py RacingCrossValidation",
