@@ -2644,7 +2644,249 @@ def _measure_ragged() -> dict:
     return result
 
 
+def _measure_fleet() -> dict:
+    """TX_BENCH_MODE=fleet: the coordinated replica set end to end
+    (docs/fleet.md) on the synthetic-Titanic model (CPU). Four model
+    names (same saved dir) are served behind the fleet router so the
+    cost-model placement spreads lanes across replicas, and three
+    phases run against real ``tx serve`` children:
+
+    - **goodput scaling** — closed-loop clients pump scores through
+      the router at fleet sizes 1, 2 and 4; measured goodput (ok
+      answers/s) and p50/p99 latency per size. Headline
+      ``fleet_goodput_scaling_1to4`` is the 4-replica / 1-replica
+      goodput ratio (p99 reported alongside: scaling must not buy
+      throughput with tail latency).
+    - **kill drill** — one of the 4 replicas is SIGKILLed mid-stream;
+      measured: client-observed failures across the kill (target 0,
+      the router fails the lanes over before the replacement exists)
+      and kill-to-ready warm-takeover seconds (the healed child
+      resumes from its own warm-state snapshot).
+    - **rolling deploy** — every replica drained + respawned
+      sequentially under load; measured: failures (target 0) and
+      total deploy seconds.
+
+    The merged fleet-admission block and router counters ride along,
+    and the whole document is persisted to BENCH_STATE.json under the
+    ``fleet`` section."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from examples.titanic import build_features, stratified_split, \
+        synthetic_titanic
+    from transmogrifai_tpu.models import LogisticRegression
+    from transmogrifai_tpu.observability.store import ProfileStore
+    from transmogrifai_tpu.runtime.retry import RetryPolicy
+    from transmogrifai_tpu.serving import (FleetRouter, ReplicaManager,
+                                           RouterConfig,
+                                           TcpServingClient,
+                                           wait_port_ready)
+    from transmogrifai_tpu.workflow import Workflow
+
+    records = synthetic_titanic(1309)
+    train, test = stratified_split(records)
+    survived, features = build_features()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        survived, features).get_output()
+    model = (Workflow().set_result_features(survived, pred)
+             .set_input_records(train).train(validate="off"))
+    work = tempfile.mkdtemp(prefix="tx_fleet_bench_")
+    model_dir = os.path.join(work, "model")
+    model.save(model_dir)
+    reqs = [dict(r) for r in test]
+    # four NAMES for one saved model: distinct plans per replica, so
+    # the placement cost (compile term for unhosted models) spreads
+    # the lanes instead of colocating them — the multi-model fleet
+    model_names = [f"m{i}" for i in range(4)]
+    models = [f"{n}={model_dir}" for n in model_names]
+    patient = RetryPolicy(max_attempts=120, base_delay=0.2,
+                          max_delay=0.5)
+
+    def boot_fleet(n, root):
+        import asyncio
+        router = FleetRouter(RouterConfig(forward_timeout=30.0))
+        router.default_model = model_names[0]
+        manager = ReplicaManager(
+            models=models, replicas=n, state_root=root,
+            serve_args=["--max-wait-ms", "5",
+                        "--snapshot-interval", "1"],
+            on_up=router.register_replica_threadsafe,
+            on_down=router.unregister_replica_threadsafe,
+            on_draining=router.mark_draining_threadsafe)
+        manager.start()
+        box, ready = [], threading.Event()
+
+        def _run():
+            def _cb(p):
+                box.append(p)
+                ready.set()
+            asyncio.run(router.serve("127.0.0.1", 0, ready_cb=_cb))
+
+        thread = threading.Thread(target=_run, daemon=True)
+        thread.start()
+        if not ready.wait(180):
+            raise RuntimeError("fleet router never bound")
+        # warm one lane per model name (pays each bucket compile ONCE,
+        # outside every timed window)
+        with TcpServingClient("127.0.0.1", box[0], retry=patient,
+                              timeout=120.0) as c:
+            for i, name in enumerate(model_names):
+                out = c.score(dict(reqs[i]), model=name)
+                if not out.get("ok"):
+                    raise RuntimeError(f"warmup failed: {out}")
+        return router, manager, thread, box[0]
+
+    def stop_fleet(router, manager, thread):
+        router.stop_threadsafe()
+        manager.shutdown()
+        thread.join(30)
+
+    def start_pump(port, workers=16):
+        state = {"stop": threading.Event(),
+                 "lock": threading.Lock(),
+                 "lat": [], "failures": []}
+
+        def _worker(w):
+            c = TcpServingClient("127.0.0.1", port, retry=patient,
+                                 timeout=30.0)
+            i = 0
+            while not state["stop"].is_set():
+                rec = dict(reqs[(i * workers + w) % len(reqs)])
+                name = model_names[(i + w) % len(model_names)]
+                t0 = time.perf_counter()
+                try:
+                    out = c.score(rec, model=name,
+                                  request_id=f"f{w}-{i}")
+                except Exception as e:   # noqa: BLE001 - tallied
+                    with state["lock"]:
+                        state["failures"].append(repr(e)[:200])
+                    out = None
+                dt = time.perf_counter() - t0
+                if out is not None:
+                    if out.get("ok"):
+                        with state["lock"]:
+                            state["lat"].append(dt)
+                    else:
+                        with state["lock"]:
+                            state["failures"].append(str(out)[:200])
+                i += 1
+            c.close()
+
+        state["threads"] = [threading.Thread(target=_worker,
+                                             args=(w,), daemon=True)
+                            for w in range(workers)]
+        state["t0"] = time.perf_counter()
+        for t in state["threads"]:
+            t.start()
+        return state
+
+    def finish_pump(state):
+        state["stop"].set()
+        for t in state["threads"]:
+            t.join(60)
+        wall = time.perf_counter() - state["t0"]
+        lat = np.asarray(state["lat"]
+                         if state["lat"] else [0.0])
+        return {"goodput_rows_per_s": round(len(state["lat"]) / wall,
+                                            1),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3,
+                                2),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3,
+                                2),
+                "answered": len(state["lat"]),
+                "client_observed_failures": len(state["failures"]),
+                "failure_samples": state["failures"][:3]}
+
+    window_s = float(os.environ.get("TX_BENCH_FLEET_SECONDS", "6"))
+
+    # -- phase A: goodput scaling at 1, 2, 4 replicas ------------------
+    scaling = {}
+    router = manager = thread = port = None
+    for n in (1, 2, 4):
+        router, manager, thread, port = boot_fleet(
+            n, os.path.join(work, f"fleet{n}"))
+        pump = start_pump(port)
+        time.sleep(window_s)
+        scaling[n] = finish_pump(pump)
+        if n != 4:
+            stop_fleet(router, manager, thread)
+    g1 = scaling[1]["goodput_rows_per_s"]
+    g4 = scaling[4]["goodput_rows_per_s"]
+
+    # -- phase B: kill one of the 4, measure the warm takeover ---------
+    victim = "r1"
+    gen_before = manager.snapshot()["replicas"][victim]["generation"]
+    pump = start_pump(port, workers=4)
+    time.sleep(0.5)
+    t_kill = time.perf_counter()
+    manager.procs[victim].proc.kill()
+    takeover_s = None
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        # takeover = the HEALED incarnation answering ready, not just
+        # the respawn starting (the manager bumps the generation at
+        # spawn time, before the child has even imported)
+        rp = manager.procs[victim]
+        if rp.generation > gen_before and rp.port_event.is_set() \
+                and rp.alive():
+            wait_port_ready("127.0.0.1", rp.port, 120)
+            takeover_s = time.perf_counter() - t_kill
+            break
+        time.sleep(0.05)
+    time.sleep(1.0)
+    kill_phase = finish_pump(pump)
+    resume = next((json.loads(ln)["resume"]
+                   for ln in manager.procs[victim].output
+                   if ln.startswith("{") and '"resume"' in ln), {})
+
+    # -- phase C: rolling deploy of the whole fleet under load ---------
+    pump = start_pump(port, workers=4)
+    t_deploy = time.perf_counter()
+    manager.rolling_deploy()
+    deploy_s = time.perf_counter() - t_deploy
+    time.sleep(1.0)
+    deploy_phase = finish_pump(pump)
+    with TcpServingClient("127.0.0.1", port, retry=patient,
+                          timeout=30.0) as c:
+        fleet_metrics = c.metrics()
+    generations = {n: v["generation"] for n, v in
+                   manager.snapshot()["replicas"].items()}
+    stop_fleet(router, manager, thread)
+
+    result = {
+        "metric": "fleet_goodput_scaling_1to4",
+        "value": round(g4 / max(g1, 1e-9), 2),
+        "unit": "x",
+        "vs_baseline": round(g4 / max(g1, 1e-9), 2),
+        "scaling": {str(n): scaling[n] for n in scaling},
+        "kill_drill": {
+            "takeover_seconds": (round(takeover_s, 2)
+                                 if takeover_s is not None else None),
+            "resume_mode": resume.get("mode"),
+            "resume_warm_buckets": resume.get("warm_buckets"),
+            **kill_phase},
+        "rolling_deploy": {"deploy_seconds": round(deploy_s, 2),
+                           "generations": generations,
+                           **deploy_phase},
+        "fleet_admission": fleet_metrics.get("admission"),
+        "router": fleet_metrics.get("router"),
+        "platform": "cpu",
+    }
+    try:
+        ProfileStore(_STATE_PATH).record_section(
+            "fleet", {k: v for k, v in result.items()
+                      if k not in ("router",)})
+    except Exception:  # pragma: no cover - read-only repo
+        pass
+    return result
+
+
 def _measure() -> dict:
+    if os.environ.get("TX_BENCH_MODE") == "fleet":
+        return _measure_fleet()
     if os.environ.get("TX_BENCH_MODE") == "ragged":
         return _measure_ragged()
     if os.environ.get("TX_BENCH_MODE") == "autotune":
@@ -2872,7 +3114,7 @@ def main() -> None:
                                            "serve_loop", "self_heal",
                                            "restart", "restart_aot",
                                            "autotune", "overload",
-                                           "ragged"):
+                                           "ragged", "fleet"):
         # these modes are DEFINED on the forced-CPU backend (the
         # sharded sweep on a virtual device pool, the prepare
         # comparison on the x64 CPU path, the serve-loop latency SLO
@@ -2929,6 +3171,8 @@ def main() -> None:
 
 
 def _headline_metric() -> tuple:
+    if os.environ.get("TX_BENCH_MODE") == "fleet":
+        return "fleet_goodput_scaling_1to4", "x"
     if os.environ.get("TX_BENCH_MODE") == "ragged":
         return "ragged_padding_reduction", "fraction"
     if os.environ.get("TX_BENCH_MODE") == "autotune":

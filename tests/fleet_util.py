@@ -1,0 +1,154 @@
+"""Shared multi-process serving harness for the fleet drills.
+
+Every subprocess drill in this suite needs the same three moves:
+spawn a ``tx serve`` child on an ephemeral port, barrier on its
+``{"ready": true}`` answer, and tear it down deterministically (never
+leave an orphan to poison the next test). This module is the ONE copy
+of that boilerplate — used by the fleet tests (test_fleet*.py) and by
+the PR-12 restart drills in test_serving_state.py.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from transmogrifai_tpu.runtime.retry import RetryPolicy
+from transmogrifai_tpu.serving import TcpServingClient
+
+__all__ = ["free_port", "patient_retry", "spawn_serve", "wait_ready",
+           "stop_proc", "FleetHarness"]
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def patient_retry():
+    # covers a full child boot (imports + restore) between attempts
+    return RetryPolicy(max_attempts=120, base_delay=0.2, max_delay=0.5)
+
+
+def spawn_serve(model_dir, port, extra=(), env_extra=None,
+                model_name="m"):
+    """One ``tx serve`` child on ``port`` with stdout captured (the
+    drills parse its banner / drain / resume JSON lines)."""
+    cmd = [sys.executable, "-m", "transmogrifai_tpu.cli", "serve",
+           "--model", f"{model_name}={model_dir}",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--max-wait-ms", "5", "--snapshot-interval", "2", *extra]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=env)
+
+
+def wait_ready(port, timeout=120.0, host="127.0.0.1"):
+    """Barrier until the serving (or fleet router) port answers
+    ``{"ready": true}``."""
+    deadline = time.monotonic() + timeout
+    client = TcpServingClient(host, port,
+                              retry=RetryPolicy(max_attempts=2,
+                                                base_delay=0.05,
+                                                max_delay=0.1),
+                              timeout=2.0)
+    while time.monotonic() < deadline:
+        try:
+            out = client.request({"ready": True})
+            if out.get("ready"):
+                client.close()
+                return out
+        except Exception:   # noqa: BLE001 - boot race, keep polling
+            time.sleep(0.25)
+    raise AssertionError(f"server on :{port} never became ready")
+
+
+def stop_proc(proc, timeout=30.0):
+    """Deterministic teardown for one child: kill if still alive,
+    always reap, return captured stdout (or '')."""
+    if proc is None:
+        return ""
+    if proc.poll() is None:
+        proc.kill()
+    try:
+        stdout, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        stdout, _ = proc.communicate(timeout=timeout)
+    return stdout or ""
+
+
+class FleetHarness:
+    """N serve children on ephemeral ports with per-replica state
+    dirs: the fixture-sized version of serving/fleet.py's
+    ReplicaManager, for drills that want direct control of each
+    child (kill this one, drain that one) instead of self-healing.
+
+    >>> with FleetHarness(model_dir, tmp_path, n=2) as fleet:
+    ...     out = client.score(rec, model="m")   # via fleet.ports[0]
+    """
+
+    def __init__(self, model_dir, root, n=2, extra=(),
+                 env_extra=None, model_name="m"):
+        self.model_dir = str(model_dir)
+        self.root = str(root)
+        self.n = int(n)
+        self.extra = tuple(extra)
+        self.env_extra = dict(env_extra or {})
+        self.model_name = model_name
+        self.names = [f"r{i}" for i in range(self.n)]
+        self.ports = {}
+        self.procs = {}
+        self.state_dirs = {}
+
+    def spawn(self, name, resume=False, port=None, extra=()):
+        """(Re)spawn one replica; barriers on readiness."""
+        state_dir = self.state_dirs.setdefault(
+            name, os.path.join(self.root, name))
+        os.makedirs(state_dir, exist_ok=True)
+        port = port or self.ports.get(name) or free_port()
+        args = ["--state-dir", state_dir]
+        if resume:
+            args += ["--resume-state", state_dir]
+        args += list(self.extra) + list(extra)
+        proc = spawn_serve(self.model_dir, port, extra=args,
+                           env_extra=self.env_extra,
+                           model_name=self.model_name)
+        self.ports[name] = port
+        self.procs[name] = proc
+        wait_ready(port)
+        return proc
+
+    def start(self):
+        for name in self.names:
+            self.spawn(name)
+        return self
+
+    def kill(self, name, sig=None):
+        """SIGKILL (default) or signal one replica; returns captured
+        stdout once it exits."""
+        proc = self.procs[name]
+        if sig is None:
+            proc.kill()
+        else:
+            proc.send_signal(sig)
+        stdout, _ = proc.communicate(timeout=90)
+        return stdout or ""
+
+    def stop(self):
+        outs = {}
+        for name, proc in list(self.procs.items()):
+            outs[name] = stop_proc(proc)
+        return outs
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

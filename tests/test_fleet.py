@@ -1,0 +1,311 @@
+"""Fleet drills: the coordinated replica set end to end
+(serving/fleet.py + serving/router.py + cli/fleet.py, docs/fleet.md).
+
+Two subprocess drills back the ISSUE's acceptance lines directly:
+
+- **kill one replica mid-stream** — a ``TX_FAULT_PLAN`` kill drill
+  SIGKILLs one of two replicas while a client pumps scores through
+  the router: zero client-observed failures, and the dead replica
+  comes back as a warm (``--resume-state``) generation-2 incarnation;
+- **rolling deploy** — drain + respawn each replica sequentially
+  under continuous client load: zero failures, every replica at
+  generation 2, and steady-state scoring after the deploy adds ZERO
+  new plan compiles (the warm snapshots carried the bucket lattice
+  across the deploy).
+
+Both spawn real ``tx serve`` children (compiles + boots), so both are
+slow-marked; the fast in-process router coverage lives in
+test_fleet_router.py.
+"""
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fleet_util import (free_port, patient_retry,  # noqa: E402
+                        stop_proc, wait_ready)
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.models import LogisticRegression
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.runtime import telemetry
+from transmogrifai_tpu.serving import (FleetRouter, ReplicaManager,
+                                       RouterConfig, TcpServingClient)
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import Workflow
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _records(n=96, seed=11):
+    rng = np.random.default_rng(seed)
+    cats = ["a", "b", "c"]
+    recs = []
+    for _ in range(n):
+        x = float(rng.normal())
+        z = float(rng.uniform(0, 4))
+        recs.append({"x": x, "z": z,
+                     "cat": cats[int(rng.integers(0, len(cats)))],
+                     "label": float(x + 0.5 * rng.normal() > 0)})
+    return recs
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    recs = _records()
+    x = FeatureBuilder.of("x", Real).extract(
+        lambda r: r.get("x")).as_predictor()
+    z = FeatureBuilder.of("z", RealNN).extract(
+        lambda r: r.get("z")).as_predictor()
+    cat = FeatureBuilder.of("cat", PickList).extract(
+        lambda r: r.get("cat")).as_predictor()
+    label = FeatureBuilder.of("label", RealNN).extract(
+        lambda r: r.get("label")).as_response()
+    pred = LogisticRegression(reg_param=0.01).set_input(
+        label, transmogrify([x, z, cat])).get_output()
+    model = (Workflow().set_result_features(pred)
+             .set_input_records(recs).train(validate="off"))
+    d = str(tmp_path_factory.mktemp("fleet_model") / "model")
+    model.save(d)
+    return d
+
+
+def _pump_stdout(proc, lines, events):
+    """Drain a fleet process's stdout, setting the named event when a
+    matching ``{"fleet": ...}`` lifecycle line appears."""
+    for line in proc.stdout:
+        lines.append(line)
+        try:
+            doc = json.loads(line)
+        except (ValueError, TypeError):
+            doc = None   # child chatter, not a lifecycle line
+        if not isinstance(doc, dict):
+            continue
+        kind = doc.get("fleet")
+        if kind == "kill_drill":
+            events["killed"].set()
+        elif kind == "spawned" and doc.get("resume"):
+            events["warm_respawn"].set()
+        elif kind == "ready" and doc.get("generation", 1) >= 2:
+            events["takeover_ready"].set()
+
+
+class TestKillDrillThroughCli:
+    def test_kill_one_replica_is_invisible_to_the_client(
+            self, model_dir, tmp_path):
+        """``tx fleet`` with 2 replicas + a TX_FAULT_PLAN kill drill
+        on r1: the client pumping scores through the router observes
+        ZERO failures across the kill, and r1 comes back as a warm
+        generation-2 incarnation."""
+        port = free_port()
+        cmd = [sys.executable, "-m", "transmogrifai_tpu.cli", "fleet",
+               "--model", f"m={model_dir}", "--replicas", "2",
+               "--host", "127.0.0.1", "--port", str(port),
+               "--state-root", str(tmp_path / "state"),
+               "--max-wait-ms", "5", "--snapshot-interval", "1",
+               "--admission", "off",
+               "--max-restarts", "5", "--restart-window", "300"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   # the watch loop probes each replica ~10x/s: the
+                   # 40th probe of r1 SIGKILLs it a few seconds into
+                   # the scoring stream
+                   TX_FAULT_PLAN="fleet:r1:kill:40=kill")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=env)
+        lines, events = [], {"killed": threading.Event(),
+                             "warm_respawn": threading.Event(),
+                             "takeover_ready": threading.Event()}
+        pump = threading.Thread(target=_pump_stdout,
+                                args=(proc, lines, events),
+                                daemon=True)
+        pump.start()
+        recs = _records(n=24, seed=13)
+        failures, answered = [], 0
+        try:
+            wait_ready(port, timeout=240)
+            client = TcpServingClient("127.0.0.1", port,
+                                      retry=patient_retry(),
+                                      timeout=30.0)
+            deadline = time.monotonic() + 180
+            settle_until = None
+            i = 0
+            while time.monotonic() < deadline:
+                rec = dict(recs[i % len(recs)])
+                rec.pop("label", None)
+                try:
+                    out = client.score(rec, model="m",
+                                       request_id=f"k{i}")
+                except Exception as e:   # noqa: BLE001 - drill tally
+                    failures.append(f"k{i}: {type(e).__name__}: {e}")
+                    out = None
+                if out is not None:
+                    if out.get("ok"):
+                        answered += 1
+                    else:
+                        failures.append(f"k{i}: {out}")
+                i += 1
+                if events["takeover_ready"].is_set():
+                    # keep streaming a little while against the
+                    # healed fleet, then stop
+                    if settle_until is None:
+                        settle_until = time.monotonic() + 3.0
+                    elif time.monotonic() > settle_until:
+                        break
+            client.close()
+        finally:
+            if proc.poll() is None:
+                # SIGTERM and WAIT: run_fleet's finally must get to
+                # manager.shutdown(), or the serve children leak past
+                # the test (stop_proc alone would SIGKILL the parent
+                # before it can reap them)
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(60)
+                except subprocess.TimeoutExpired:
+                    pass
+            stop_proc(proc)
+        assert events["killed"].is_set(), \
+            "the kill drill never fired:\n" + "".join(lines[-30:])
+        assert events["warm_respawn"].is_set(), \
+            "r1 was not respawned with --resume-state"
+        assert events["takeover_ready"].is_set(), \
+            "no generation-2 incarnation became ready:\n" + \
+            "".join(lines[-30:])
+        assert not failures, \
+            f"{len(failures)} client-observed failures " \
+            f"(first: {failures[0]})"
+        assert answered >= 20, f"only {answered} scores landed"
+
+
+class TestRollingDeployInProcess:
+    def test_rolling_deploy_zero_failures_and_flat_compiles(
+            self, model_dir, tmp_path):
+        """ReplicaManager.rolling_deploy under continuous client load
+        through an in-process FleetRouter: zero client-observed
+        failures, every replica reaches generation 2, and steady-state
+        scoring AFTER the deploy adds zero plan compiles (the warm
+        snapshots carried the bucket lattice across the respawns)."""
+        router = FleetRouter(RouterConfig(forward_timeout=30.0))
+        router.default_model = "m"
+        manager = ReplicaManager(
+            models=[f"m={model_dir}"], replicas=2,
+            state_root=str(tmp_path / "state"),
+            serve_args=["--max-wait-ms", "5",
+                        "--snapshot-interval", "1",
+                        "--admission", "off"],
+            on_up=router.register_replica_threadsafe,
+            on_down=router.unregister_replica_threadsafe,
+            on_draining=router.mark_draining_threadsafe)
+        port_box, ready = [], threading.Event()
+
+        def _run_router():
+            def _cb(p):
+                port_box.append(p)
+                ready.set()
+            asyncio.run(router.serve("127.0.0.1", 0, ready_cb=_cb))
+
+        router_thread = threading.Thread(target=_run_router,
+                                         daemon=True)
+        recs = _records(n=24, seed=17)
+        failures, counts = [], {"n": 0}
+        stop_pump = threading.Event()
+
+        def _pump_scores():
+            client = TcpServingClient("127.0.0.1", port_box[0],
+                                      retry=patient_retry(),
+                                      timeout=30.0)
+            i = 0
+            while not stop_pump.is_set():
+                rec = dict(recs[i % len(recs)])
+                rec.pop("label", None)
+                try:
+                    out = client.score(rec, model="m",
+                                       request_id=f"d{i}")
+                except Exception as e:   # noqa: BLE001 - drill tally
+                    failures.append(f"d{i}: {type(e).__name__}: {e}")
+                    out = None
+                if out is not None and not out.get("ok"):
+                    failures.append(f"d{i}: {out}")
+                elif out is not None:
+                    counts["n"] += 1
+                i += 1
+            client.close()
+
+        try:
+            manager.start()
+            router_thread.start()
+            assert ready.wait(120), "router never bound"
+            client = TcpServingClient("127.0.0.1", port_box[0],
+                                      retry=patient_retry(),
+                                      timeout=30.0)
+            # warm the lane + let a snapshot land before deploying
+            for i, rec in enumerate(recs):
+                payload = dict(rec)
+                payload.pop("label", None)
+                out = client.score(payload, model="m",
+                                   request_id=f"w{i}")
+                assert out.get("ok"), out
+            time.sleep(1.5)
+            pump = threading.Thread(target=_pump_scores, daemon=True)
+            pump.start()
+            manager.rolling_deploy()
+            time.sleep(1.0)
+            stop_pump.set()
+            pump.join(60)
+            assert not failures, \
+                f"{len(failures)} client-observed failures during " \
+                f"the deploy (first: {failures[0]})"
+            assert counts["n"] > 0, "no scores landed mid-deploy"
+            snap = manager.snapshot()
+            for name, view in snap["replicas"].items():
+                assert view["generation"] == 2, (name, view)
+                assert view["state"] == "ok", (name, view)
+                assert view["alive"], (name, view)
+            # settle pass: give the post-deploy lane owner one full
+            # batch (any cold bucket compiles happen HERE) ...
+            for i, rec in enumerate(recs):
+                payload = dict(rec)
+                payload.pop("label", None)
+                assert client.score(payload, model="m",
+                                    request_id=f"s{i}").get("ok")
+
+            def _fleet_compiles():
+                total = 0
+                for name in sorted(manager.procs):
+                    mc = TcpServingClient(
+                        "127.0.0.1", manager.procs[name].port,
+                        retry=patient_retry(), timeout=30.0)
+                    total += int(mc.metrics().get("plan_compiles", 0))
+                    mc.close()
+                return total
+
+            # ... then assert steady state is compile-free: the same
+            # records again must not add a single plan compile
+            before = _fleet_compiles()
+            for i, rec in enumerate(recs):
+                payload = dict(rec)
+                payload.pop("label", None)
+                assert client.score(payload, model="m",
+                                    request_id=f"p{i}").get("ok")
+            assert _fleet_compiles() == before, \
+                "post-deploy steady-state scoring recompiled plans"
+            client.close()
+        finally:
+            stop_pump.set()
+            router.stop_threadsafe()
+            manager.shutdown()
+            router_thread.join(30)

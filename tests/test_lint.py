@@ -1438,6 +1438,108 @@ class TestR06ArtifactBypass:
         assert findings == []
 
 
+class TestR07LeakedWriter:
+    """TX-R07: a socket/stream writer stored in a dict-like container
+    in serving/ with no removal path anywhere in the module leaks one
+    entry (and one fd) per client disconnect — the router's
+    ``finally: writers.pop(key, None)`` is the required shape."""
+
+    SRV = "transmogrifai_tpu/serving/frontend.py"
+
+    def _lint(self, code, path=None):
+        return lint_source(textwrap.dedent(code), path or self.SRV)
+
+    def test_writer_store_without_cleanup_flagged(self):
+        findings = self._lint("""
+            class Frontend:
+                def __init__(self):
+                    self._writers = {}
+
+                async def handle(self, reader, writer):
+                    key = id(writer)
+                    self._writers[key] = writer
+                    while True:
+                        line = await reader.readline()
+                        if not line:
+                            break
+        """)
+        assert "TX-R07" in _rules(findings)
+        f = [x for x in findings if x.rule_id == "TX-R07"][0]
+        assert f.severity == "error"
+        assert "pop" in (f.hint or "")
+
+    def test_sock_and_conn_names_flagged(self):
+        findings = self._lint("""
+            def track(table, registry, sock, conn):
+                table[1] = sock
+                registry["a"] = conn
+        """)
+        assert len([f for f in findings
+                    if f.rule_id == "TX-R07"]) == 2
+
+    def test_pop_in_finally_is_clean(self):
+        # the reference shape: handler's finally evicts the entry
+        findings = self._lint("""
+            class Frontend:
+                def __init__(self):
+                    self._writers = {}
+
+                async def handle(self, reader, writer):
+                    key = id(writer)
+                    self._writers[key] = writer
+                    try:
+                        await reader.readline()
+                    finally:
+                        self._writers.pop(key, None)
+        """)
+        assert "TX-R07" not in _rules(findings)
+
+    def test_cleanup_in_other_method_counts(self):
+        # the verdict is module-wide: a disconnect method that dels
+        # the entry is a removal path even though the store is
+        # elsewhere
+        findings = self._lint("""
+            class Frontend:
+                def __init__(self):
+                    self.conns = {}
+
+                def attach(self, key, conn):
+                    self.conns[key] = conn
+
+                def detach(self, key):
+                    del self.conns[key]
+        """)
+        assert "TX-R07" not in _rules(findings)
+
+    def test_non_connection_values_legal(self):
+        findings = self._lint("""
+            class Cache:
+                def __init__(self):
+                    self.results = {}
+
+                def put(self, key, row):
+                    self.results[key] = row
+        """)
+        assert "TX-R07" not in _rules(findings)
+
+    def test_outside_serving_is_silent(self):
+        findings = self._lint("""
+            def track(table, writer):
+                table[1] = writer
+        """, path="transmogrifai_tpu/runtime/pool.py")
+        assert "TX-R07" not in _rules(findings)
+
+    def test_inline_suppression(self, tmp_path):
+        d = tmp_path / "serving"
+        d.mkdir()
+        p = d / "front.py"
+        p.write_text("def track(table, writer):\n"
+                     "    table[1] = writer"
+                     "  # tx-lint: disable=TX-R07\n")
+        findings, _ = lint_paths([str(p)])
+        assert findings == []
+
+
 class TestJ08ShardClosure:
     """TX-J08: a shard_map/pjit body closing over an array-like value
     gets implicit full replication — arrays must enter through

@@ -546,6 +546,82 @@ class TestTcpClient:
         finally:
             srv.close()
 
+    def test_late_duplicate_reply_deduped_on_request_id(self):
+        # a resend racing a late reply: the stream carries a leftover
+        # answer for an EARLIER abandoned request before the real
+        # one — the client must surface only the reply echoing its
+        # own id, and count the duplicate
+        import socket
+        import threading
+        from transmogrifai_tpu.serving import TcpServingClient
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def run():
+            conn, _ = srv.accept()
+            fh = conn.makefile("rw")
+            fh.readline()
+            # the late reply to an abandoned earlier send...
+            fh.write(json.dumps({"ok": True, "request_id": "old-7",
+                                 "result": {"stale": True}}) + "\n")
+            # ...then the real answer
+            fh.write(json.dumps({"ok": True, "request_id": "req-1",
+                                 "result": {"y": 2}}) + "\n")
+            fh.flush()
+            conn.close()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        try:
+            with TcpServingClient("127.0.0.1", port,
+                                  retry=self._retry()) as client:
+                out = client.request({"record": {"x": 1.0},
+                                      "id": "req-1"})
+            assert out["request_id"] == "req-1"
+            assert out["result"] == {"y": 2}
+            assert telemetry.counters()[
+                "serve_client_duplicate_replies"] == 1
+            t.join(timeout=5)
+        finally:
+            srv.close()
+
+    def test_untagged_request_keeps_first_reply(self):
+        # without an id there is nothing to dedupe against — the
+        # first line is the answer, exactly as before
+        import socket
+        import threading
+        from transmogrifai_tpu.serving import TcpServingClient
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def run():
+            conn, _ = srv.accept()
+            fh = conn.makefile("rw")
+            fh.readline()
+            fh.write(json.dumps({"ok": True, "request_id": "srv-1",
+                                 "result": {"y": 3}}) + "\n")
+            fh.flush()
+            conn.close()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        try:
+            with TcpServingClient("127.0.0.1", port,
+                                  retry=self._retry()) as client:
+                out = client.request({"record": {"x": 1.0}})
+            assert out["result"] == {"y": 3}
+            assert "serve_client_duplicate_replies" not in \
+                telemetry.counters()
+            t.join(timeout=5)
+        finally:
+            srv.close()
+
     def test_scores_against_the_real_loop(self, trained):
         import threading
         from transmogrifai_tpu.cli.serve import serve_forever

@@ -26,8 +26,6 @@ import json
 import os
 import signal
 import socket
-import subprocess
-import sys
 import threading
 import time
 
@@ -38,7 +36,6 @@ from transmogrifai_tpu.features.builder import FeatureBuilder
 from transmogrifai_tpu.models import LogisticRegression
 from transmogrifai_tpu.ops import transmogrify
 from transmogrifai_tpu.runtime import FaultInjector, telemetry
-from transmogrifai_tpu.runtime.retry import RetryPolicy
 from transmogrifai_tpu.serving import (SNAPSHOT_SCHEMA, CircuitBreaker,
                                        ServeConfig, ServeDraining,
                                        ServingServer,
@@ -294,6 +291,99 @@ class TestFailureModes:
 
 
 # ---------------------------------------------------------------------------
+# artifact-fingerprint drift gates the warm-bucket prewarm replay
+# ---------------------------------------------------------------------------
+
+class TestArtifactDriftGate:
+    """The model dir was RE-SAVED between snapshot and resume: the
+    snapshot's warm buckets describe programs that no longer exist.
+    The restore must notice the PR-16 plan-fingerprint mismatch
+    (``serving_state_artifact_drift``) and skip the prewarm replay —
+    paying compiles to warm a stale lattice is worse than booting
+    cold for that model."""
+
+    def _train_and_save(self, path, drop_cat=False, seed=21):
+        recs = _records(n=96, seed=seed)
+        x = FeatureBuilder.of("x", Real).extract(
+            lambda r: r.get("x")).as_predictor()
+        z = FeatureBuilder.of("z", RealNN).extract(
+            lambda r: r.get("z")).as_predictor()
+        cat = FeatureBuilder.of("cat", PickList).extract(
+            lambda r: r.get("cat")).as_predictor()
+        label = FeatureBuilder.of("label", RealNN).extract(
+            lambda r: r.get("label")).as_response()
+        feats = [x, z] if drop_cat else [x, z, cat]
+        pred = LogisticRegression(reg_param=0.01).set_input(
+            label, transmogrify(feats)).get_output()
+        model = (Workflow().set_result_features(pred)
+                 .set_input_records(recs).train(validate="off"))
+        model.save(path)
+        return recs
+
+    def test_resaved_model_skips_warm_replay(self, tmp_path,
+                                             monkeypatch):
+        monkeypatch.setenv("TX_AOT_EXPORT", "on")
+        d = str(tmp_path / "model")
+        recs = self._train_and_save(d)
+        state_dir = str(tmp_path / "state")
+        server, client = serve_in_process(
+            {"m": d}, ServeConfig(max_wait_ms=5.0, sentinel=False))
+        try:
+            client.score_many([dict(r) for r in recs[:16]])
+            # the incarnation serves from a real artifact store —
+            # its fingerprint is what the snapshot records
+            entry = server.plans.get("m")
+            assert entry.plan.aot_summary() is not None
+            assert StateManager(server, state_dir).write()
+        finally:
+            server.stop()
+        # re-save a STRUCTURALLY different model to the same dir
+        # (different feature set -> different plan fingerprint)
+        self._train_and_save(d, drop_cat=True, seed=22)
+        telemetry.reset()
+        server2 = ServingServer(
+            ServeConfig(max_wait_ms=5.0, sentinel=False))
+        server2.add_model("m", d)
+        out = StateManager(server2, state_dir).restore()
+        try:
+            assert out["mode"] == "warm" and out["restored"] is True
+            # drift was detected and counted ...
+            assert telemetry.counters()[
+                "serving_state_artifact_drift"] >= 1
+            # ... and the stale warm buckets were NOT replayed
+            assert out["warm_buckets"]["m"] == []
+        finally:
+            server2.stop()
+
+    def test_matching_fingerprint_still_replays(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("TX_AOT_EXPORT", "on")
+        d = str(tmp_path / "model")
+        recs = self._train_and_save(d)
+        state_dir = str(tmp_path / "state")
+        server, client = serve_in_process(
+            {"m": d}, ServeConfig(max_wait_ms=5.0, sentinel=False))
+        try:
+            client.score_many([dict(r) for r in recs[:16]])
+            assert StateManager(server, state_dir).write()
+        finally:
+            server.stop()
+        telemetry.reset()
+        server2 = ServingServer(
+            ServeConfig(max_wait_ms=5.0, sentinel=False))
+        server2.add_model("m", d)
+        out = StateManager(server2, state_dir).restore()
+        try:
+            assert out["mode"] == "warm"
+            assert out["warm_buckets"]["m"], \
+                "same fingerprint must keep the warm replay"
+            assert "serving_state_artifact_drift" not in \
+                telemetry.counters()
+        finally:
+            server2.stop()
+
+
+# ---------------------------------------------------------------------------
 # graceful drain, in-process under concurrent load
 # ---------------------------------------------------------------------------
 
@@ -369,49 +459,13 @@ class TestProcessMetrics:
 
 # ---------------------------------------------------------------------------
 # the subprocess drills: SIGTERM flush, rolling restart, supervision
+# (spawn/poll/teardown boilerplate lives in the shared fleet harness)
 # ---------------------------------------------------------------------------
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _patient_retry():
-    # covers a full child boot (imports + restore) between attempts
-    return RetryPolicy(max_attempts=120, base_delay=0.2, max_delay=0.5)
-
-
-def _spawn_serve(model_dir, port, extra=(), env_extra=None):
-    cmd = [sys.executable, "-m", "transmogrifai_tpu.cli", "serve",
-           "--model", f"m={model_dir}", "--host", "127.0.0.1",
-           "--port", str(port), "--max-wait-ms", "5",
-           "--snapshot-interval", "2", *extra]
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.update(env_extra or {})
-    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True,
-                            env=env)
-
-
-def _wait_ready(port, timeout=120.0):
-    deadline = time.monotonic() + timeout
-    client = TcpServingClient("127.0.0.1", port,
-                              retry=RetryPolicy(max_attempts=2,
-                                                base_delay=0.05,
-                                                max_delay=0.1),
-                              timeout=2.0)
-    while time.monotonic() < deadline:
-        try:
-            out = client.request({"ready": True})
-            if out.get("ready"):
-                client.close()
-                return
-        except Exception:
-            time.sleep(0.25)
-    raise AssertionError(f"server on :{port} never became ready")
+from fleet_util import (free_port as _free_port,                # noqa: E402
+                        patient_retry as _patient_retry,
+                        spawn_serve as _spawn_serve,
+                        wait_ready as _wait_ready)
 
 
 class TestRestartDrills:

@@ -1,0 +1,121 @@
+"""``tx fleet`` — run a coordinated replica set behind the fleet
+router (docs/fleet.md).
+
+One command boots the whole topology: N supervised ``tx serve``
+children (serving/fleet.py), each with its own state dir and
+ephemeral port, plus the asyncio router front-end (serving/router.py)
+on the public port. Clients speak the ordinary JSON-lines serving
+protocol to the router and get lane placement, mid-stream failover,
+warm takeover after a replica death, and fleet-coherent admission
+for free::
+
+    tx fleet --model fraud=/models/fraud --replicas 4 --port 8765
+
+The serve-tuning flags (``--max-wait-ms``, ``--plan-cache``,
+``--admission``, ``--artifacts`` ...) are forwarded verbatim to every
+replica child.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+__all__ = ["add_fleet_parser", "run_fleet"]
+
+
+def add_fleet_parser(sub) -> None:
+    fl = sub.add_parser(
+        "fleet",
+        help="serve a replica set behind the fault-tolerant router")
+    fl.add_argument("--model", action="append", required=True,
+                    metavar="NAME=DIR",
+                    help="model to serve on every replica "
+                         "(repeatable)")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="number of serve child processes")
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8765,
+                    help="router port (children bind ephemeral "
+                         "ports; 0 = ephemeral router too)")
+    fl.add_argument("--state-root", default=None, metavar="DIR",
+                    help="root for per-replica state dirs "
+                         "(default: .tx_fleet_state under the cwd)")
+    fl.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="per-replica batching window")
+    fl.add_argument("--plan-cache", type=int, default=4,
+                    help="per-replica plan-cache budget (also feeds "
+                         "the router's placement pressure term)")
+    fl.add_argument("--admission", choices=["on", "off"],
+                    default="on",
+                    help="per-replica admission control; the router "
+                         "merges the per-replica states")
+    fl.add_argument("--artifacts", choices=["auto", "require", "off"],
+                    default="auto",
+                    help="AOT artifact mode forwarded to replicas — "
+                         "'require' keeps rolling deploys "
+                         "compile-free by refusing artifact-less "
+                         "boots")
+    fl.add_argument("--snapshot-interval", type=float, default=10.0,
+                    help="per-replica warm-state snapshot cadence "
+                         "(seconds); the snapshot is what makes "
+                         "takeover warm")
+    fl.add_argument("--max-restarts", type=int, default=5,
+                    help="per-replica crash-loop breaker threshold")
+    fl.add_argument("--restart-window", type=float, default=60.0,
+                    help="crash-loop breaker sliding window "
+                         "(seconds)")
+    fl.add_argument("--max-requests", type=int, default=None,
+                    help="router exits after answering this many "
+                         "(tests/bench)")
+    fl.add_argument("--forward-timeout", type=float, default=30.0,
+                    help="per-forward round-trip deadline before the "
+                         "lane fails over")
+
+
+def run_fleet(args) -> int:
+    """Boot the replica set, wire its lifecycle callbacks into the
+    router, and serve until SIGTERM/SIGINT."""
+    from ..serving.fleet import ReplicaManager
+    from ..serving.router import FleetRouter, RouterConfig
+    from ..tuning.model import CostModel
+
+    state_root = args.state_root or os.path.join(
+        os.getcwd(), ".tx_fleet_state")
+    serve_args = ["--max-wait-ms", str(args.max_wait_ms),
+                  "--plan-cache", str(args.plan_cache),
+                  "--admission", args.admission,
+                  "--artifacts", args.artifacts,
+                  "--snapshot-interval", str(args.snapshot_interval)]
+    router = FleetRouter(
+        config=RouterConfig(
+            plan_budget=int(args.plan_cache),
+            forward_timeout=float(args.forward_timeout)),
+        cost_model=CostModel.from_store())
+    first_model = args.model[0].split("=", 1)[0]
+    router.default_model = first_model
+    manager = ReplicaManager(
+        models=args.model, replicas=args.replicas,
+        state_root=state_root, host=args.host,
+        serve_args=serve_args,
+        max_restarts=args.max_restarts,
+        restart_window=args.restart_window,
+        on_up=router.register_replica_threadsafe,
+        on_down=router.unregister_replica_threadsafe,
+        on_draining=router.mark_draining_threadsafe)
+    print(json.dumps({"fleet": "starting",
+                      "replicas": args.replicas,
+                      "state_root": state_root}), flush=True)
+    manager.start()
+    # seed the registry synchronously so the router is ready the
+    # moment its loop starts (on_up callbacks fired before the loop
+    # existed fall through to direct registration)
+    try:
+        return asyncio.run(router.serve(
+            args.host, args.port,
+            max_requests=args.max_requests,
+            banner_extra={"manager": manager.snapshot()}))
+    finally:
+        manager.shutdown()
+        print(json.dumps({"fleet": "stopped",
+                          **manager.snapshot()}), flush=True)

@@ -109,6 +109,14 @@ RULES: Dict[str, tuple] = {
                       "compile per bucket; route through "
                       "artifacts.loader.load_or_compile "
                       "(docs/aot_artifacts.md)"),
+    "TX-R07": (ERROR, "leaked connection writer in serving/: a "
+                      "socket/stream writer stored in a dict with no "
+                      "removal path (del/.pop/.popitem/.clear) "
+                      "anywhere in the module — every client "
+                      "disconnect leaks the entry and its socket fd "
+                      "until the process exhausts file descriptors; "
+                      "evict in the handler's finally "
+                      "(serving/router.py FleetRouter.handle)"),
     # -- cross-procedure rules (whole-program call graph) ------------------
     "TX-X01": (ERROR, "blocking primitive (time.sleep, sync open() "
                       "file I/O, .block_until_ready(), un-awaited "

@@ -90,6 +90,18 @@ call) are caught here in milliseconds:
   container and shed overflow at the enqueue edge with a
   machine-readable ``retry_after_ms`` answer (serving/admission.py);
   bounded constructions and non-queue names are untouched.
+- TX-R07 leaked connection writer (``serving/`` files only): a
+  socket / stream writer / transport stored into a dict-like
+  container (``self._writers[key] = writer``) in a module with NO
+  removal path for that container anywhere — no ``del c[...]``, no
+  ``.pop(...)``/``.popitem()``/``.clear()``/``.discard(...)``. Every
+  client disconnect then leaks one writer entry (and its socket fd):
+  the table only grows, and a long-lived server exhausts fds under
+  nothing but ordinary connection churn. The fix is structural — the
+  handler's ``finally`` must evict the entry when the connection
+  dies (serving/router.py's ``_client_writers`` is the reference
+  shape). Stores of non-connection values and containers with any
+  observed cleanup call are untouched.
 - TX-O01 telemetry/trace emission inside a jitted function body:
   ``telemetry.event(...)``/``telemetry.count(...)``, a tracer span
   enter/exit (``trace.span``/``add_span``/``add_event``), or a
@@ -579,6 +591,12 @@ class _Visitor(ast.NodeVisitor):
         #: memoized jit-builder idiom — their ARGUMENTS are compile
         #: cache keys)
         self.memoized_builders: Set[str] = set()
+        #: TX-R07 (module-wide, resolved in :meth:`finalize`):
+        #: container name -> first node that stored a connection
+        #: writer into it, and the set of containers with ANY
+        #: observed removal path
+        self._writer_stores: Dict[str, ast.AST] = {}
+        self._writer_cleanups: Set[str] = set()
 
     # -- helpers -----------------------------------------------------------
     def add(self, rule: str, node: ast.AST, message: str,
@@ -1203,6 +1221,75 @@ class _Visitor(ast.NodeVisitor):
                      "answer (serving/admission.py)")
             return
 
+    # -- TX-R07: leaked connection writers in serving/ ---------------------
+    _WRITER_NAME_HINTS = ("writer", "sock", "conn", "transport",
+                          "stream")
+
+    @staticmethod
+    def _r07_container_name(node: ast.AST) -> Optional[str]:
+        """The name of a dict-like container — a plain name or a
+        ``self.<attr>``; anything else is out of scope."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute) and _is_self_name(node.value):
+            return node.attr
+        return None
+
+    @classmethod
+    def _r07_writerish(cls, value: ast.AST) -> bool:
+        """Is the stored VALUE connection-shaped — a name/attribute
+        (or a tuple holding one) whose spelling mentions a writer/
+        socket/transport? Deliberately shallow: a call result like
+        ``make_stream_handler(...)`` is not tracked (too many false
+        positives), a plain ``writer`` variable is."""
+        if isinstance(value, ast.Tuple):
+            return any(cls._r07_writerish(e) for e in value.elts)
+        name = None
+        if isinstance(value, ast.Name):
+            name = value.id
+        elif isinstance(value, ast.Attribute):
+            name = value.attr
+        return bool(name) and any(h in name.lower()
+                                  for h in cls._WRITER_NAME_HINTS)
+
+    def _check_writer_store(self, targets, value) -> None:
+        for target in targets:
+            if not isinstance(target, ast.Subscript):
+                continue
+            cname = self._r07_container_name(target.value)
+            if cname is not None and self._r07_writerish(value):
+                self._writer_stores.setdefault(cname, target)
+
+    def _check_writer_cleanup_call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) \
+                and fn.attr in ("pop", "popitem", "clear", "discard"):
+            cname = self._r07_container_name(fn.value)
+            if cname is not None:
+                self._writer_cleanups.add(cname)
+
+    def finalize(self) -> None:
+        """Module-wide verdicts that need the WHOLE tree seen first.
+        TX-R07: every container that received a connection-writer
+        store but shows no removal path anywhere in the module leaks
+        one entry (and one socket fd) per client disconnect."""
+        for cname, node in sorted(self._writer_stores.items(),
+                                  key=lambda kv: kv[1].lineno):
+            if cname in self._writer_cleanups:
+                continue
+            self.add(
+                "TX-R07", node,
+                f"connection writer stored in {cname!r} with no "
+                f"disconnect-cleanup path anywhere in this module — "
+                f"every client disconnect leaks the entry (and its "
+                f"socket fd); the table only grows until the process "
+                f"runs out of file descriptors",
+                ERROR,
+                hint=f"evict the entry when the connection dies: "
+                     f"`finally: {cname}.pop(key, None)` in the "
+                     f"connection handler (see FleetRouter.handle, "
+                     f"serving/router.py)")
+
     # -- TX-O01: telemetry/trace emission inside a jitted body -------------
     _CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "time_ns",
                     "perf_counter_ns", "monotonic_ns"}
@@ -1252,6 +1339,9 @@ class _Visitor(ast.NodeVisitor):
         # TX-R04: torn state-file writes anywhere under serving/ ------------
         if self.serving:
             self._check_state_file_write(node)
+            # TX-R07: any pop/clear on a container counts as a
+            # disconnect-cleanup path for that container
+            self._check_writer_cleanup_call(node)
         # TX-R06: AOT-artifact-loader bypass in serving//cli/ ----------------
         if self.artifact_path:
             self._check_plan_compile_bypass(node)
@@ -1459,6 +1549,7 @@ class _Visitor(ast.NodeVisitor):
             for target in node.targets:
                 self._check_live_mutation(target)
             self._check_unbounded_queue(node.targets, node.value)
+            self._check_writer_store(node.targets, node.value)
         for target in node.targets:
             self._check_tunable_const(target, node.value)
         self.generic_visit(node)
@@ -1522,6 +1613,11 @@ class _Visitor(ast.NodeVisitor):
         if self.serving:
             for target in node.targets:
                 self._check_live_mutation(target, deleting=True)
+                # TX-R07: `del container[key]` is a cleanup path
+                if isinstance(target, ast.Subscript):
+                    cname = self._r07_container_name(target.value)
+                    if cname is not None:
+                        self._writer_cleanups.add(cname)
         self.generic_visit(node)
 
     def _check_live_mutation(self, target: ast.AST,
@@ -1606,6 +1702,7 @@ def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
     visitor = _Visitor(path, al)
     _register_module_jits(tree, al, visitor)
     visitor.visit(tree)
+    visitor.finalize()
     return visitor.findings
 
 

@@ -23,7 +23,9 @@ with the grammar ``scope:name:site:n=fault``:
   name), ``state`` (the warm-restart snapshot path,
   docs/serving_restart.md; name = the registered model name or
   ``server``), ``admission`` (the overload admission edge,
-  docs/admission.md; name = the registered model name).
+  docs/admission.md; name = the registered model name), ``fleet``
+  (the replica set + router layer, docs/fleet.md; name = the replica
+  name, e.g. ``r0``).
 - ``name``   — exact match or ``*``.
 - ``site``   — where the probe sits: ``dispatch`` (per-family device
   eval or the serving plan's fused-program dispatch, once per retry
@@ -46,7 +48,17 @@ with the grammar ``scope:name:site:n=fault``:
   (``admission:<model>:enqueue`` — probed on every admission check; a
   ``burst`` fault registers a phantom arrival spike against the lane
   so shed answers, retry hints and the brownout state machine are
-  drillable without generating real load).
+  drillable without generating real load), and the fleet trio
+  (docs/fleet.md) ``kill`` (``fleet:<replica>:kill`` — probed by the
+  replica manager's watch loop; a ``kill`` fault SIGKILLs that child
+  process, driving the warm-takeover drill), ``partition``
+  (``fleet:<replica>:partition`` — probed by the router on every
+  forward to that replica; a raising fault such as ``preempt`` is
+  treated as a transport failure, so the lane fails over), and
+  ``hang`` (``fleet:<replica>:hang`` — probed inside the router's
+  forward round-trip; a ``hang:<s>`` fault stalls only that forward
+  in an executor thread so the per-request timeout and failover path
+  fire deterministically).
 - ``n``      — fire at the Nth matching probe (1-based), or ``*`` for
   every one.
 - ``fault``  — ``oom`` (RESOURCE_EXHAUSTED-shaped — transient, then
@@ -82,8 +94,9 @@ from typing import Dict, List, Optional, Tuple
 
 _log = logging.getLogger(__name__)
 
-__all__ = ["FaultInjector", "maybe_inject", "KillPoint", "InjectedFault",
-           "InjectedOom", "InjectedPreemption", "InjectedFamilyBug"]
+__all__ = ["FaultInjector", "maybe_inject", "injector_active",
+           "KillPoint", "InjectedFault", "InjectedOom",
+           "InjectedPreemption", "InjectedFamilyBug"]
 
 
 class InjectedFault(Exception):
@@ -235,6 +248,14 @@ def _active() -> Optional[FaultInjector]:
     if _ENV_CACHE[0] != text:
         _ENV_CACHE = (text, FaultInjector(text))
     return _ENV_CACHE[1]
+
+
+def injector_active() -> bool:
+    """True when a fault plan is installed (context manager or
+    ``TX_FAULT_PLAN``). Lets hot paths skip probe plumbing that is
+    only meaningful under a drill — e.g. the fleet router only routes
+    its ``hang`` probe through an executor thread when a plan exists."""
+    return _active() is not None
 
 
 def maybe_inject(scope: str, name: str, site: str) -> Optional[str]:

@@ -9,8 +9,13 @@ and a self-healing model lifecycle — drift-triggered background
 retraining with canary validation, atomic hot-swap, and instant
 rollback (docs/self_healing.md) — plus preemption tolerance: graceful
 drain on SIGTERM and a warm-state snapshot that a restart restores
-behind a readiness gate (docs/serving_restart.md)."""
+behind a readiness gate (docs/serving_restart.md), and a fleet layer — N supervised replicas
+behind a fault-tolerant placement router with warm takeover and
+fleet-coherent overload control (docs/fleet.md)."""
 from .client import ServingUnavailable, TcpServingClient
+from .fleet import ReplicaManager, ReplicaSpec, wait_port_ready
+from .router import (BackendUnavailable, FleetRouter, ReplicaHandle,
+                     RouterConfig, merge_admission)
 from .guard import (AdmissionPolicy, BreakerOpenError, CircuitBreaker,
                     GuardedScoreResult, GuardReason, OutputGuard,
                     SchemaGuard, ServingGuard)
@@ -44,4 +49,7 @@ __all__ = ["ScoringPlan", "EncodedScoreBatch", "PlanCoverage",
            "serve_in_process",
            "LifecycleConfig", "ModelLifecycle",
            "ServingStateSnapshot", "StateManager", "SNAPSHOT_SCHEMA",
-           "TcpServingClient", "ServingUnavailable"]
+           "TcpServingClient", "ServingUnavailable",
+           "FleetRouter", "RouterConfig", "ReplicaHandle",
+           "BackendUnavailable", "merge_admission",
+           "ReplicaManager", "ReplicaSpec", "wait_port_ready"]

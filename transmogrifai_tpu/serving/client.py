@@ -28,6 +28,11 @@ the server-provided hint (capped at the policy's ``max_delay``) and
 resends, counting ``serve_client_shed_retries``; the last attempt
 returns the shed answer to the caller as the verdict.
 
+Resends are dedupe-safe: a request tagged with an ``id`` discards any
+late reply echoing a DIFFERENT ``request_id`` (a leftover answer to
+an earlier abandoned send racing the resend) instead of surfacing two
+answers — counted as ``serve_client_duplicate_replies``.
+
 >>> with TcpServingClient("127.0.0.1", 8190) as client:
 ...     row = client.score({"x": 1.0}, model="m")
 ...     snap = client.metrics()
@@ -118,6 +123,30 @@ class TcpServingClient:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _read_reply(self, rid: Optional[Any]) -> Dict[str, Any]:
+        """Read the reply for request ``rid``, discarding any LATE
+        reply a resend raced: when the caller tagged the request with
+        an ``id``, a line echoing a DIFFERENT ``request_id`` is a
+        leftover answer to an earlier abandoned send (e.g. the read
+        timed out mid-reply, the request was resent, and both answers
+        eventually land on the stream) — surfacing it would answer
+        this request with a stale payload. Counted as
+        ``serve_client_duplicate_replies``; untagged requests keep
+        the first reply, exactly as before."""
+        while True:
+            answer = self._reader.readline()
+            if not answer:
+                raise ConnectionError(
+                    "server closed the connection mid-request")
+            doc = json.loads(answer)
+            echoed = (doc.get("request_id")
+                      if isinstance(doc, dict) else None)
+            if rid is not None and echoed is not None \
+                    and str(echoed) != str(rid):
+                _telemetry.count("serve_client_duplicate_replies")
+                continue
+            return doc
+
     # -- requests ----------------------------------------------------------
     def request(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """One request/response round trip. A transport failure closes
@@ -133,11 +162,7 @@ class TcpServingClient:
             try:
                 self.connect()
                 self._sock.sendall(line.encode())
-                answer = self._reader.readline()
-                if not answer:
-                    raise ConnectionError(
-                        "server closed the connection mid-request")
-                doc = json.loads(answer)
+                doc = self._read_reply(payload.get("id"))
                 if isinstance(doc, dict) and doc.get("draining"):
                     _telemetry.count("serve_client_drain_retries")
                     raise ConnectionError(

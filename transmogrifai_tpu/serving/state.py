@@ -209,22 +209,33 @@ class ServingStateSnapshot:
             prev_art = mdoc.get("artifacts")
             cur_art = (entry.plan.aot_summary()
                        if hasattr(entry.plan, "aot_summary") else None)
-            if prev_art is not None and (
-                    cur_art is None
-                    or cur_art.get("fingerprint")
-                    != prev_art.get("fingerprint")):
+            drifted = prev_art is not None and (
+                cur_art is None
+                or cur_art.get("fingerprint")
+                != prev_art.get("fingerprint"))
+            if drifted:
                 _telemetry.count("serving_state_artifact_drift")
                 _telemetry.event(
                     "serving_state_artifact_drift", model=name,
                     previous=str((prev_art or {}).get("fingerprint")),
                     current=str((cur_art or {}).get("fingerprint")))
-            samples = list(mdoc.get("samples") or []) or [{}]
-            buckets = [int(b) for b in mdoc.get("warm_buckets") or []]
-            for bucket in sorted(buckets):
-                batch = list(itertools.islice(
-                    itertools.cycle(samples), bucket))
-                entry.plan.score(batch)
-            warmed[name] = sorted(buckets)
+            if drifted:
+                # the model dir was re-saved between snapshot and
+                # resume: the snapshot's warm buckets describe
+                # PROGRAMS THAT NO LONGER EXIST. Replaying them would
+                # pay full compiles for plans the new fingerprint may
+                # bucket differently — boot cold for this model and
+                # let live traffic warm the real lattice.
+                warmed[name] = []
+            else:
+                samples = list(mdoc.get("samples") or []) or [{}]
+                buckets = [int(b)
+                           for b in mdoc.get("warm_buckets") or []]
+                for bucket in sorted(buckets):
+                    batch = list(itertools.islice(
+                        itertools.cycle(samples), bucket))
+                    entry.plan.score(batch)
+                warmed[name] = sorted(buckets)
             for tenant in mdoc.get("tenants") or []:
                 if tenant not in entry.guards:
                     entry.guards[tenant] = _TenantGuards(
