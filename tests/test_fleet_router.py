@@ -17,7 +17,9 @@ from transmogrifai_tpu.runtime import FaultInjector, telemetry
 from transmogrifai_tpu.runtime.retry import RetryPolicy
 from transmogrifai_tpu.serving.router import (BackendUnavailable,
                                               FleetRouter,
+                                              ReplicaHandle,
                                               RouterConfig,
+                                              _BackendLink,
                                               merge_admission)
 
 
@@ -47,11 +49,14 @@ class FakeReplica:
     """A JSON-lines server that answers like a serve child. ``mode``
     switches the verdict: ok / draining / shed / drop (close the
     connection without answering — the transport-failure drill) /
-    stale (emit a wrong-request_id line before the real answer)."""
+    flaky (drop the first ``drops_left`` score requests, then answer
+    normally — the transient-blip drill) / stale (emit a
+    wrong-request_id line before the real answer)."""
 
-    def __init__(self, name, mode="ok"):
+    def __init__(self, name, mode="ok", drops_left=1):
         self.name = name
         self.mode = mode
+        self.drops_left = drops_left
         self.requests = []
         self.admission = {"enabled": True, "state": "ok",
                           "pressure": 0.1, "drain_rows_per_s": 100.0,
@@ -88,6 +93,10 @@ class FakeReplica:
                     self.requests.append(msg)
                     rid = msg.get("id")
                     if self.mode == "drop":
+                        writer.close()
+                        return
+                    if self.mode == "flaky" and self.drops_left > 0:
+                        self.drops_left -= 1
                         writer.close()
                         return
                     if self.mode == "draining":
@@ -329,6 +338,84 @@ class TestForwarding:
                     await rep.stop()
         asyncio.run(drive())
 
+    def test_transport_blip_resends_on_same_link(self):
+        """A replica that drops ONE connection mid-request and then
+        answers must be healed by the in-link reconnect+resend: the
+        resend carries the same request id, and its genuine reply
+        must NOT be discarded as a stale duplicate (the regression:
+        marking the rid stale per-attempt made every post-blip retry
+        burn the full forward timeout)."""
+        async def drive():
+            router = _router()
+            flaky = FakeReplica("r0", mode="flaky", drops_left=1)
+            reps = await _fleet(router, flaky)
+            try:
+                out = await asyncio.wait_for(
+                    router.score({"record": {"x": 1}, "model": "m",
+                                  "tenant": "t"}), timeout=5)
+                assert out["ok"], out
+                assert out["replica"] == "r0"
+                # the reconnect's reply was surfaced, not deduped
+                assert telemetry.counters().get(
+                    "fleet_backend_duplicate_replies", 0) == 0
+                assert telemetry.counters().get(
+                    "fleet_backend_reconnects", 0) == 1
+                # the lone replica survived its blip
+                assert router.replicas["r0"].state == "ok"
+                assert len(flaky.requests) == 2   # original + resend
+            finally:
+                for rep in reps:
+                    await rep.stop()
+        asyncio.run(drive())
+
+    def test_abandoned_rid_joins_stale_ring_and_is_skipped(self):
+        """Only a rid ABANDONED on a link (every attempt failed) joins
+        the stale ring — and a late reply carrying it is then skipped
+        by a later expect-less round trip (the probe path)."""
+        async def drive():
+            state = {"conns": 0}
+
+            async def handle(reader, writer):
+                state["conns"] += 1
+                line = await reader.readline()
+                if not line:
+                    writer.close()
+                    return
+                if state["conns"] <= 3:
+                    # swallow the request: the link retries, then
+                    # abandons the rid after its final attempt
+                    writer.close()
+                    return
+                # replay the abandoned request's late reply, then
+                # answer the probe for real
+                late = {"ok": True, "request_id": "abandoned-1",
+                        "result": "from the past"}
+                real = {"ok": True, "metrics": {"admission": None}}
+                writer.write((json.dumps(late) + "\n").encode())
+                writer.write((json.dumps(real) + "\n").encode())
+                await writer.drain()
+
+            server = await asyncio.start_server(handle, "127.0.0.1",
+                                                0)
+            port = server.sockets[0].getsockname()[1]
+            link = _BackendLink(ReplicaHandle("r0", "127.0.0.1",
+                                              port),
+                                _fast_retry(), timeout=2.0)
+            try:
+                with pytest.raises(BackendUnavailable):
+                    await link.request({"record": {},
+                                        "id": "abandoned-1"})
+                assert "abandoned-1" in link._stale_rids
+                out = await link.probe()
+                assert "metrics" in out   # the late reply was skipped
+                assert telemetry.counters().get(
+                    "fleet_backend_duplicate_replies", 0) >= 1
+            finally:
+                await link.close()
+                server.close()
+                await server.wait_closed()
+        asyncio.run(drive())
+
     def test_all_replicas_dead_is_answered_error(self):
         async def drive():
             router = _router()
@@ -380,6 +467,47 @@ class TestFleetAdmission:
                 assert out["retry_after_ms"] == merged[
                     "retry_after_ms"] == 166
                 assert cold.requests == []   # never forwarded
+            finally:
+                for rep in reps:
+                    await rep.stop()
+        asyncio.run(drive())
+
+    def test_dead_replica_recovers_via_poll_probe(self):
+        """A transient blip must not shrink the fleet permanently:
+        the admission poll keeps re-probing a dead-but-registered
+        replica and restores it to ok on a successful round trip
+        (the manager only re-announces a replica after a respawn, so
+        without this the router would never use it again)."""
+        async def drive():
+            router = _router()
+            blip = FakeReplica("r0", mode="drop")
+            reps = await _fleet(router, blip)
+            try:
+                out = await router.score({"record": {"x": 1},
+                                          "model": "m",
+                                          "tenant": "t"})
+                assert out["ok"] is False and out.get("unavailable")
+                assert router.replicas["r0"].state == "dead"
+                # while the replica stays unreachable the probe fails
+                # and it stays dead
+                await blip.stop()
+                await router.poll_admission_once()
+                assert router.replicas["r0"].state == "dead"
+                # the replica comes back healthy on the SAME port:
+                # one poll restores it without any re-registration
+                blip.mode = "ok"
+                blip.server = await asyncio.start_server(
+                    blip._handle, "127.0.0.1", blip.port)
+                await router.poll_admission_once()
+                assert router.replicas["r0"].state == "ok"
+                assert router.stats["recoveries"] == 1
+                assert telemetry.counters().get(
+                    "fleet_replica_recoveries", 0) == 1
+                out = await router.score({"record": {"x": 2},
+                                          "model": "m",
+                                          "tenant": "t"})
+                assert out["ok"], out
+                assert out["replica"] == "r0"
             finally:
                 for rep in reps:
                     await rep.stop()

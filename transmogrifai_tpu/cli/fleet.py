@@ -106,11 +106,14 @@ def run_fleet(args) -> int:
     print(json.dumps({"fleet": "starting",
                       "replicas": args.replicas,
                       "state_root": state_root}), flush=True)
-    manager.start()
-    # seed the registry synchronously so the router is ready the
-    # moment its loop starts (on_up callbacks fired before the loop
-    # existed fall through to direct registration)
     try:
+        # start() inside the try: a partial boot (some children
+        # spawned, none became ready) must still reach shutdown()
+        # below, or the spawned serve processes leak
+        manager.start()
+        # seed the registry synchronously so the router is ready the
+        # moment its loop starts (on_up callbacks fired before the
+        # loop existed fall through to direct registration)
         return asyncio.run(router.serve(
             args.host, args.port,
             max_requests=args.max_requests,

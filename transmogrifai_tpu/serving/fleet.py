@@ -18,6 +18,8 @@ The robustness contract, per replica:
   docs/serving_restart.md), so takeover is WARM, not a cold start.
   While the replacement boots, the router has already re-placed the
   dead replica's lanes onto survivors — clients never see the gap.
+  Each heal runs on its own thread: the watch loop keeps ticking the
+  other replicas, so near-simultaneous crashes heal in parallel.
 - **Crash-loop breaker.** Per-replica sliding-window crash counting,
   exactly like the PR-12 supervisor: more than ``max_restarts``
   crashes inside ``restart_window`` seconds marks the replica
@@ -189,7 +191,8 @@ class ReplicaManager:
                 extra_args=tuple(serve_args),
                 env=dict(env or {}))
         self.procs: Dict[str, ReplicaProcess] = {}
-        #: "starting" | "ok" | "draining" | "failed" | "stopped"
+        #: "starting" | "ok" | "healing" | "draining" | "failed"
+        #: | "stopped"
         self.states: Dict[str, str] = {n: "starting"
                                        for n in self.specs}
         self._crashes: Dict[str, deque] = {n: deque()
@@ -198,6 +201,7 @@ class ReplicaManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watch: Optional[threading.Thread] = None
+        self._heals: Dict[str, threading.Thread] = {}
         self.kill_drills = 0
 
     # -- spawning ----------------------------------------------------------
@@ -291,12 +295,24 @@ class ReplicaManager:
             # graceful exits end the incarnation without healing;
             # rolling_deploy owns the respawn
             return
-        self._heal(name, rc)
+        # heal on a dedicated thread: _heal blocks on the backoff
+        # sleep and then on the replacement's readiness gate (up to
+        # ready_timeout), and the watch loop must keep ticking the
+        # OTHER replicas meanwhile — near-simultaneous crashes heal
+        # in parallel and kill drills keep firing. The "healing"
+        # state keeps this tick from starting a second heal.
+        with self._lock:
+            self.states[name] = "healing"
+        t = threading.Thread(target=self._heal, args=(name, rc),
+                             daemon=True)
+        self._heals[name] = t
+        t.start()
 
     def _heal(self, name: str, rc: int) -> None:
         """Crash detected: count it against the sliding window, then
         either trip the per-replica crash-loop breaker or respawn
-        with ``--resume-state`` (the warm takeover)."""
+        with ``--resume-state`` (the warm takeover). Runs on its own
+        thread, one per healing replica."""
         now = time.monotonic()
         crashes = self._crashes[name]
         crashes.append(now)
@@ -309,7 +325,7 @@ class ReplicaManager:
               flush=True)
         if self.on_down is not None:
             self.on_down(name, f"exit {rc}")
-        if len(crashes) >= self.max_restarts:
+        if len(crashes) > self.max_restarts:
             with self._lock:
                 self.states[name] = "failed"
             _telemetry.count("fleet_crash_loop_breakers")
@@ -319,13 +335,16 @@ class ReplicaManager:
                               "window_seconds": self.restart_window}),
                   flush=True)
             return
-        time.sleep(self.retry.delay_for(
-            len(crashes), f"fleet-restart:{name}"))
+        if self._stop.wait(self.retry.delay_for(
+                len(crashes), f"fleet-restart:{name}")):
+            return   # manager is shutting down — no respawn
         try:
             self._boot(name, resume=True)
         except (OSError, TimeoutError, RuntimeError) as e:
-            # respawn failed outright — count it as another crash so
-            # the breaker can still trip instead of looping forever
+            # respawn failed outright — harsher than another crash:
+            # a replacement that cannot even reach ready has nothing
+            # a restart window could ride out, so the replica is
+            # marked failed immediately instead of looping forever
             _telemetry.event("fleet_respawn_failed", replica=name,
                              error=str(e)[:200])
             with self._lock:
@@ -373,19 +392,26 @@ class ReplicaManager:
         self._stop.set()
         if self._watch is not None:
             self._watch.join(5.0)
+        for t in list(self._heals.values()):
+            t.join(2.0)
         for name, rp in list(self.procs.items()):
             with self._lock:
                 self.states[name] = "stopped"
             if rp.alive():
                 rp.proc.terminate()
         deadline = time.monotonic() + timeout
-        for rp in self.procs.values():
+        for rp in list(self.procs.values()):
             remaining = max(deadline - time.monotonic(), 0.1)
             try:
                 rp.proc.wait(remaining)
             except subprocess.TimeoutExpired:
                 rp.proc.kill()
                 rp.proc.wait(10)
+        # a heal thread that out-waited the joins above may have
+        # slipped a fresh spawn past the terminate sweep — reap it
+        for rp in list(self.procs.values()):
+            if rp.alive():
+                rp.proc.kill()
 
     def snapshot(self) -> dict:
         """Manager-side view for the fleet metrics document."""

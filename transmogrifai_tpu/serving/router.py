@@ -24,7 +24,11 @@ notice nothing — and owns three fleet-only concerns:
   ``{"ok": false, "draining": true}`` answer from a gracefully
   stopping replica (docs/serving_restart.md) is the rolling-deploy
   re-place signal: the lane moves, the request resends, zero
-  client-observed failures.
+  client-observed failures. A replica the router marked dead on a
+  transient blip is re-probed by the admission poll and restored to
+  ``ok`` on a successful round trip (``fleet_replica_recoveries``) —
+  router-side death is never permanent while the replica stays
+  registered.
 - **Fleet-coherent admission.** The router polls every replica's
   ``metrics_snapshot()["admission"]`` block (docs/admission.md) and
   merges them: fleet state is the WORST replica state, the drain rate
@@ -210,10 +214,16 @@ class _BackendLink:
             doc = json.loads(raw)
             rid = (doc.get("request_id")
                    if isinstance(doc, dict) else None)
-            if rid is not None and rid in self._stale_rids:
+            wanted = (expect_rid is not None and rid is not None
+                      and str(rid) == str(expect_rid))
+            if rid is not None and not wanted \
+                    and rid in self._stale_rids:
                 # late reply for a request we already abandoned and
                 # resent elsewhere — surfacing it would answer the
-                # CURRENT request with a stale payload
+                # CURRENT request with a stale payload. A reply whose
+                # rid matches expect_rid is NEVER stale: an in-link
+                # reconnect resends the SAME rid, and its answer is
+                # exactly the one we are waiting for.
                 _telemetry.count("fleet_backend_duplicate_replies")
                 continue
             if expect_rid is not None and rid is not None \
@@ -243,8 +253,6 @@ class _BackendLink:
                 except (OSError, ConnectionError, asyncio.TimeoutError,
                         json.JSONDecodeError, InjectedFault) as e:
                     last = e
-                    if expect_rid is not None:
-                        self._stale_rids.append(expect_rid)
                     await self.close()
                     _telemetry.count("fleet_backend_reconnects")
                     if attempt < self.retry.max_attempts:
@@ -252,11 +260,40 @@ class _BackendLink:
                             attempt,
                             f"fleet:{self.handle.name}:"
                             f"{self.handle.port}"))
+            if expect_rid is not None:
+                # only NOW is the request abandoned on this link (the
+                # caller fails the lane over and resends elsewhere) —
+                # a reply that straggles in later must not answer a
+                # future request. Recording the rid per-attempt would
+                # make the in-link reconnect discard its own resend's
+                # genuine reply as a duplicate.
+                self._stale_rids.append(expect_rid)
         raise BackendUnavailable(
             f"replica {self.handle.name} "
             f"({self.handle.host}:{self.handle.port}) unreachable "
             f"after {self.retry.max_attempts} attempts "
             f"[{classify_error(last)}]: {last}") from last
+
+    async def probe(self) -> dict:
+        """One SINGLE-attempt metrics round trip with a short
+        deadline and no backoff — the router's dead-replica recovery
+        probe (:meth:`FleetRouter.poll_admission_once`). Kept separate
+        from :meth:`request` so a still-dead replica costs the poll
+        loop one fast failure, not a full retry ladder."""
+        line = b'{"metrics": true}\n'
+        async with self._lock:
+            try:
+                maybe_inject("fleet", self.handle.name, "partition")
+                return await asyncio.wait_for(
+                    self._roundtrip(line, None),
+                    min(self.timeout, 2.0))
+            except (OSError, ConnectionError, asyncio.TimeoutError,
+                    json.JSONDecodeError, InjectedFault) as e:
+                await self.close()
+                raise BackendUnavailable(
+                    f"replica {self.handle.name} "
+                    f"({self.handle.host}:{self.handle.port}) probe "
+                    f"failed [{classify_error(e)}]: {e}") from e
 
 
 class FleetRouter:
@@ -291,7 +328,8 @@ class FleetRouter:
         self.on_replica_down: Optional[Callable[[str, str], None]] = None
         self.stats = {"requests": 0, "answered": 0, "failovers": 0,
                       "sheds": 0, "placements": 0,
-                      "lane_replacements": 0, "unavailable": 0}
+                      "lane_replacements": 0, "unavailable": 0,
+                      "recoveries": 0}
         self._rid_counter = itertools.count(1)
         self._conn_counter = itertools.count(1)
         self._started_at = time.time()
@@ -450,14 +488,34 @@ class FleetRouter:
         for name in list(self.replicas):
             handle = self.replicas.get(name)
             link = self._links.get(name)
-            if handle is None or link is None or not handle.usable():
+            if handle is None or link is None \
+                    or handle.state == "draining":
                 continue
-            try:
-                answer = await link.request({"metrics": True})
-            except BackendUnavailable as e:
-                _telemetry.count("fleet_admission_poll_failures")
-                self._mark_down(name, f"metrics poll failed: {e}")
-                continue
+            if handle.state == "dead":
+                # recovery probe: a replica the ROUTER marked dead on
+                # a transient blip (failed forward or metrics poll)
+                # is still registered — one successful round trip
+                # restores it. Without this, a brief network error
+                # would shrink the fleet permanently: the manager
+                # only re-announces a replica after a respawn, and a
+                # healthy child never respawns.
+                try:
+                    answer = await link.probe()
+                except BackendUnavailable:
+                    _telemetry.count("fleet_recovery_probe_failures")
+                    continue
+                handle.state = "ok"
+                self.stats["recoveries"] += 1
+                _telemetry.count("fleet_replica_recoveries")
+                _telemetry.event("fleet_replica_recovered",
+                                 replica=name)
+            else:
+                try:
+                    answer = await link.request({"metrics": True})
+                except BackendUnavailable as e:
+                    _telemetry.count("fleet_admission_poll_failures")
+                    self._mark_down(name, f"metrics poll failed: {e}")
+                    continue
             snap = answer.get("metrics", answer) \
                 if isinstance(answer, dict) else {}
             handle.admission = snap.get("admission")
